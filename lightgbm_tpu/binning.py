@@ -1,0 +1,336 @@
+"""Quantile binning: raw feature values -> small integer bins.
+
+Reference analog: ``BinMapper`` (include/LightGBM/bin.h:85, src/io/bin.cpp
+GreedyFindBin / FindBinWithZeroAsOneBin).  Host-side NumPy, run once at
+Dataset construction; the result is a dense ``uint8``/``uint16``
+``[num_rows, num_features]`` device array — the TPU-native replacement for
+the reference's per-feature Bin column stores (dense_bin.hpp/sparse_bin.hpp).
+
+Semantics kept from the reference:
+  * equal-count greedy bins from a row sample, bin boundary = midpoint
+    between adjacent distinct values;
+  * zero gets its own bin (the [-kZeroThreshold, kZeroThreshold) band);
+  * missing handling: MissingType None/Zero/NaN; NaN gets a dedicated last
+    bin when ``use_missing`` and NaNs are present; ``zero_as_missing`` folds
+    zeros into the missing bin;
+  * categorical features are binned by descending frequency, cut at 99% of
+    total count and at ``max_bin`` categories;
+  * features with a single effective bin are marked trivial and dropped from
+    training.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+K_ZERO_THRESHOLD = 1e-35
+_EPS = 1e-300
+
+
+class MissingType:
+    NONE = 0
+    ZERO = 1
+    NAN = 2
+
+
+def _greedy_find_bin(
+    distinct_values: np.ndarray,
+    counts: np.ndarray,
+    max_bin: int,
+    total_sample_cnt: int,
+    min_data_in_bin: int,
+) -> List[float]:
+    """Equal-count greedy binning over sorted distinct values.
+
+    Returns the list of bin upper bounds (last is +inf).
+    """
+    n = len(distinct_values)
+    if n == 0:
+        return []
+    if n <= max_bin:
+        # every distinct value its own bin, but honor min_data_in_bin
+        bounds: List[float] = []
+        cur_cnt = 0
+        for i in range(n - 1):
+            cur_cnt += counts[i]
+            if cur_cnt >= min_data_in_bin or max_bin >= n:
+                bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                cur_cnt = 0
+        bounds.append(np.inf)
+        return bounds
+
+    # more distinct values than bins: greedy equal-count with heavy values
+    # forced into their own bin (reference GreedyFindBin's is_big_count_value)
+    max_bin = max(1, max_bin)
+    mean_bin_size = total_sample_cnt / max_bin
+    is_big = counts >= mean_bin_size
+    rest_cnt = total_sample_cnt - counts[is_big].sum()
+    rest_bins = max_bin - int(is_big.sum())
+    if rest_bins > 0:
+        mean_bin_size = rest_cnt / rest_bins
+    bounds = []
+    cur_cnt = 0
+    remaining_bins = max_bin
+    for i in range(n - 1):
+        if not is_big[i]:
+            rest_cnt -= counts[i]
+        cur_cnt += counts[i]
+        # close the bin if it is full enough, or the next value is heavy
+        if (
+            is_big[i]
+            or cur_cnt >= mean_bin_size
+            or (is_big[i + 1] and cur_cnt >= max(1.0, mean_bin_size * 0.5))
+        ):
+            bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+            cur_cnt = 0
+            remaining_bins -= 1
+            if remaining_bins <= 1:
+                break
+            if not is_big[i] and rest_bins > 0:
+                rest_bins_left = remaining_bins - int(is_big[i + 1 :].sum())
+                if rest_bins_left > 0:
+                    mean_bin_size = max(1.0, rest_cnt / rest_bins_left)
+    bounds.append(np.inf)
+    return bounds
+
+
+def _find_bin_zero_as_one(
+    values: np.ndarray,
+    counts_total: int,
+    max_bin: int,
+    min_data_in_bin: int,
+) -> List[float]:
+    """Numerical binning with zero forced into its own bin.
+
+    Reference: FindBinWithZeroAsOneBin (src/io/bin.cpp) — negatives and
+    positives are binned separately with bin budget split proportionally,
+    and the zero band [-kZeroThreshold, kZeroThreshold] forms one bin.
+    """
+    values = values[np.isfinite(values)]
+    neg = values[values < -K_ZERO_THRESHOLD]
+    pos = values[values > K_ZERO_THRESHOLD]
+    n_zero = counts_total - len(neg) - len(pos)
+    n_total = counts_total
+    if n_total == 0:
+        return [np.inf]
+
+    budget = max_bin - 1  # one bin reserved for zero
+    n_neg, n_pos = len(neg), len(pos)
+    nonzero = n_neg + n_pos
+    if nonzero == 0:
+        return [np.inf]
+    neg_bins = int(round(budget * (n_neg / n_total))) if n_neg > 0 else 0
+    if n_neg > 0:
+        neg_bins = max(1, neg_bins)
+    pos_bins = budget - neg_bins
+    if n_pos > 0:
+        pos_bins = max(1, pos_bins)
+
+    bounds: List[float] = []
+    if n_neg > 0:
+        dv, cnt = np.unique(neg, return_counts=True)
+        b = _greedy_find_bin(dv, cnt, max(1, neg_bins), n_neg, min_data_in_bin)
+        # last bound of the negative side closes at the zero band
+        if b:
+            b[-1] = -K_ZERO_THRESHOLD
+            bounds.extend(b)
+        else:
+            bounds.append(-K_ZERO_THRESHOLD)
+    if n_zero > 0 or (n_neg > 0 and n_pos > 0):
+        bounds.append(K_ZERO_THRESHOLD)
+    if n_pos > 0:
+        dv, cnt = np.unique(pos, return_counts=True)
+        b = _greedy_find_bin(dv, cnt, max(1, pos_bins), n_pos, min_data_in_bin)
+        bounds.extend(b)
+    if not bounds or bounds[-1] != np.inf:
+        bounds.append(np.inf)
+    # dedupe while preserving order
+    out: List[float] = []
+    for x in bounds:
+        if not out or x > out[-1]:
+            out.append(x)
+    return out
+
+
+@dataclasses.dataclass
+class BinMapper:
+    """Per-feature value->bin mapping (reference: include/LightGBM/bin.h:85)."""
+
+    bin_upper_bound: np.ndarray  # [num_numeric_bins] float64, last == +inf
+    is_categorical: bool = False
+    missing_type: int = MissingType.NONE
+    num_bins: int = 1  # total bins incl. NaN bin if present
+    nan_bin: int = -1  # bin index NaN maps to, -1 if none
+    cat_to_bin: Optional[Dict[int, int]] = None
+    bin_to_cat: Optional[np.ndarray] = None
+    min_value: float = 0.0
+    max_value: float = 0.0
+    default_bin: int = 0  # bin of value 0.0 (reference default_bin concept)
+
+    @property
+    def is_trivial(self) -> bool:
+        return self.num_bins <= 1
+
+    # ---------------------------------------------------------------- build
+    @classmethod
+    def from_sample(
+        cls,
+        values: np.ndarray,
+        max_bin: int,
+        *,
+        is_categorical: bool = False,
+        min_data_in_bin: int = 3,
+        use_missing: bool = True,
+        zero_as_missing: bool = False,
+        total_cnt: Optional[int] = None,
+    ) -> "BinMapper":
+        values = np.asarray(values, dtype=np.float64).ravel()
+        total_cnt = int(total_cnt if total_cnt is not None else len(values))
+        nan_mask = np.isnan(values)
+        has_nan = bool(nan_mask.any())
+        finite = values[~nan_mask]
+
+        if is_categorical:
+            return cls._from_sample_categorical(
+                finite, max_bin, has_nan and use_missing, min_data_in_bin
+            )
+
+        if zero_as_missing:
+            missing_type = MissingType.ZERO if use_missing else MissingType.NONE
+        elif has_nan and use_missing:
+            missing_type = MissingType.NAN
+        else:
+            missing_type = MissingType.NONE
+
+        if len(finite) == 0:
+            if has_nan and use_missing:
+                return cls(
+                    bin_upper_bound=np.array([np.inf]),
+                    missing_type=MissingType.NAN,
+                    num_bins=2,
+                    nan_bin=1,
+                )
+            return cls(bin_upper_bound=np.array([np.inf]), num_bins=1)
+
+        if zero_as_missing:
+            # zeros are folded into the missing bin: bin the nonzero values,
+            # missing bin appended at the end
+            nonzero = finite[np.abs(finite) > K_ZERO_THRESHOLD]
+            if len(nonzero) == 0:
+                bounds = [np.inf]
+            else:
+                dv, cnt = np.unique(nonzero, return_counts=True)
+                bounds = _greedy_find_bin(dv, cnt, max_bin - 1, len(nonzero), min_data_in_bin)
+        else:
+            bounds = _find_bin_zero_as_one(finite, len(finite), max_bin, min_data_in_bin)
+
+        num_numeric = len(bounds)
+        nan_bin = -1
+        num_bins = num_numeric
+        if missing_type == MissingType.NAN or missing_type == MissingType.ZERO:
+            nan_bin = num_numeric
+            num_bins = num_numeric + 1
+
+        ub = np.asarray(bounds, dtype=np.float64)
+        default_bin = int(np.searchsorted(ub, 0.0, side="left"))
+        if missing_type == MissingType.ZERO:
+            default_bin = nan_bin
+        return cls(
+            bin_upper_bound=ub,
+            missing_type=missing_type,
+            num_bins=num_bins,
+            nan_bin=nan_bin,
+            min_value=float(finite.min()),
+            max_value=float(finite.max()),
+            default_bin=default_bin,
+        )
+
+    @classmethod
+    def _from_sample_categorical(
+        cls, finite: np.ndarray, max_bin: int, has_nan_bin: bool, min_data_in_bin: int
+    ) -> "BinMapper":
+        cats = finite.astype(np.int64)
+        if len(cats) == 0:
+            return cls(bin_upper_bound=np.array([np.inf]), is_categorical=True, num_bins=1)
+        if cats.min() < 0:
+            raise ValueError("categorical feature values must be non-negative")
+        uniq, cnt = np.unique(cats, return_counts=True)
+        order = np.argsort(-cnt, kind="stable")
+        uniq, cnt = uniq[order], cnt[order]
+        # cut at 99% of total count and max_bin categories (reference bin.cpp)
+        cutoff = 0.99 * cnt.sum()
+        keep = min(len(uniq), max_bin - (1 if has_nan_bin else 0))
+        csum = np.cumsum(cnt)
+        while keep > 1 and csum[keep - 1] - cnt[keep - 1] >= cutoff:
+            keep -= 1
+        uniq = uniq[:keep]
+        cat_to_bin = {int(c): i for i, c in enumerate(uniq)}
+        num_bins = keep
+        nan_bin = -1
+        if has_nan_bin:
+            nan_bin = num_bins
+            num_bins += 1
+        return cls(
+            bin_upper_bound=np.array([np.inf]),
+            is_categorical=True,
+            missing_type=MissingType.NAN if has_nan_bin else MissingType.NONE,
+            num_bins=num_bins,
+            nan_bin=nan_bin,
+            cat_to_bin=cat_to_bin,
+            bin_to_cat=uniq.copy(),
+            min_value=float(uniq.min()),
+            max_value=float(uniq.max()),
+        )
+
+    # ------------------------------------------------------------- mapping
+    def values_to_bins(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized value->bin (reference BinMapper::ValueToBin bin.h:173)."""
+        values = np.asarray(values, dtype=np.float64).ravel()
+        if self.is_categorical:
+            out = np.zeros(len(values), dtype=np.int32)
+            nan_mask = np.isnan(values)
+            iv = np.where(nan_mask, 0, values).astype(np.int64)
+            if self.bin_to_cat is not None and len(self.bin_to_cat):
+                sorter = np.argsort(self.bin_to_cat)
+                sorted_cats = self.bin_to_cat[sorter]
+                pos = np.searchsorted(sorted_cats, iv)
+                pos = np.clip(pos, 0, len(sorted_cats) - 1)
+                found = sorted_cats[pos] == iv
+                out = np.where(found, sorter[pos], 0).astype(np.int32)
+            if self.nan_bin >= 0:
+                out[nan_mask] = self.nan_bin
+            return out
+
+        nan_mask = np.isnan(values)
+        if self.missing_type == MissingType.ZERO:
+            miss = nan_mask | (np.abs(values) <= K_ZERO_THRESHOLD)
+            safe = np.where(nan_mask, 0.0, values)
+            out = np.searchsorted(self.bin_upper_bound, safe, side="left").astype(np.int32)
+            out[miss] = self.nan_bin
+            return out
+        safe = np.where(nan_mask, 0.0, values)
+        out = np.searchsorted(self.bin_upper_bound, safe, side="left").astype(np.int32)
+        if self.missing_type == MissingType.NAN and self.nan_bin >= 0:
+            out[nan_mask] = self.nan_bin
+        return out
+
+    def bin_to_threshold(self, bin_idx: int) -> float:
+        """Real-valued split threshold for 'bin <= bin_idx goes left'."""
+        if self.is_categorical:
+            raise ValueError("categorical bins have no scalar threshold")
+        b = int(bin_idx)
+        if b >= len(self.bin_upper_bound) - 1:
+            return float(self.bin_upper_bound[-2]) if len(self.bin_upper_bound) > 1 else 0.0
+        return float(self.bin_upper_bound[b])
+
+    def feature_info_str(self) -> str:
+        """feature_infos entry for the model file (reference dataset.cpp)."""
+        if self.is_trivial:
+            return "none"
+        if self.is_categorical:
+            cats = sorted(int(c) for c in (self.bin_to_cat if self.bin_to_cat is not None else []))
+            return ":".join(str(c) for c in cats)
+        return f"[{self.min_value:g}:{self.max_value:g}]"

@@ -1,0 +1,529 @@
+"""Parameter/config system.
+
+Reference analog: ``include/LightGBM/config.h`` (struct Config) and the
+generated alias table in ``src/io/config_auto.cpp``.  The reference declares
+~200 typed fields and code-generates a string->struct parser; here a plain
+dataclass plus an explicit alias map gives the same user-facing contract
+(param dicts with aliases, first-value-wins precedence, post-parse
+consistency fixes) without codegen.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+# Alias -> canonical name. Mirrors the documented LightGBM parameter aliases
+# (reference: src/io/config_auto.cpp alias table).
+_PARAM_ALIASES: Dict[str, str] = {
+    # core
+    "config_file": "config",
+    "task_type": "task",
+    "objective_type": "objective",
+    "app": "objective",
+    "application": "objective",
+    "loss": "objective",
+    "boosting_type": "boosting",
+    "boost": "boosting",
+    "train": "data",
+    "train_data": "data",
+    "train_data_file": "data",
+    "data_filename": "data",
+    "test": "valid",
+    "valid_data": "valid",
+    "valid_data_file": "valid",
+    "test_data": "valid",
+    "test_data_file": "valid",
+    "valid_filenames": "valid",
+    "num_iteration": "num_iterations",
+    "n_iter": "num_iterations",
+    "num_tree": "num_iterations",
+    "num_trees": "num_iterations",
+    "num_round": "num_iterations",
+    "num_rounds": "num_iterations",
+    "nrounds": "num_iterations",
+    "num_boost_round": "num_iterations",
+    "n_estimators": "num_iterations",
+    "max_iter": "num_iterations",
+    "shrinkage_rate": "learning_rate",
+    "eta": "learning_rate",
+    "num_leaf": "num_leaves",
+    "max_leaves": "num_leaves",
+    "max_leaf": "num_leaves",
+    "max_leaf_nodes": "num_leaves",
+    "tree": "tree_learner",
+    "tree_type": "tree_learner",
+    "tree_learner_type": "tree_learner",
+    "num_thread": "num_threads",
+    "nthread": "num_threads",
+    "nthreads": "num_threads",
+    "n_jobs": "num_threads",
+    "device": "device_type",
+    "random_seed": "seed",
+    "random_state": "seed",
+    # learning control
+    "min_data_per_leaf": "min_data_in_leaf",
+    "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_samples_leaf": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf",
+    "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "sub_row": "bagging_fraction",
+    "subsample": "bagging_fraction",
+    "bagging": "bagging_fraction",
+    "pos_sub_row": "pos_bagging_fraction",
+    "pos_subsample": "pos_bagging_fraction",
+    "pos_bagging": "pos_bagging_fraction",
+    "neg_sub_row": "neg_bagging_fraction",
+    "neg_subsample": "neg_bagging_fraction",
+    "neg_bagging": "neg_bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "bagging_fraction_seed": "bagging_seed",
+    "sub_feature": "feature_fraction",
+    "colsample_bytree": "feature_fraction",
+    "sub_feature_bynode": "feature_fraction_bynode",
+    "colsample_bynode": "feature_fraction_bynode",
+    "early_stopping_rounds": "early_stopping_round",
+    "early_stopping": "early_stopping_round",
+    "n_iter_no_change": "early_stopping_round",
+    "max_tree_output": "max_delta_step",
+    "max_leaf_output": "max_delta_step",
+    "reg_alpha": "lambda_l1",
+    "l1_regularization": "lambda_l1",
+    "reg_lambda": "lambda_l2",
+    "lambda": "lambda_l2",
+    "l2_regularization": "lambda_l2",
+    "min_split_gain": "min_gain_to_split",
+    "rate_drop": "drop_rate",
+    "topk": "top_k",
+    "mc": "monotone_constraints",
+    "monotone_constraint": "monotone_constraints",
+    "monotone_constraining_method": "monotone_constraints_method",
+    "mc_method": "monotone_constraints_method",
+    "monotone_splits_penalty": "monotone_penalty",
+    "ms_penalty": "monotone_penalty",
+    "mc_penalty": "monotone_penalty",
+    "feature_contrib": "feature_contri",
+    "fc": "feature_contri",
+    "fp": "feature_contri",
+    "feature_penalty": "feature_contri",
+    "fs": "forcedsplits_filename",
+    "forced_splits_filename": "forcedsplits_filename",
+    "forced_splits_file": "forcedsplits_filename",
+    "forced_splits": "forcedsplits_filename",
+    "verbose": "verbosity",
+    # dataset
+    "linear_trees": "linear_tree",
+    "max_bins": "max_bin",
+    "subsample_for_bin": "bin_construct_sample_cnt",
+    "data_seed": "data_random_seed",
+    "is_sparse": "is_enable_sparse",
+    "enable_sparse": "is_enable_sparse",
+    "sparse": "is_enable_sparse",
+    "is_enable_bundle": "enable_bundle",
+    "bundle": "enable_bundle",
+    "is_pre_partition": "pre_partition",
+    "two_round_loading": "two_round",
+    "use_two_round_loading": "two_round",
+    "has_header": "header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column",
+    "group_id": "group_column",
+    "query_column": "group_column",
+    "query": "group_column",
+    "query_id": "group_column",
+    "ignore_feature": "ignore_column",
+    "blacklist": "ignore_column",
+    "cat_feature": "categorical_feature",
+    "categorical_column": "categorical_feature",
+    "cat_column": "categorical_feature",
+    "categorical_features": "categorical_feature",
+    # predict
+    "is_predict_raw_score": "predict_raw_score",
+    "predict_rawscore": "predict_raw_score",
+    "raw_score": "predict_raw_score",
+    "is_predict_leaf_index": "predict_leaf_index",
+    "leaf_index": "predict_leaf_index",
+    "is_predict_contrib": "predict_contrib",
+    "contrib": "predict_contrib",
+    # objective
+    "num_classes": "num_class",
+    "unbalance": "is_unbalance",
+    "unbalanced_sets": "is_unbalance",
+    "num_position_buckets": "lambdarank_position_bias_regularization",
+    # metric
+    "metrics": "metric",
+    "metric_types": "metric",
+    "output_freq": "metric_freq",
+    "training_metric": "is_provide_training_metric",
+    "is_training_metric": "is_provide_training_metric",
+    "train_metric": "is_provide_training_metric",
+    "ndcg_eval_at": "eval_at",
+    "ndcg_at": "eval_at",
+    "map_eval_at": "eval_at",
+    "map_at": "eval_at",
+    # network
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port",
+    "port": "local_listen_port",
+    "machine_list_file": "machine_list_filename",
+    "machine_list": "machine_list_filename",
+    "mlist": "machine_list_filename",
+    "workers": "machines",
+    "nodes": "machines",
+}
+
+_OBJECTIVE_ALIASES: Dict[str, str] = {
+    "regression": "regression",
+    "regression_l2": "regression",
+    "l2": "regression",
+    "mean_squared_error": "regression",
+    "mse": "regression",
+    "l2_root": "regression",
+    "root_mean_squared_error": "regression",
+    "rmse": "regression",
+    "regression_l1": "regression_l1",
+    "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1",
+    "mae": "regression_l1",
+    "mean_absolute_percentage_error": "mape",
+    "mape": "mape",
+    "huber": "huber",
+    "fair": "fair",
+    "poisson": "poisson",
+    "quantile": "quantile",
+    "gamma": "gamma",
+    "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass",
+    "softmax": "multiclass",
+    "multiclassova": "multiclassova",
+    "multiclass_ova": "multiclassova",
+    "ova": "multiclassova",
+    "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy",
+    "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda",
+    "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank",
+    "rank_xendcg": "rank_xendcg",
+    "xendcg": "rank_xendcg",
+    "xe_ndcg": "rank_xendcg",
+    "xe_ndcg_mart": "rank_xendcg",
+    "xendcg_mart": "rank_xendcg",
+    "none": "none",
+    "null": "none",
+    "custom": "none",
+    "na": "none",
+}
+
+
+def _to_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return bool(v)
+    s = str(v).strip().lower()
+    if s in ("true", "1", "yes", "+"):
+        return True
+    if s in ("false", "0", "no", "-"):
+        return False
+    raise ValueError(f"cannot parse boolean from {v!r}")
+
+
+def _to_int_list(v: Any) -> List[int]:
+    if v is None or v == "":
+        return []
+    if isinstance(v, (list, tuple)):
+        return [int(x) for x in v]
+    return [int(x) for x in str(v).split(",") if x != ""]
+
+
+def _to_float_list(v: Any) -> List[float]:
+    if v is None or v == "":
+        return []
+    if isinstance(v, (list, tuple)):
+        return [float(x) for x in v]
+    return [float(x) for x in str(v).split(",") if x != ""]
+
+
+def _to_str_list(v: Any) -> List[str]:
+    if v is None or v == "":
+        return []
+    if isinstance(v, (list, tuple)):
+        return [str(x) for x in v]
+    return [s for s in str(v).split(",") if s != ""]
+
+
+@dataclasses.dataclass
+class Config:
+    """Typed view of a LightGBM-style parameter dict.
+
+    Field names and defaults follow the reference's documented parameters
+    (include/LightGBM/config.h); only fields the TPU build consumes (or will
+    consume) are materialized.
+    """
+
+    # Core
+    task: str = "train"
+    objective: str = "regression"
+    boosting: str = "gbdt"
+    data: str = ""
+    valid: List[str] = dataclasses.field(default_factory=list)
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    tree_learner: str = "serial"
+    num_threads: int = 0
+    device_type: str = "tpu"
+    seed: Optional[int] = None
+    deterministic: bool = False
+
+    # Learning control
+    force_col_wise: bool = False
+    force_row_wise: bool = False
+    histogram_pool_size: float = -1.0
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    bagging_fraction: float = 1.0
+    pos_bagging_fraction: float = 1.0
+    neg_bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    feature_fraction: float = 1.0
+    feature_fraction_bynode: float = 1.0
+    feature_fraction_seed: int = 2
+    extra_trees: bool = False
+    extra_seed: int = 6
+    early_stopping_round: int = 0
+    first_metric_only: bool = False
+    max_delta_step: float = 0.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    linear_lambda: float = 0.0
+    min_gain_to_split: float = 0.0
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    min_data_per_group: int = 100
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    top_k: int = 20
+    monotone_constraints: List[int] = dataclasses.field(default_factory=list)
+    monotone_constraints_method: str = "basic"
+    monotone_penalty: float = 0.0
+    feature_contri: List[float] = dataclasses.field(default_factory=list)
+    forcedsplits_filename: str = ""
+    refit_decay_rate: float = 0.9
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    cegb_penalty_feature_lazy: List[float] = dataclasses.field(default_factory=list)
+    cegb_penalty_feature_coupled: List[float] = dataclasses.field(default_factory=list)
+    path_smooth: float = 0.0
+    interaction_constraints: Any = ""
+    verbosity: int = 1
+    use_quantized_grad: bool = False
+    num_grad_quant_bins: int = 4
+    quant_train_renew_leaf: bool = False
+    stochastic_rounding: bool = True
+
+    # Dataset
+    linear_tree: bool = False
+    max_bin: int = 255
+    max_bin_by_feature: List[int] = dataclasses.field(default_factory=list)
+    min_data_in_bin: int = 3
+    bin_construct_sample_cnt: int = 200000
+    data_random_seed: int = 1
+    is_enable_sparse: bool = True
+    enable_bundle: bool = True
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    feature_pre_filter: bool = True
+    pre_partition: bool = False
+    two_round: bool = False
+    header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_feature: Any = ""
+    forcedbins_filename: str = ""
+    save_binary: bool = False
+    precise_float_parser: bool = False
+
+    # Predict
+    start_iteration_predict: int = 0
+    num_iteration_predict: int = -1
+    predict_raw_score: bool = False
+    predict_leaf_index: bool = False
+    predict_contrib: bool = False
+    predict_disable_shape_check: bool = False
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+
+    # Objective
+    objective_seed: int = 5
+    num_class: int = 1
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+    sigmoid: float = 1.0
+    boost_from_average: bool = True
+    reg_sqrt: bool = False
+    alpha: float = 0.9
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
+    lambdarank_truncation_level: int = 30
+    lambdarank_norm: bool = True
+    label_gain: List[float] = dataclasses.field(default_factory=list)
+    lambdarank_position_bias_regularization: float = 0.0
+
+    # Metric
+    metric: List[str] = dataclasses.field(default_factory=list)
+    metric_freq: int = 1
+    is_provide_training_metric: bool = False
+    eval_at: List[int] = dataclasses.field(default_factory=lambda: [1, 2, 3, 4, 5])
+    multi_error_top_k: int = 1
+    auc_mu_weights: List[float] = dataclasses.field(default_factory=list)
+
+    # Network
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_filename: str = ""
+    machines: str = ""
+
+    # Raw (post-alias) params as given by the user.
+    raw: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @classmethod
+    def from_params(cls, params: Optional[Dict[str, Any]]) -> "Config":
+        params = dict(params or {})
+        cfg = cls()
+        resolved: Dict[str, Any] = {}
+        # first-value-wins among aliases, canonical name wins over aliases
+        # (matches reference Config::KeepFirstValues semantics closely enough:
+        # the reference warns and keeps the first-seen; canonical-first is the
+        # common convention in the python package).
+        for key, value in params.items():
+            canon = _PARAM_ALIASES.get(key, key)
+            if canon in resolved and canon != key:
+                continue
+            resolved[canon] = value
+        cfg.raw = dict(resolved)
+        for f in dataclasses.fields(cls):
+            if f.name == "raw" or f.name not in resolved:
+                continue
+            v = resolved[f.name]
+            try:
+                if f.type in ("bool", bool):
+                    setattr(cfg, f.name, _to_bool(v))
+                elif f.type in ("int", int):
+                    setattr(cfg, f.name, int(float(v)))
+                elif f.type in ("float", float):
+                    setattr(cfg, f.name, float(v))
+                elif f.name in ("metric", "valid"):
+                    setattr(cfg, f.name, _to_str_list(v))
+                elif f.name in ("monotone_constraints", "eval_at", "max_bin_by_feature"):
+                    setattr(cfg, f.name, _to_int_list(v))
+                elif f.name in (
+                    "label_gain",
+                    "feature_contri",
+                    "cegb_penalty_feature_lazy",
+                    "cegb_penalty_feature_coupled",
+                    "auc_mu_weights",
+                ):
+                    setattr(cfg, f.name, _to_float_list(v))
+                elif f.name == "seed":
+                    setattr(cfg, f.name, int(float(v)))
+                else:
+                    setattr(cfg, f.name, v)
+            except (TypeError, ValueError) as exc:
+                raise ValueError(f"bad value for parameter {f.name!r}: {v!r}") from exc
+        cfg.objective = _OBJECTIVE_ALIASES.get(str(cfg.objective), str(cfg.objective))
+        if str(params.get("objective", "")).lower() in ("l2_root", "root_mean_squared_error", "rmse"):
+            cfg.reg_sqrt = True
+        cfg._apply_seed()
+        cfg._check_conflicts()
+        return cfg
+
+    def _apply_seed(self) -> None:
+        # reference: Config seed re-derives sub-seeds deterministically
+        if self.seed is not None:
+            base = int(self.seed)
+            if "bagging_seed" not in self.raw:
+                self.bagging_seed = base + 3
+            if "feature_fraction_seed" not in self.raw:
+                self.feature_fraction_seed = base + 2
+            if "drop_seed" not in self.raw:
+                self.drop_seed = base + 4
+            if "data_random_seed" not in self.raw:
+                self.data_random_seed = base + 1
+            if "extra_seed" not in self.raw:
+                self.extra_seed = base + 6
+            if "objective_seed" not in self.raw:
+                self.objective_seed = base + 5
+
+    def _check_conflicts(self) -> None:
+        # reference: Config::CheckParamConflict (src/io/config.cpp:346)
+        if self.num_machines <= 1 and self.tree_learner in ("feature", "data", "voting"):
+            # single machine: parallel learners degrade to sharded-on-one-mesh;
+            # keep the learner (on TPU "data" means mesh-sharded, still valid
+            # with a 1..N device mesh), so no forced downgrade here.
+            pass
+        if self.is_unbalance and self.scale_pos_weight != 1.0:
+            raise ValueError("cannot set both is_unbalance and scale_pos_weight")
+        if self.objective in ("multiclass", "multiclassova") and self.num_class < 2:
+            raise ValueError(f"objective {self.objective} requires num_class >= 2")
+        if self.num_leaves < 2:
+            raise ValueError("num_leaves must be >= 2")
+        if self.max_bin < 2:
+            raise ValueError("max_bin must be >= 2")
+        if self.bagging_freq > 0 and (self.pos_bagging_fraction < 1.0 or self.neg_bagging_fraction < 1.0):
+            if self.objective != "binary":
+                raise ValueError("pos/neg bagging fractions require binary objective")
+
+    @property
+    def num_tree_per_iteration(self) -> int:
+        if self.objective in ("multiclass", "multiclassova"):
+            return self.num_class
+        return 1
+
+    def default_metric(self) -> List[str]:
+        obj = self.objective
+        table = {
+            "regression": ["l2"],
+            "regression_l1": ["l1"],
+            "huber": ["huber"],
+            "fair": ["fair"],
+            "poisson": ["poisson"],
+            "quantile": ["quantile"],
+            "mape": ["mape"],
+            "gamma": ["gamma"],
+            "tweedie": ["tweedie"],
+            "binary": ["binary_logloss"],
+            "multiclass": ["multi_logloss"],
+            "multiclassova": ["multi_logloss"],
+            "cross_entropy": ["cross_entropy"],
+            "cross_entropy_lambda": ["cross_entropy_lambda"],
+            "lambdarank": ["ndcg"],
+            "rank_xendcg": ["ndcg"],
+        }
+        return table.get(obj, [])
+
+
+def canonical_objective(name: str) -> str:
+    return _OBJECTIVE_ALIASES.get(name, name)

@@ -1,0 +1,417 @@
+"""Dataset: binned training data + metadata, resident on device.
+
+Reference analogs: ``Dataset``/``Metadata`` (include/LightGBM/dataset.h:487,
+:48, src/io/dataset.cpp), ``DatasetLoader`` (src/io/dataset_loader.cpp).
+
+TPU-first design: instead of per-feature Bin column objects with col-wise /
+row-wise layout heuristics (reference dataset.cpp:619 GetShareStates), the
+whole dataset is ONE dense ``[num_rows, num_used_features]`` uint8/uint16
+device array of bin indices.  Binning happens host-side in NumPy at
+construction from a row sample (reference bin_construct_sample_cnt), then the
+binned matrix is pushed to HBM once.  EFB/feature-bundling is unnecessary in
+this layout (a dense uint8 matrix is already the bundled form).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .binning import BinMapper
+from .config import Config
+
+try:  # pandas is optional
+    import pandas as pd  # type: ignore
+except Exception:  # pragma: no cover
+    pd = None
+
+
+def _is_1d(a: np.ndarray) -> np.ndarray:
+    a = np.asarray(a)
+    if a.ndim == 2 and a.shape[1] == 1:
+        a = a.ravel()
+    if a.ndim != 1:
+        raise ValueError(f"expected 1-D array, got shape {a.shape}")
+    return a
+
+
+@dataclasses.dataclass
+class Metadata:
+    """Per-row metadata (reference: include/LightGBM/dataset.h:48)."""
+
+    label: np.ndarray
+    weight: Optional[np.ndarray] = None
+    init_score: Optional[np.ndarray] = None
+    query_boundaries: Optional[np.ndarray] = None  # [num_queries+1] int32
+    position: Optional[np.ndarray] = None
+
+    @property
+    def num_data(self) -> int:
+        return len(self.label)
+
+    def set_query(self, group_sizes: np.ndarray) -> None:
+        group_sizes = _is_1d(group_sizes).astype(np.int64)
+        boundaries = np.zeros(len(group_sizes) + 1, dtype=np.int32)
+        np.cumsum(group_sizes, out=boundaries[1:])
+        if boundaries[-1] != self.num_data:
+            raise ValueError(
+                f"sum of query sizes ({boundaries[-1]}) != num_data ({self.num_data})"
+            )
+        self.query_boundaries = boundaries
+
+
+def _load_text_file(path: str, config: Config) -> Dict[str, Any]:
+    """Parse a CSV/TSV/LibSVM-style training file (reference src/io/parser.cpp).
+
+    Only dense CSV/TSV with an optional header is supported for now; label
+    column defaults to 0 as in the reference CLI examples.
+    """
+    p = Path(path)
+    text = p.read_text()
+    first = text.splitlines()[0] if text else ""
+    delim = "\t" if "\t" in first else ("," if "," in first else None)
+    skip = 1 if config.header else 0
+    arr = np.loadtxt(path, delimiter=delim, skiprows=skip, dtype=np.float64, ndmin=2)
+    label_col = 0
+    if config.label_column not in ("", None):
+        lc = str(config.label_column)
+        label_col = int(lc.split("=")[-1]) if "=" in lc else int(lc)
+    label = arr[:, label_col]
+    feats = np.delete(arr, label_col, axis=1)
+    out: Dict[str, Any] = {"data": feats, "label": label}
+    qpath = Path(str(path) + ".query")
+    if qpath.exists():
+        out["group"] = np.loadtxt(qpath, dtype=np.int64, ndmin=1)
+    wpath = Path(str(path) + ".weight")
+    if wpath.exists():
+        out["weight"] = np.loadtxt(wpath, dtype=np.float64, ndmin=1)
+    ipath = Path(str(path) + ".init")
+    if ipath.exists():
+        out["init_score"] = np.loadtxt(ipath, dtype=np.float64, ndmin=1)
+    return out
+
+
+class Dataset:
+    """Binned dataset (reference: Dataset, include/LightGBM/dataset.h:487).
+
+    Lazily constructed like the python-package Dataset (basic.py:1744): raw
+    data is held until ``construct()`` bins it (or bins are inherited from a
+    reference dataset for validation sets).
+    """
+
+    def __init__(
+        self,
+        data: Union[np.ndarray, str, "pd.DataFrame", None],
+        label: Optional[np.ndarray] = None,
+        *,
+        reference: Optional["Dataset"] = None,
+        weight: Optional[np.ndarray] = None,
+        group: Optional[np.ndarray] = None,
+        init_score: Optional[np.ndarray] = None,
+        feature_name: Union[str, Sequence[str]] = "auto",
+        categorical_feature: Union[str, Sequence] = "auto",
+        params: Optional[Dict[str, Any]] = None,
+        free_raw_data: bool = True,
+    ) -> None:
+        self.params: Dict[str, Any] = dict(params or {})
+        self.config = Config.from_params(self.params)
+        self._raw_data = data
+        self._label = label
+        self._weight = weight
+        self._group = group
+        self._init_score = init_score
+        self._feature_name = feature_name
+        self._categorical_feature = categorical_feature
+        self.reference = reference
+        self.free_raw_data = free_raw_data
+
+        # filled by construct()
+        self._constructed = False
+        self.bin_mappers: List[BinMapper] = []
+        self.used_features: List[int] = []  # original feature idx per used column
+        self.bins: Optional[np.ndarray] = None  # [N, num_used] uint8/uint16
+        self.raw: Optional[np.ndarray] = None  # raw values (for linear trees / predict checks)
+        self.metadata: Optional[Metadata] = None
+        self.feature_names: List[str] = []
+        self.num_total_features: int = 0
+        self._device_cache: Dict[str, Any] = {}
+
+    # ----------------------------------------------------------- properties
+    @property
+    def num_data(self) -> int:
+        self.construct()
+        return int(self.bins.shape[0])
+
+    @property
+    def num_feature(self) -> int:
+        """Number of original (pre-pruning) features, like the reference."""
+        self.construct()
+        return self.num_total_features
+
+    @property
+    def num_used_feature(self) -> int:
+        self.construct()
+        return int(self.bins.shape[1])
+
+    def num_bins_per_feature(self) -> np.ndarray:
+        self.construct()
+        return np.array([self.bin_mappers[i].num_bins for i in self.used_features], dtype=np.int32)
+
+    # ------------------------------------------------------------ construct
+    def construct(self) -> "Dataset":
+        if self._constructed:
+            return self
+        data = self._raw_data
+        label = self._label
+        if isinstance(data, (str, Path)):
+            loaded = _load_text_file(str(data), self.config)
+            data = loaded["data"]
+            if label is None:
+                label = loaded.get("label")
+            if self._group is None:
+                self._group = loaded.get("group")
+            if self._weight is None:
+                self._weight = loaded.get("weight")
+            if self._init_score is None:
+                self._init_score = loaded.get("init_score")
+        if pd is not None and isinstance(data, pd.DataFrame):
+            if self._feature_name == "auto":
+                self._feature_name = [str(c) for c in data.columns]
+            if self._categorical_feature == "auto":
+                cats = [
+                    str(c)
+                    for c in data.columns
+                    if str(data[c].dtype) in ("category", "object")
+                ]
+                self._categorical_feature = cats
+            data = data.to_numpy(dtype=np.float64, na_value=np.nan)
+        if data is None:
+            raise ValueError("Dataset has no data")
+        data = np.asarray(data, dtype=np.float64)
+        if data.ndim != 2:
+            raise ValueError(f"data must be 2-D, got shape {data.shape}")
+        n, num_features = data.shape
+        self.num_total_features = num_features
+
+        if label is None:
+            raise ValueError("label is required to construct a Dataset")
+        label = _is_1d(np.asarray(label, dtype=np.float64))
+        if len(label) != n:
+            raise ValueError(f"label length {len(label)} != num rows {n}")
+
+        if isinstance(self._feature_name, str):
+            self.feature_names = [f"Column_{i}" for i in range(num_features)]
+        else:
+            self.feature_names = [str(s) for s in self._feature_name]
+
+        cat_idx = self._resolve_categorical(num_features)
+
+        if self.reference is not None:
+            ref = self.reference.construct()
+            self.bin_mappers = ref.bin_mappers
+            self.used_features = ref.used_features
+            self.feature_names = ref.feature_names
+            self.num_total_features = ref.num_total_features
+        else:
+            self._build_bin_mappers(data, cat_idx)
+
+        cols = []
+        for j in self.used_features:
+            cols.append(self.bin_mappers[j].values_to_bins(data[:, j]))
+        if cols:
+            binned = np.stack(cols, axis=1)
+        else:
+            binned = np.zeros((n, 0), dtype=np.int32)
+        max_bins = max((m.num_bins for m in self.bin_mappers), default=1)
+        dtype = np.uint8 if max_bins <= 256 else np.uint16
+        self.bins = binned.astype(dtype)
+        self.raw = data if (self.config.linear_tree or not self.free_raw_data) else None
+
+        weight = self._weight
+        if weight is not None:
+            weight = _is_1d(np.asarray(weight, dtype=np.float64))
+        init_score = self._init_score
+        if init_score is not None:
+            init_score = np.asarray(init_score, dtype=np.float64)
+        self.metadata = Metadata(label=label, weight=weight, init_score=init_score)
+        if self._group is not None:
+            self.metadata.set_query(np.asarray(self._group))
+
+        self._constructed = True
+        if self.free_raw_data and not self.config.linear_tree:
+            self._raw_data = None
+        return self
+
+    def _resolve_categorical(self, num_features: int) -> List[int]:
+        cf = self._categorical_feature
+        if cf == "auto" or cf is None or cf == "":
+            cfg_cf = self.config.categorical_feature
+            cf = cfg_cf if cfg_cf not in ("", "auto", None) else []
+        if isinstance(cf, str):
+            cf = [c for c in cf.split(",") if c != ""]
+        out: List[int] = []
+        for c in cf:
+            if isinstance(c, (int, np.integer)):
+                out.append(int(c))
+            elif str(c) in self.feature_names:
+                out.append(self.feature_names.index(str(c)))
+            else:
+                out.append(int(str(c).replace("name:", "")) if str(c).isdigit() else -1)
+        return [c for c in out if 0 <= c < num_features]
+
+    def _build_bin_mappers(self, data: np.ndarray, cat_idx: List[int]) -> None:
+        cfg = self.config
+        n = data.shape[0]
+        sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+        if sample_cnt < n:
+            rng = np.random.default_rng(cfg.data_random_seed)
+            sample_rows = rng.choice(n, size=sample_cnt, replace=False)
+            sample = data[np.sort(sample_rows)]
+        else:
+            sample = data
+        max_bin_by_feature = cfg.max_bin_by_feature
+        self.bin_mappers = []
+        self.used_features = []
+        for j in range(data.shape[1]):
+            mb = (
+                max_bin_by_feature[j]
+                if j < len(max_bin_by_feature)
+                else cfg.max_bin
+            )
+            mapper = BinMapper.from_sample(
+                sample[:, j],
+                mb,
+                is_categorical=j in cat_idx,
+                min_data_in_bin=cfg.min_data_in_bin,
+                use_missing=cfg.use_missing,
+                zero_as_missing=cfg.zero_as_missing,
+            )
+            self.bin_mappers.append(mapper)
+            if not mapper.is_trivial:
+                self.used_features.append(j)
+
+    # ----------------------------------------------------------- field API
+    def set_label(self, label: np.ndarray) -> "Dataset":
+        if self._constructed:
+            self.metadata.label = _is_1d(np.asarray(label, dtype=np.float64))
+            self._device_cache.clear()
+        else:
+            self._label = label
+        return self
+
+    def set_weight(self, weight: Optional[np.ndarray]) -> "Dataset":
+        if self._constructed:
+            self.metadata.weight = (
+                None if weight is None else _is_1d(np.asarray(weight, dtype=np.float64))
+            )
+            self._device_cache.clear()
+        else:
+            self._weight = weight
+        return self
+
+    def set_group(self, group: Optional[np.ndarray]) -> "Dataset":
+        if self._constructed:
+            if group is not None:
+                self.metadata.set_query(np.asarray(group))
+        else:
+            self._group = group
+        return self
+
+    def set_init_score(self, init_score: Optional[np.ndarray]) -> "Dataset":
+        if self._constructed:
+            self.metadata.init_score = (
+                None if init_score is None else np.asarray(init_score, dtype=np.float64)
+            )
+        else:
+            self._init_score = init_score
+        return self
+
+    def get_label(self) -> np.ndarray:
+        self.construct()
+        return self.metadata.label
+
+    def get_weight(self) -> Optional[np.ndarray]:
+        self.construct()
+        return self.metadata.weight
+
+    def get_group(self) -> Optional[np.ndarray]:
+        self.construct()
+        qb = self.metadata.query_boundaries
+        return None if qb is None else np.diff(qb)
+
+    def get_init_score(self) -> Optional[np.ndarray]:
+        self.construct()
+        return self.metadata.init_score
+
+    def get_field(self, name: str):
+        getters = {
+            "label": self.get_label,
+            "weight": self.get_weight,
+            "group": self.get_group,
+            "init_score": self.get_init_score,
+        }
+        if name not in getters:
+            raise KeyError(name)
+        return getters[name]()
+
+    def set_field(self, name: str, value) -> "Dataset":
+        setters = {
+            "label": self.set_label,
+            "weight": self.set_weight,
+            "group": self.set_group,
+            "init_score": self.set_init_score,
+        }
+        if name not in setters:
+            raise KeyError(name)
+        return setters[name](value)
+
+    def create_valid(
+        self,
+        data,
+        label=None,
+        weight=None,
+        group=None,
+        init_score=None,
+        params=None,
+    ) -> "Dataset":
+        return Dataset(
+            data,
+            label,
+            reference=self,
+            weight=weight,
+            group=group,
+            init_score=init_score,
+            params=params if params is not None else self.params,
+        )
+
+    # -------------------------------------------------------------- device
+    def device_bins(self):
+        """The binned matrix as a device array (cached)."""
+        import jax.numpy as jnp
+
+        self.construct()
+        if "bins" not in self._device_cache:
+            self._device_cache["bins"] = jnp.asarray(self.bins, dtype=jnp.int32)
+        return self._device_cache["bins"]
+
+    def device_label(self):
+        import jax.numpy as jnp
+
+        self.construct()
+        if "label" not in self._device_cache:
+            self._device_cache["label"] = jnp.asarray(self.metadata.label, dtype=jnp.float32)
+        return self._device_cache["label"]
+
+    def device_weight(self):
+        import jax.numpy as jnp
+
+        self.construct()
+        if "weight" not in self._device_cache:
+            w = self.metadata.weight
+            self._device_cache["weight"] = (
+                None if w is None else jnp.asarray(w, dtype=jnp.float32)
+            )
+        return self._device_cache["weight"]

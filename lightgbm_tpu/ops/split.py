@@ -1,0 +1,139 @@
+"""Best-split search over a leaf histogram.
+
+Reference analogs: ``FeatureHistogram::FindBestThresholdSequentially``
+(src/treelearner/feature_histogram.hpp:832 — per-feature sequential scan with
+missing-direction handling) and the CUDA per-(leaf,feature) scan kernel
+(src/treelearner/cuda/cuda_best_split_finder.cu:776).
+
+TPU-native formulation: one vectorized cumulative-sum over the bin axis for
+ALL features at once, gains evaluated for every (feature, bin, missing-dir)
+candidate simultaneously, then a single argmax.  The reference's two-direction
+scan for missing values becomes two gain tensors (NaN bin counted left vs
+right).  Gain math (L1 thresholding, L2, max_delta_step, min_data/min_hess
+gates) follows feature_histogram.hpp:711-828.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+_EPS = 1e-15
+
+
+def threshold_l1(g: jnp.ndarray, l1: float) -> jnp.ndarray:
+    return jnp.sign(g) * jnp.maximum(jnp.abs(g) - l1, 0.0)
+
+
+def leaf_gain(g, h, l1: float, l2: float):
+    t = threshold_l1(g, l1)
+    return (t * t) / (h + l2 + _EPS)
+
+
+def leaf_output(g, h, l1: float, l2: float, max_delta_step: float = 0.0):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:711)."""
+    out = -threshold_l1(g, l1) / (h + l2 + _EPS)
+    if max_delta_step > 0.0:
+        out = jnp.clip(out, -max_delta_step, max_delta_step)
+    return out
+
+
+class SplitCandidate(NamedTuple):
+    """Best split for one leaf (reference: SplitInfo, split_info.hpp:22)."""
+
+    gain: jnp.ndarray  # improvement over parent minus min_gain; <=0 means no split
+    feature: jnp.ndarray  # used-feature index (int32)
+    bin: jnp.ndarray  # threshold bin: bin <= threshold goes left
+    default_left: jnp.ndarray  # bool: missing goes left
+    left_g: jnp.ndarray
+    left_h: jnp.ndarray
+    left_cnt: jnp.ndarray
+    right_g: jnp.ndarray
+    right_h: jnp.ndarray
+    right_cnt: jnp.ndarray
+
+
+def best_split(
+    hist: jnp.ndarray,  # [F, B, 3] (sum_grad, sum_hess, count)
+    parent_g: jnp.ndarray,
+    parent_h: jnp.ndarray,
+    parent_cnt: jnp.ndarray,
+    num_bins: jnp.ndarray,  # [F] total bins per feature (incl. NaN bin)
+    nan_bins: jnp.ndarray,  # [F] NaN-bin index per feature, -1 if none
+    feature_mask: jnp.ndarray,  # [F] bool — col-sampled features
+    *,
+    lambda_l1: float,
+    lambda_l2: float,
+    min_data_in_leaf: int,
+    min_sum_hessian_in_leaf: float,
+    min_gain_to_split: float,
+    max_delta_step: float = 0.0,
+) -> SplitCandidate:
+    f, b, _ = hist.shape
+
+    has_nan = nan_bins >= 0
+    nan_idx = jnp.where(has_nan, nan_bins, 0)
+    nan_stats = jnp.take_along_axis(hist, nan_idx[:, None, None], axis=1)[:, 0, :]
+    nan_stats = nan_stats * has_nan[:, None]  # [F, 3]
+
+    # zero out the NaN bin so the cumsum covers only ordered numeric bins
+    bin_ids = jnp.arange(b, dtype=jnp.int32)[None, :]
+    is_nan_bin = has_nan[:, None] & (bin_ids == nan_bins[:, None])
+    hist_o = jnp.where(is_nan_bin[:, :, None], 0.0, hist)
+
+    cum = jnp.cumsum(hist_o, axis=1)  # [F, B, 3] left stats (missing right)
+    parent = jnp.stack(
+        [parent_g.astype(jnp.float32), parent_h.astype(jnp.float32), parent_cnt.astype(jnp.float32)]
+    )
+
+    # candidate threshold at bin t is valid for t in [0, num_ordered_bins-2]
+    num_ordered = num_bins - has_nan.astype(jnp.int32)
+    valid_bin = bin_ids < (num_ordered[:, None] - 1)
+
+    def eval_case(left):  # left: [F, B, 3]
+        lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
+        rg, rh, rc = parent[0] - lg, parent[1] - lh, parent[2] - lc
+        ok = (
+            valid_bin
+            & (lc >= min_data_in_leaf)
+            & (rc >= min_data_in_leaf)
+            & (lh >= min_sum_hessian_in_leaf)
+            & (rh >= min_sum_hessian_in_leaf)
+            & feature_mask[:, None]
+        )
+        gain = leaf_gain(lg, lh, lambda_l1, lambda_l2) + leaf_gain(
+            rg, rh, lambda_l1, lambda_l2
+        )
+        return jnp.where(ok, gain, -jnp.inf)
+
+    gain_right = eval_case(cum)  # missing -> right (default_left = False)
+    gain_left = jnp.where(
+        has_nan[:, None], eval_case(cum + nan_stats[:, None, :]), -jnp.inf
+    )  # missing -> left; only distinct when a NaN bin exists
+
+    gains = jnp.stack([gain_right, gain_left])  # [2, F, B]
+    flat = jnp.argmax(gains)
+    dl = (flat // (f * b)).astype(jnp.int32)
+    rem = flat % (f * b)
+    feat = (rem // b).astype(jnp.int32)
+    tbin = (rem % b).astype(jnp.int32)
+    best_gain_raw = gains.reshape(-1)[flat]
+
+    left = cum[feat, tbin] + jnp.where(dl == 1, nan_stats[feat], 0.0)
+    parent_gain = leaf_gain(parent[0], parent[1], lambda_l1, lambda_l2)
+    improvement = best_gain_raw - parent_gain - min_gain_to_split
+    improvement = jnp.where(jnp.isfinite(best_gain_raw), improvement, -jnp.inf)
+
+    return SplitCandidate(
+        gain=improvement.astype(jnp.float32),
+        feature=feat,
+        bin=tbin,
+        default_left=dl == 1,
+        left_g=left[0],
+        left_h=left[1],
+        left_cnt=left[2],
+        right_g=parent[0] - left[0],
+        right_h=parent[1] - left[1],
+        right_cnt=parent[2] - left[2],
+    )

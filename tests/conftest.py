@@ -1,0 +1,13 @@
+"""Test config: run on a virtual 8-device CPU mesh.
+
+Multi-chip hardware isn't available in CI; sharding tests run over
+``--xla_force_host_platform_device_count=8`` as the reference's distributed
+tests run N CLI processes on localhost (tests/distributed/_test_distributed.py).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

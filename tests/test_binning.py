@@ -1,0 +1,114 @@
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import BinMapper, MissingType, K_ZERO_THRESHOLD
+
+
+def test_simple_uniform_binning():
+    rng = np.random.default_rng(0)
+    vals = rng.uniform(1.0, 2.0, size=10000)
+    m = BinMapper.from_sample(vals, max_bin=64)
+    assert not m.is_trivial
+    assert m.missing_type == MissingType.NONE
+    bins = m.values_to_bins(vals)
+    assert bins.min() >= 0
+    assert bins.max() < m.num_bins
+    # roughly equal counts
+    counts = np.bincount(bins, minlength=m.num_bins)
+    nonzero = counts[counts > 0]
+    assert len(nonzero) >= 32
+    # monotonicity: larger value -> larger-or-equal bin
+    order = np.argsort(vals)
+    assert (np.diff(bins[order]) >= 0).all()
+
+
+def test_bin_boundaries_separate_values():
+    vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0] * 10)
+    m = BinMapper.from_sample(vals, max_bin=32, min_data_in_bin=1)
+    bins = m.values_to_bins(np.array([1.0, 2.0, 3.0, 4.0, 5.0]))
+    # distinct values get distinct bins when budget allows
+    assert len(set(bins.tolist())) == 5
+
+
+def test_zero_gets_own_bin():
+    vals = np.concatenate([np.zeros(50), np.linspace(-1, 1, 50)])
+    m = BinMapper.from_sample(vals, max_bin=16, min_data_in_bin=1)
+    zero_bin = m.values_to_bins(np.array([0.0]))[0]
+    neg_bin = m.values_to_bins(np.array([-0.5]))[0]
+    pos_bin = m.values_to_bins(np.array([0.5]))[0]
+    assert neg_bin < zero_bin < pos_bin
+
+
+def test_nan_bin():
+    vals = np.array([1.0, 2.0, 3.0, np.nan, np.nan, 4.0] * 5)
+    m = BinMapper.from_sample(vals, max_bin=16, min_data_in_bin=1)
+    assert m.missing_type == MissingType.NAN
+    assert m.nan_bin == m.num_bins - 1
+    bins = m.values_to_bins(np.array([np.nan, 1.0]))
+    assert bins[0] == m.nan_bin
+    assert bins[1] != m.nan_bin
+
+
+def test_no_nan_no_missing_bin():
+    vals = np.linspace(0, 1, 100)
+    m = BinMapper.from_sample(vals, max_bin=8)
+    assert m.missing_type == MissingType.NONE
+    assert m.nan_bin == -1
+
+
+def test_zero_as_missing():
+    vals = np.concatenate([np.zeros(50), np.linspace(1, 2, 50)])
+    m = BinMapper.from_sample(vals, max_bin=8, zero_as_missing=True)
+    assert m.missing_type == MissingType.ZERO
+    b = m.values_to_bins(np.array([0.0, np.nan, 1.5]))
+    assert b[0] == m.nan_bin
+    assert b[1] == m.nan_bin
+    assert b[2] != m.nan_bin
+
+
+def test_trivial_feature():
+    vals = np.full(100, 7.0)
+    m = BinMapper.from_sample(vals, max_bin=8)
+    assert m.is_trivial
+
+
+def test_categorical_binning_by_frequency():
+    vals = np.array([0] * 50 + [1] * 30 + [2] * 20, dtype=np.float64)
+    m = BinMapper.from_sample(vals, max_bin=8, is_categorical=True)
+    assert m.is_categorical
+    bins = m.values_to_bins(np.array([0.0, 1.0, 2.0]))
+    # most frequent category -> bin 0
+    assert bins[0] == 0
+    assert bins[1] == 1
+    assert bins[2] == 2
+    # unseen category maps to bin 0
+    assert m.values_to_bins(np.array([99.0]))[0] == 0
+
+
+def test_categorical_max_bin_cut():
+    rng = np.random.default_rng(1)
+    vals = rng.integers(0, 100, size=5000).astype(np.float64)
+    m = BinMapper.from_sample(vals, max_bin=16, is_categorical=True)
+    assert m.num_bins <= 16
+
+
+def test_threshold_real_value_roundtrip():
+    rng = np.random.default_rng(2)
+    vals = rng.normal(size=1000)
+    m = BinMapper.from_sample(vals, max_bin=32)
+    bins = m.values_to_bins(vals)
+    for b in range(m.num_bins - 1):
+        thr = m.bin_to_threshold(b)
+        left = vals[bins <= b]
+        right = vals[(bins > b) & (bins < m.num_bins)]
+        if len(left) and len(right):
+            assert left.max() <= thr <= right.min()
+
+
+def test_max_bin_respected():
+    rng = np.random.default_rng(3)
+    vals = rng.normal(size=100000)
+    for mb in (4, 16, 63, 255):
+        m = BinMapper.from_sample(vals, max_bin=mb)
+        assert m.num_bins <= mb + 1  # +1 for potential nan bin
+        assert m.values_to_bins(vals).max() < m.num_bins
