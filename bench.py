@@ -1,0 +1,76 @@
+"""Benchmark: boosting iterations/sec on a Higgs-like workload, single chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference CPU trains Higgs-10.5M x 28 at ~3.8 iters/sec
+(500 iters in 130.094 s, 255 leaves, 16 threads — docs/Experiments.rst:108,
+see BASELINE.md).  This benchmark runs the same shape of work (binary
+objective, 255 leaves, max_bin 255, 28 features) on however many rows fit a
+single chip comfortably, and reports iterations/sec; vs_baseline is the ratio
+against 3.8 iters/s.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    n_features = 28
+    num_leaves = 255
+    warmup_iters = 2
+    timed_iters = int(os.environ.get("BENCH_ITERS", 10))
+
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(n_rows, n_features)).astype(np.float32)
+    w = rng.normal(size=n_features)
+    logits = X @ w * 0.5 + rng.normal(scale=1.0, size=n_rows)
+    y = (logits > 0).astype(np.float64)
+
+    import lightgbm_tpu as lgb
+
+    params = {
+        "objective": "binary",
+        "num_leaves": num_leaves,
+        "max_bin": 255,
+        "learning_rate": 0.1,
+        "min_data_in_leaf": 100,
+        "verbosity": -1,
+        "metric": "none",
+    }
+    dtrain = lgb.Dataset(X, y, params=params)
+    booster = lgb.Booster(params, dtrain)
+
+    for _ in range(warmup_iters):
+        booster.update()
+    import jax
+
+    jax.block_until_ready(booster._score)
+
+    t0 = time.perf_counter()
+    for _ in range(timed_iters):
+        booster.update()
+    jax.block_until_ready(booster._score)
+    dt = time.perf_counter() - t0
+
+    iters_per_sec = timed_iters / dt
+    baseline = 3.8  # reference CPU iters/sec on Higgs (BASELINE.md)
+    print(
+        json.dumps(
+            {
+                "metric": "higgs_like_1m_boosting_iters_per_sec",
+                "value": round(iters_per_sec, 4),
+                "unit": "iters/sec",
+                "vs_baseline": round(iters_per_sec / baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""lightgbm_tpu: a TPU-native gradient-boosted decision tree framework.
+
+A from-scratch reimplementation of the capabilities of LightGBM
+(nick-zocdoc/LightGBM) designed for TPUs: histogram construction, split
+search and partitioning run as jitted JAX/XLA (Pallas kernels for the hot
+ops), distributed training maps the reference's socket/MPI collectives onto
+XLA collectives over a ``jax.sharding.Mesh``.
+
+Public surface mirrors the reference python-package (lightgbm/__init__.py):
+``Dataset``, ``Booster``, ``train``, ``cv``, callbacks, sklearn wrappers.
+"""
+
+from .basic import LGBMDeprecationWarning  # noqa: F401
+from .boosting.gbdt import Booster
+from .callback import (
+    EarlyStopException,
+    early_stopping,
+    log_evaluation,
+    print_evaluation,
+    record_evaluation,
+    reset_parameter,
+)
+from .config import Config
+from .dataset import Dataset
+from .engine import CVBooster, cv, train
+
+try:
+    from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
+except Exception:  # pragma: no cover - sklearn not installed
+    LGBMClassifier = LGBMModel = LGBMRanker = LGBMRegressor = None
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dataset",
+    "Booster",
+    "CVBooster",
+    "train",
+    "cv",
+    "early_stopping",
+    "log_evaluation",
+    "print_evaluation",
+    "record_evaluation",
+    "reset_parameter",
+    "EarlyStopException",
+    "Config",
+    "LGBMModel",
+    "LGBMClassifier",
+    "LGBMRegressor",
+    "LGBMRanker",
+]

@@ -1,0 +1,19 @@
+"""Compatibility shims mirroring python-package/lightgbm/basic.py exports.
+
+The reference's basic.py is the ctypes bridge to the C ABI; here the Booster
+and Dataset are native Python+JAX (no C ABI), so this module only carries the
+auxiliary names users import from ``lightgbm.basic``.
+"""
+
+from __future__ import annotations
+
+from .boosting.gbdt import Booster  # noqa: F401
+from .dataset import Dataset  # noqa: F401
+
+
+class LGBMDeprecationWarning(FutureWarning):
+    """Deprecation warning class used by the package."""
+
+
+class LightGBMError(Exception):
+    """Error thrown by this package (reference: basic.py LightGBMError)."""

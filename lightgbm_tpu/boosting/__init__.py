@@ -1,0 +1,31 @@
+"""Boosting implementations: GBDT, DART, RF + factory.
+
+Reference analog: ``Boosting::CreateBoosting`` (src/boosting/boosting.cpp:37).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..config import Config
+from ..dataset import Dataset
+from .gbdt import Booster
+
+
+def create_booster(params: Optional[Dict[str, Any]], train_set: Dataset) -> Booster:
+    cfg = Config.from_params(params)
+    boosting = cfg.boosting
+    if boosting in ("dart",):
+        from .dart import DARTBooster
+
+        return DARTBooster(params, train_set)
+    if boosting in ("rf", "random_forest"):
+        from .rf import RFBooster
+
+        return RFBooster(params, train_set)
+    if boosting in ("gbdt", "gbrt", "goss"):
+        return Booster(params, train_set)
+    raise ValueError(f"unknown boosting type: {boosting!r}")
+
+
+__all__ = ["Booster", "create_booster"]

@@ -1,0 +1,174 @@
+"""DART boosting (reference: src/boosting/dart.hpp — DroppingTrees :97,
+Normalize :145).
+
+The reference performs a 3-step shrink/add dance per dropped tree so each
+score updater sees the right delta; algebraically the net effect is: rescale
+each dropped tree's output v to v' = v * k/(k+1) (xgboost mode: k/(k+lr)) and
+add (v' - v) to BOTH train and valid scores — which is how it is written here
+(one bin-space walk per dropped tree per score).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..dataset import Dataset
+from ..predict import add_tree_to_score
+from .gbdt import Booster, _EPS
+
+
+class DARTBooster(Booster):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._tree_weight = []  # per-iteration weight (uniform_drop off)
+        self._sum_weight = 0.0
+        self._drop_rng = np.random.default_rng(self.config.drop_seed)
+
+    def _walk_add(self, rec, leaf_delta: np.ndarray, kk: int, include_valid: bool) -> None:
+        """Add a tree's (delta) outputs to train (and optionally valid) scores."""
+        delta = jnp.asarray(leaf_delta, dtype=jnp.float32)
+        if len(rec["split_feature"]) == 0:
+            self._score = self._score.at[kk].add(float(leaf_delta[0]))
+            if include_valid:
+                for entry in self._valid:
+                    entry.score = entry.score.at[kk].add(float(leaf_delta[0]))
+            return
+        args = (
+            jnp.asarray(rec["split_feature"]),
+            jnp.asarray(rec["split_bin"]),
+            jnp.asarray(rec["default_left"]),
+            jnp.asarray(rec["left_child"]),
+            jnp.asarray(rec["right_child"]),
+            delta,
+        )
+        self._score = self._score.at[kk].set(
+            add_tree_to_score(self._score[kk], self._bins, self._nan_bins, *args)
+        )
+        if include_valid:
+            for entry in self._valid:
+                entry.score = entry.score.at[kk].set(
+                    add_tree_to_score(
+                        entry.score[kk],
+                        entry.dataset.device_bins(),
+                        self._nan_bins,
+                        *args,
+                    )
+                )
+
+    def _select_drops(self):
+        cfg = self.config
+        drop_index = []
+        if self._drop_rng.random() >= cfg.skip_drop:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                if self._sum_weight > 0:
+                    inv_avg = len(self._tree_weight) / self._sum_weight
+                    if cfg.max_drop > 0:
+                        drop_rate = min(
+                            drop_rate, cfg.max_drop * inv_avg / self._sum_weight
+                        )
+                    for i in range(self._iter):
+                        if self._drop_rng.random() < drop_rate * self._tree_weight[i] * inv_avg:
+                            drop_index.append(i)
+                            if len(drop_index) >= cfg.max_drop > 0:
+                                break
+            else:
+                if cfg.max_drop > 0 and self._iter > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / self._iter)
+                for i in range(self._iter):
+                    if self._drop_rng.random() < drop_rate:
+                        drop_index.append(i)
+                        if len(drop_index) >= cfg.max_drop > 0:
+                            break
+        return drop_index
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        cfg = self.config
+        k = self.num_tree_per_iteration
+
+        drop_index = self._select_drops()
+        kdrop = len(drop_index)
+        # remove dropped trees from the TRAIN score so gradients see the
+        # reduced ensemble (reference DroppingTrees :97)
+        for i in drop_index:
+            for kk in range(k):
+                idx = i * k + kk
+                self._walk_add(
+                    self._bin_records[idx],
+                    -np.asarray(self.models_[idx].leaf_value, dtype=np.float32),
+                    kk,
+                    include_valid=False,
+                )
+        if not cfg.xgboost_dart_mode:
+            self._shrinkage_rate = cfg.learning_rate / (1.0 + kdrop)
+        else:
+            self._shrinkage_rate = (
+                cfg.learning_rate
+                if kdrop == 0
+                else cfg.learning_rate / (cfg.learning_rate + kdrop)
+            )
+
+        finished = super().update(train_set, fobj)
+        if finished:
+            # restore dropped trees' contributions
+            for i in drop_index:
+                for kk in range(k):
+                    idx = i * k + kk
+                    self._walk_add(
+                        self._bin_records[idx],
+                        np.asarray(self.models_[idx].leaf_value, dtype=np.float32),
+                        kk,
+                        include_valid=False,
+                    )
+            return True
+
+        # Normalize (reference :145): v -> v * factor on dropped trees;
+        # train gets v*factor added back (it has 0 now), valid gets v*(factor-1)
+        if kdrop > 0:
+            factor = (
+                kdrop / (kdrop + 1.0)
+                if not cfg.xgboost_dart_mode
+                else kdrop / (kdrop + cfg.learning_rate)
+            )
+            for i in drop_index:
+                for kk in range(k):
+                    idx = i * k + kk
+                    v = np.asarray(self.models_[idx].leaf_value, dtype=np.float64)
+                    self.models_[idx].apply_shrinkage(factor)
+                    self._bin_records[idx]["leaf_value"] = np.asarray(
+                        self.models_[idx].leaf_value, dtype=np.float32
+                    )
+                    self._walk_add(
+                        self._bin_records[idx], (v * factor).astype(np.float32), kk, False
+                    )
+                    # valid: subtract the lost fraction
+                    delta_valid = (v * (factor - 1.0)).astype(np.float32)
+                    dv = jnp.asarray(delta_valid)
+                    rec = self._bin_records[idx]
+                    for entry in self._valid:
+                        if len(rec["split_feature"]) == 0:
+                            entry.score = entry.score.at[kk].add(float(delta_valid[0]))
+                        else:
+                            entry.score = entry.score.at[kk].set(
+                                add_tree_to_score(
+                                    entry.score[kk],
+                                    entry.dataset.device_bins(),
+                                    self._nan_bins,
+                                    jnp.asarray(rec["split_feature"]),
+                                    jnp.asarray(rec["split_bin"]),
+                                    jnp.asarray(rec["default_left"]),
+                                    jnp.asarray(rec["left_child"]),
+                                    jnp.asarray(rec["right_child"]),
+                                    dv,
+                                )
+                            )
+                if not cfg.uniform_drop:
+                    self._sum_weight -= self._tree_weight[i] * (1.0 - factor)
+                    self._tree_weight[i] *= factor
+        if not cfg.uniform_drop:
+            self._tree_weight.append(self._shrinkage_rate)
+            self._sum_weight += self._shrinkage_rate
+        return False

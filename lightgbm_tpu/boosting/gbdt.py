@@ -1,0 +1,931 @@
+"""GBDT boosting loop and the user-facing Booster.
+
+Reference analogs: ``GBDT`` (src/boosting/gbdt.cpp — Init :59, TrainOneIter
+:352, BoostFromAverage :327, UpdateScore :501, EvalAndCheckEarlyStopping
+:482), model text IO (src/boosting/gbdt_model_text.cpp), the C-API ``Booster``
+wrapper (src/c_api.cpp:166) and the python-package ``Booster``
+(python-package/lightgbm/basic.py:3541) rolled into one class — there is no
+C ABI layer here; the "native" side is XLA.
+
+Per-iteration device work (all jitted, scores stay in HBM):
+  gradients (objectives/) -> per-class grow_tree (ops/grower.py) ->
+  score gather-update; valid scores advance by a bin-space tree walk
+  (predict.add_tree_to_score).  Host work per iteration is O(num_leaves):
+  materializing the tree into the model list (exactly the CUDA learner's
+  host/device split, SURVEY §3.5).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..dataset import Dataset
+from ..metrics import Metric, create_metric
+from ..objectives import ObjectiveFunction, create_objective
+from ..ops.grower import GrowerParams, grow_tree
+from ..predict import (
+    BinTreeBatch,
+    add_tree_to_score,
+    predict_bins_leaves,
+    predict_bins_raw,
+    predict_real_leaves,
+    predict_real_raw,
+    stack_bin_trees,
+    stack_real_trees,
+)
+from ..tree import Tree
+
+_EPS = 1e-15
+_MODEL_VERSION = "v4"
+
+
+def _ceil_pow2(x: int) -> int:
+    return max(1, 1 << (int(x) - 1).bit_length())
+
+
+class _EvalEntry:
+    """Per-dataset eval state: device bins + score, metrics."""
+
+    def __init__(self, name: str, dataset: Dataset, metrics: List[Metric]):
+        self.name = name
+        self.dataset = dataset
+        self.metrics = metrics
+        self.score: Optional[jnp.ndarray] = None  # [K, N]
+
+
+class Booster:
+    """LightGBM-compatible Booster (train + predict + model IO)."""
+
+    def __init__(
+        self,
+        params: Optional[Dict[str, Any]] = None,
+        train_set: Optional[Dataset] = None,
+        model_file: Optional[str] = None,
+        model_str: Optional[str] = None,
+    ) -> None:
+        self.params: Dict[str, Any] = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self.models_: List[Tree] = []
+        self._bin_records: List[Optional[dict]] = []  # bin-space mirror per tree
+        self.train_set: Optional[Dataset] = None
+        self._valid: List[_EvalEntry] = []
+        self._iter = 0
+        self.objective: Optional[ObjectiveFunction] = None
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.max_feature_idx = -1
+        self.label_idx = 0
+        self.num_class = 1
+        self.num_tree_per_iteration = 1
+        self.average_output = False
+        self._loaded_params_str = ""
+        self.config = Config.from_params(self.params)
+        self.pandas_categorical = None
+        self._stack_cache: Dict[Any, BinTreeBatch] = {}
+
+        if model_file is not None:
+            with open(model_file) as f:
+                self._load_model_string(f.read())
+            return
+        if model_str is not None:
+            self._load_model_string(model_str)
+            return
+        if train_set is None:
+            raise ValueError("Booster needs train_set, model_file, or model_str")
+        self._init_train(train_set)
+
+    # ================================================================ training
+    def _init_train(self, train_set: Dataset) -> None:
+        """Reference: GBDT::Init (src/boosting/gbdt.cpp:59)."""
+        train_set.construct()
+        self.train_set = train_set
+        cfg = self.config
+        self.objective = create_objective(cfg)
+        md = train_set.metadata
+        if self.objective is not None:
+            self.objective.init(
+                md.label, md.weight, md.query_boundaries, md.position
+            )
+            self.num_class = self.objective.num_class
+        else:
+            self.num_class = max(1, cfg.num_class)
+        self.num_tree_per_iteration = (
+            self.objective.num_tree_per_iteration if self.objective else self.num_class
+        )
+        self.feature_names = list(train_set.feature_names)
+        self.feature_infos = [m.feature_info_str() for m in train_set.bin_mappers]
+        self.max_feature_idx = train_set.num_total_features - 1
+        self.average_output = cfg.boosting == "rf"
+
+        n = train_set.num_data
+        k = self.num_tree_per_iteration
+        init = np.zeros((k, n), dtype=np.float32)
+        if md.init_score is not None:
+            isc = np.asarray(md.init_score, dtype=np.float32)
+            init += isc.reshape(k, n) if isc.size == k * n else isc.reshape(1, n)
+            self._has_init_score = True
+        else:
+            self._has_init_score = False
+        self._score = jnp.asarray(init)
+
+        # device data
+        self._bins = train_set.device_bins()
+        nb = train_set.num_bins_per_feature()
+        self._num_bins = jnp.asarray(nb, dtype=jnp.int32)
+        nan_bins = np.array(
+            [train_set.bin_mappers[j].nan_bin for j in train_set.used_features],
+            dtype=np.int32,
+        )
+        if len(nan_bins) == 0:
+            nan_bins = np.array([-1], dtype=np.int32)  # pairs with the dummy column
+        self._nan_bins = jnp.asarray(nan_bins)
+        self._max_bin_padded = _ceil_pow2(int(nb.max()) if len(nb) else 2)
+        self._grower_params = GrowerParams(
+            num_leaves=cfg.num_leaves,
+            max_bin=self._max_bin_padded,
+            max_depth=cfg.max_depth,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+            lambda_l1=cfg.lambda_l1,
+            lambda_l2=cfg.lambda_l2,
+            min_gain_to_split=cfg.min_gain_to_split,
+            max_delta_step=cfg.max_delta_step,
+        )
+        self._ones_mask = jnp.ones((n,), jnp.float32)
+        self._full_feature_mask = jnp.ones((self._bins.shape[1],), bool)
+        self._rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
+        self._shrinkage_rate = cfg.learning_rate
+
+        from .sampling import create_sample_strategy
+
+        is_pos = None
+        if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
+            is_pos = jnp.asarray(np.asarray(md.label) > 0)
+        self._sampler = create_sample_strategy(cfg, n, is_pos)
+
+        # metrics for the training set
+        self._train_entry = _EvalEntry(
+            "training", train_set, self._create_metrics()
+        )
+        for m in self._train_entry.metrics:
+            m.init(md.label, md.weight, md.query_boundaries)
+        self._class_need_train = [
+            self.objective.class_need_train(kk) if self.objective else True
+            for kk in range(k)
+        ]
+
+    def _create_metrics(self) -> List[Metric]:
+        cfg = self.config
+        names = cfg.metric if cfg.metric else cfg.default_metric()
+        out = []
+        for name in names:
+            m = create_metric(name, cfg)
+            if m is not None:
+                out.append(m)
+        return out
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct()
+        entry = _EvalEntry(name, data, self._create_metrics())
+        md = data.metadata
+        for m in entry.metrics:
+            m.init(md.label, md.weight, md.query_boundaries)
+        k = self.num_tree_per_iteration
+        nv = data.num_data
+        init = np.zeros((k, nv), dtype=np.float32)
+        if md.init_score is not None:
+            isc = np.asarray(md.init_score, dtype=np.float32)
+            init += isc.reshape(k, nv) if isc.size == k * nv else isc.reshape(1, nv)
+        entry.score = jnp.asarray(init)
+        # replay existing trees onto the valid score
+        vbins = data.device_bins()
+        vraw = None
+        for idx, rec in enumerate(self._bin_records):
+            k_id = idx % k
+            if rec is not None and rec.get("no_bin_form"):
+                if vraw is None:
+                    vraw = self._raw_for_replay(data)
+                entry.score = entry.score.at[k_id].add(
+                    jnp.asarray(
+                        self.models_[idx].predict(vraw), dtype=jnp.float32
+                    )
+                )
+                continue
+            if rec is None or len(rec["split_feature"]) == 0:
+                tree = self.models_[idx]
+                entry.score = entry.score.at[k_id].add(float(tree.leaf_value[0]))
+                continue
+            entry.score = entry.score.at[k_id].set(
+                add_tree_to_score(
+                    entry.score[k_id],
+                    vbins,
+                    self._nan_bins,
+                    jnp.asarray(rec["split_feature"]),
+                    jnp.asarray(rec["split_bin"]),
+                    jnp.asarray(rec["default_left"]),
+                    jnp.asarray(rec["left_child"]),
+                    jnp.asarray(rec["right_child"]),
+                    jnp.asarray(np.asarray(self.models_[idx].leaf_value, dtype=np.float32)),
+                )
+            )
+        self._valid.append(entry)
+        return self
+
+    def _next_rng(self) -> jax.Array:
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration (reference GBDT::TrainOneIter gbdt.cpp:352).
+
+        Returns True when training cannot continue (no positive-gain split),
+        mirroring the reference's is_finished flag.
+        """
+        if train_set is not None and train_set is not self.train_set:
+            self._init_train(train_set)
+        cfg = self.config
+        k = self.num_tree_per_iteration
+        n = self.train_set.num_data
+
+        init_scores = [0.0] * k
+        if fobj is None:
+            if (
+                not self.models_
+                and not self._has_init_score
+                and self.objective is not None
+                and cfg.boost_from_average
+            ):
+                for kk in range(k):
+                    s = self.objective.boost_from_score(kk)
+                    if abs(s) > _EPS:
+                        init_scores[kk] = s
+                        self._score = self._score.at[kk].add(s)
+                        for entry in self._valid:
+                            entry.score = entry.score.at[kk].add(s)
+            grad, hess = self.objective.get_gradients(self._score, self._next_rng())
+        else:
+            g, h = fobj(
+                np.asarray(self._score).reshape(-1)
+                if k > 1
+                else np.asarray(self._score[0]),
+                self.train_set,
+            )
+            grad = jnp.asarray(np.asarray(g, dtype=np.float32).reshape(k, n))
+            hess = jnp.asarray(np.asarray(h, dtype=np.float32).reshape(k, n))
+
+        # bagging / GOSS (reference: SampleStrategy::Bagging gbdt.cpp:384)
+        mask, grad, hess = self._sampler.sample(
+            self._iter, grad, hess, self._next_rng()
+        )
+        feature_mask = self._feature_mask_for_iter()
+
+        should_continue = False
+        for kk in range(k):
+            tree_idx = len(self.models_)
+            if self._class_need_train[kk] and self._bins.shape[1] > 0:
+                ta, leaf_id = grow_tree(
+                    self._bins,
+                    grad[kk],
+                    hess[kk],
+                    mask,
+                    self._num_bins,
+                    self._nan_bins,
+                    feature_mask,
+                    self._grower_params,
+                )
+                n_leaves = int(ta.num_leaves)
+            else:
+                n_leaves = 1
+
+            if n_leaves > 1:
+                should_continue = True
+                leaf_value = ta.leaf_value
+                if self.objective is not None and self.objective.is_renew_tree_output:
+                    lv = self.objective.renew_tree_output(
+                        np.asarray(self._score[kk], dtype=np.float64),
+                        np.asarray(leaf_id),
+                        np.asarray(leaf_value, dtype=np.float64),
+                        np.asarray(mask),
+                    )
+                    leaf_value = jnp.asarray(lv, dtype=jnp.float32)
+                    ta = ta._replace(leaf_value=leaf_value)
+                shrunk = leaf_value * self._shrinkage_rate
+                # train score update: one gather (reference UpdateScore :501)
+                self._score = self._score.at[kk].add(shrunk[leaf_id])
+                # valid score updates: bin-space walk of the new tree
+                for entry in self._valid:
+                    entry.score = entry.score.at[kk].set(
+                        add_tree_to_score(
+                            entry.score[kk],
+                            entry.dataset.device_bins(),
+                            self._nan_bins,
+                            ta.split_feature,
+                            ta.split_bin,
+                            ta.default_left,
+                            ta.left_child,
+                            ta.right_child,
+                            shrunk,
+                        )
+                    )
+                tree = Tree.from_device_arrays(
+                    ta,
+                    self.train_set.bin_mappers,
+                    self.train_set.used_features,
+                )
+                tree.apply_shrinkage(self._shrinkage_rate)
+                if abs(init_scores[kk]) > _EPS:
+                    tree.add_bias(init_scores[kk])
+                nn = n_leaves - 1
+                self._bin_records.append(
+                    {
+                        "split_feature": np.asarray(ta.split_feature)[:nn],
+                        "split_bin": np.asarray(ta.split_bin)[:nn],
+                        "default_left": np.asarray(ta.default_left)[:nn],
+                        "left_child": np.asarray(ta.left_child)[:nn],
+                        "right_child": np.asarray(ta.right_child)[:nn],
+                        "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
+                    }
+                )
+                self.models_.append(tree)
+            else:
+                # constant tree (reference gbdt.cpp:428-441)
+                if len(self.models_) < k:
+                    if (
+                        self.objective is not None
+                        and not cfg.boost_from_average
+                        and not self._has_init_score
+                    ):
+                        init_scores[kk] = self.objective.boost_from_score(kk)
+                        self._score = self._score.at[kk].add(init_scores[kk])
+                        for entry in self._valid:
+                            entry.score = entry.score.at[kk].add(init_scores[kk])
+                    tree = Tree.constant_tree(init_scores[kk])
+                else:
+                    tree = Tree.constant_tree(0.0)
+                self._bin_records.append(
+                    {
+                        "split_feature": np.zeros(0, np.int32),
+                        "split_bin": np.zeros(0, np.int32),
+                        "default_left": np.zeros(0, bool),
+                        "left_child": np.zeros(0, np.int32),
+                        "right_child": np.zeros(0, np.int32),
+                        "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
+                    }
+                )
+                self.models_.append(tree)
+
+        if not should_continue:
+            if len(self.models_) > k:
+                for _ in range(k):
+                    self.models_.pop()
+                    self._bin_records.pop()
+            return True
+        self._iter += 1
+        return False
+
+    def _feature_mask_for_iter(self) -> jnp.ndarray:
+        cfg = self.config
+        f = self._bins.shape[1]
+        if cfg.feature_fraction >= 1.0 or f == 0:
+            return self._full_feature_mask
+        rng = np.random.default_rng(cfg.feature_fraction_seed + self._iter)
+        used = max(1, int(round(f * cfg.feature_fraction)))
+        chosen = rng.choice(f, size=used, replace=False)
+        m = np.zeros(f, dtype=bool)
+        m[chosen] = True
+        return jnp.asarray(m)
+
+    def rollback_one_iter(self) -> "Booster":
+        """Reference GBDT::RollbackOneIter (gbdt.cpp:462)."""
+        if self._iter <= 0:
+            return self
+        k = self.num_tree_per_iteration
+        for kk in range(k):
+            idx = len(self.models_) - k + kk
+            tree = self.models_[idx]
+            rec = self._bin_records[idx]
+            neg = jnp.asarray(-np.asarray(tree.leaf_value, dtype=np.float32))
+            if len(rec["split_feature"]):
+                self._score = self._score.at[kk].set(
+                    add_tree_to_score(
+                        self._score[kk],
+                        self._bins,
+                        self._nan_bins,
+                        jnp.asarray(rec["split_feature"]),
+                        jnp.asarray(rec["split_bin"]),
+                        jnp.asarray(rec["default_left"]),
+                        jnp.asarray(rec["left_child"]),
+                        jnp.asarray(rec["right_child"]),
+                        neg,
+                    )
+                )
+                for entry in self._valid:
+                    entry.score = entry.score.at[kk].set(
+                        add_tree_to_score(
+                            entry.score[kk],
+                            entry.dataset.device_bins(),
+                            self._nan_bins,
+                            jnp.asarray(rec["split_feature"]),
+                            jnp.asarray(rec["split_bin"]),
+                            jnp.asarray(rec["default_left"]),
+                            jnp.asarray(rec["left_child"]),
+                            jnp.asarray(rec["right_child"]),
+                            neg,
+                        )
+                    )
+            else:
+                self._score = self._score.at[kk].add(-float(tree.leaf_value[0]))
+                for entry in self._valid:
+                    entry.score = entry.score.at[kk].add(-float(tree.leaf_value[0]))
+        for _ in range(k):
+            self.models_.pop()
+            self._bin_records.pop()
+        self._iter -= 1
+        return self
+
+    # ================================================================== eval
+    def _eval_entry(self, entry: _EvalEntry, feval=None) -> List[Tuple[str, str, float, bool]]:
+        dev_score = self._score if entry is self._train_entry else entry.score
+        score = np.asarray(dev_score, dtype=np.float64)
+        out = []
+        for m in entry.metrics:
+            for name, val in m.eval(score, self.objective):
+                out.append((entry.name, name, val, m.is_higher_better))
+        if feval is not None:
+            fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+            # feval receives transformed predictions, matching the reference
+            # (GBDT::GetPredictAt applies ConvertOutput before handing the
+            # score to python feval)
+            if self.objective is not None:
+                pred_for_feval = np.asarray(
+                    self.objective.convert_output(
+                        jnp.asarray(score.T if self.num_class > 1 else score[0])
+                    )
+                )
+            else:
+                pred_for_feval = score.T if self.num_class > 1 else score[0]
+            for f in fevals:
+                res = f(pred_for_feval, entry.dataset)
+                results = res if isinstance(res, list) else [res]
+                for name, val, hib in results:
+                    out.append((entry.name, name, val, hib))
+        return out
+
+    def eval_train(self, feval=None):
+        return self._eval_entry(self._train_entry, feval)
+
+    def eval_valid(self, feval=None):
+        out = []
+        for entry in self._valid:
+            out.extend(self._eval_entry(entry, feval))
+        return out
+
+    def eval(self, data: Dataset, name: str, feval=None):
+        for entry in self._valid:
+            if entry.dataset is data:
+                return self._eval_entry(entry, feval)
+        if data is self.train_set:
+            return self.eval_train(feval)
+        raise ValueError("dataset was not added with add_valid")
+
+    # =============================================================== predict
+    def current_iteration(self) -> int:
+        return self._iter
+
+    def num_trees(self) -> int:
+        return len(self.models_)
+
+    @property
+    def num_model_per_iteration(self) -> int:
+        return self.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        return self.max_feature_idx + 1
+
+    def _tree_range(self, start_iteration: int, num_iteration: Optional[int]):
+        k = self.num_tree_per_iteration
+        total_iters = len(self.models_) // k
+        start = max(0, start_iteration)
+        if num_iteration is None:
+            # LightGBM contract: default to best_iteration when early
+            # stopping recorded one (basic.py predict docs)
+            end = self.best_iteration if self.best_iteration > 0 else total_iters
+            end = min(end, total_iters)
+        elif num_iteration <= 0:
+            end = total_iters
+        else:
+            end = min(total_iters, start + num_iteration)
+        return start * k, max(end, start) * k
+
+    def predict(
+        self,
+        data: Union[np.ndarray, "Any"],
+        start_iteration: int = 0,
+        num_iteration: Optional[int] = None,
+        raw_score: bool = False,
+        pred_leaf: bool = False,
+        pred_contrib: bool = False,
+        validate_features: bool = False,
+        **kwargs,
+    ) -> np.ndarray:
+        """Batch prediction (reference: LGBM_BoosterPredictForMat ->
+        PredictBatchDirect, src/c_api.cpp:2531/:528; per-tree walk
+        tree_avx512.hpp:41 -> predict.py level-sync walker).
+
+        Unlike the fork's quirk (PredictRawBatch skipping ConvertOutput,
+        SURVEY §2.9), the sigmoid/softmax transform IS applied unless
+        raw_score is requested.
+        """
+        X = self._coerce_predict_input(data)
+        t0, t1 = self._tree_range(start_iteration, num_iteration)
+        if pred_contrib:
+            return self._predict_contrib(X, t0, t1)
+        k = self.num_tree_per_iteration
+        if t1 <= t0 or not self.models_:
+            n = X.shape[0]
+            if pred_leaf:
+                return np.zeros((n, 0), dtype=np.int32)
+            base = np.zeros((n, k) if k > 1 else n)
+            return base
+
+        use_bins = (
+            self.train_set is not None
+            and self.train_set.bin_mappers
+            # merged init-model trees may have no exact bin-space form
+            # (e.g. categorical splits); fall back to the host walker then
+            and not any(
+                r.get("no_bin_form") for r in self._bin_records[t0:t1]
+            )
+        )
+        if use_bins:
+            bins = self._bin_input(X)
+            batch = self._stacked_bins(t0, t1)
+            if pred_leaf:
+                leaves = predict_bins_leaves(batch, bins, self._nan_bins)
+                return np.asarray(leaves, dtype=np.int32)
+            per_tree = np.asarray(predict_bins_raw(batch, bins, self._nan_bins), dtype=np.float64)
+        else:
+            has_cat = any(t.num_cat > 0 for t in self.models_[t0:t1])
+            if has_cat:
+                per_tree = np.stack(
+                    [t.predict(X) for t in self.models_[t0:t1]], axis=1
+                )
+                if pred_leaf:
+                    return np.stack(
+                        [
+                            np.fromiter(
+                                (t.predict_leaf(row) for row in X), dtype=np.int32
+                            )
+                            for t in self.models_[t0:t1]
+                        ],
+                        axis=1,
+                    )
+            else:
+                batch = stack_real_trees(self.models_[t0:t1])
+                Xd = jnp.asarray(X, dtype=jnp.float32)
+                if pred_leaf:
+                    return np.asarray(predict_real_leaves(batch, Xd), dtype=np.int32)
+                per_tree = np.asarray(predict_real_raw(batch, Xd), dtype=np.float64)
+
+        n = X.shape[0]
+        raw = per_tree.reshape(n, -1, k).sum(axis=1)  # [N, K]
+        if self.average_output:
+            raw /= (t1 - t0) // k
+        if k == 1:
+            raw = raw[:, 0]
+        if raw_score or self.objective is None:
+            return raw
+        return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+
+    def _coerce_predict_input(self, data) -> np.ndarray:
+        try:
+            import pandas as pd  # type: ignore
+
+            if isinstance(data, pd.DataFrame):
+                data = data.to_numpy(dtype=np.float64, na_value=np.nan)
+        except Exception:
+            pass
+        if hasattr(data, "toarray"):  # scipy sparse
+            data = data.toarray()
+        X = np.asarray(data, dtype=np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        return X
+
+    def _bin_input(self, X: np.ndarray) -> jnp.ndarray:
+        ds = self.train_set
+        cols = []
+        for j in ds.used_features:
+            mapper = ds.bin_mappers[j]
+            b = mapper.values_to_bins(X[:, j])
+            if mapper.is_categorical:
+                # unseen categories must fall through to the right child
+                # (reference CategoricalDecision, tree.h:382): bin 0 would
+                # wrongly send them left, so route them to a sentinel bin
+                vals = X[:, j]
+                nan_mask = np.isnan(vals)
+                iv = np.where(nan_mask, -1, vals).astype(np.int64)
+                known = np.isin(iv, mapper.bin_to_cat) & (iv >= 0)
+                sentinel = np.int32(1 << 20)
+                b = np.where(known | (nan_mask & (mapper.nan_bin >= 0)), b, sentinel)
+            cols.append(b)
+        mat = (
+            np.stack(cols, axis=1)
+            if cols
+            # no used features (all trivial): keep one dummy column so the
+            # walker's gathers stay in range; constant trees never read it
+            else np.zeros((X.shape[0], 1), dtype=np.int32)
+        )
+        return jnp.asarray(mat.astype(np.int32))
+
+    def _stacked_bins(self, t0: int, t1: int) -> BinTreeBatch:
+        key = (t0, t1, len(self.models_))
+        if key not in self._stack_cache:
+            self._stack_cache = {}  # invalidate older stacks
+            self._stack_cache[key] = stack_bin_trees(
+                self._bin_records[t0:t1], self.config.num_leaves
+            )
+        return self._stack_cache[key]
+
+    def _predict_contrib(self, X: np.ndarray, t0: int, t1: int) -> np.ndarray:
+        """SHAP values via TreeSHAP (reference: GBDT::PredictContrib ->
+        Tree::PredictContrib, src/io/tree.cpp TreeSHAP path)."""
+        from ..shap import predict_contrib
+
+        return predict_contrib(self, X, t0, t1)
+
+    # ============================================================== model IO
+    def model_to_string(
+        self,
+        num_iteration: Optional[int] = None,
+        start_iteration: int = 0,
+        importance_type: str = "split",
+    ) -> str:
+        """Reference: GBDT::SaveModelToString (gbdt_model_text.cpp:314)."""
+        t0, t1 = self._tree_range(start_iteration, num_iteration)
+        lines = ["tree"]
+        lines.append(f"version={_MODEL_VERSION}")
+        lines.append(f"num_class={self.num_class}")
+        lines.append(f"num_tree_per_iteration={self.num_tree_per_iteration}")
+        lines.append(f"label_index={self.label_idx}")
+        lines.append(f"max_feature_idx={self.max_feature_idx}")
+        if self.objective is not None:
+            lines.append(f"objective={self.objective.to_string()}")
+        elif self.config.objective:
+            lines.append(f"objective={self.config.objective}")
+        if self.average_output:
+            lines.append("average_output")
+        lines.append("feature_names=" + " ".join(self.feature_names))
+        lines.append("feature_infos=" + " ".join(self.feature_infos))
+
+        tree_strs = [
+            self.models_[i].to_string(i - t0) for i in range(t0, t1)
+        ]
+        sizes = [len(s) + 1 for s in tree_strs]  # +1: joining newline
+        lines.append("tree_sizes=" + " ".join(str(s) for s in sizes))
+        lines.append("")
+        body = "\n".join(tree_strs)
+        out = "\n".join(lines) + "\n" + body + ("\n" if body else "") + "end of trees\n"
+
+        imp = self.feature_importance(importance_type="split")
+        pairs = sorted(
+            [
+                (int(imp[i]), self.feature_names[i])
+                for i in range(len(imp))
+                if imp[i] > 0
+            ],
+            key=lambda p: -p[0],
+        )
+        out += "\nfeature_importances:\n"
+        for v, name in pairs:
+            out += f"{name}={v}\n"
+        out += "\nparameters:\n"
+        for key, val in (self.params or {}).items():
+            out += f"[{key}: {val}]\n"
+        out += "end of parameters\n"
+        return out
+
+    def save_model(
+        self,
+        filename: str,
+        num_iteration: Optional[int] = None,
+        start_iteration: int = 0,
+        importance_type: str = "split",
+    ) -> "Booster":
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration, start_iteration, importance_type))
+        return self
+
+    def _load_model_string(self, s: str) -> None:
+        """Reference: GBDT::LoadModelFromString (gbdt_model_text.cpp:468)."""
+        header, _, rest = s.partition("Tree=")
+        kv = {}
+        for line in header.splitlines():
+            line = line.strip()
+            if "=" in line:
+                key, v = line.split("=", 1)
+                kv[key] = v
+            elif line == "average_output":
+                self.average_output = True
+        self.num_class = int(kv.get("num_class", 1))
+        self.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", 1))
+        self.label_idx = int(kv.get("label_index", 0))
+        self.max_feature_idx = int(kv.get("max_feature_idx", 0))
+        self.feature_names = kv.get("feature_names", "").split()
+        self.feature_infos = kv.get("feature_infos", "").split()
+        obj_str = kv.get("objective", "")
+        if obj_str:
+            parts = obj_str.split()
+            obj_params = dict(self.params)
+            obj_params["objective"] = parts[0]
+            for tok in parts[1:]:
+                if ":" in tok:
+                    pk, pv = tok.split(":", 1)
+                    obj_params[pk] = pv
+                elif tok == "sqrt":
+                    obj_params["reg_sqrt"] = True
+            self.config = Config.from_params(obj_params)
+            try:
+                self.objective = create_objective(self.config)
+            except ValueError:
+                self.objective = None
+        trees_part, _, _tail = ("Tree=" + rest).partition("end of trees")
+        blocks = trees_part.split("Tree=")
+        self.models_ = []
+        self._bin_records = []
+        for block in blocks:
+            if not block.strip():
+                continue
+            self.models_.append(Tree.from_string(block))
+        self._iter = len(self.models_) // max(1, self.num_tree_per_iteration)
+        # objective needs label stats for convert_output only for a few
+        # objectives; predict-time convert uses config scalars, so a light
+        # init with dummy labels is enough when we have no dataset.
+        if self.objective is not None:
+            try:
+                self.objective.init(np.zeros(1), None)
+            except Exception:
+                pass
+            self.objective.num_data = 0
+
+    def dump_model(
+        self, num_iteration: Optional[int] = None, start_iteration: int = 0
+    ) -> dict:
+        t0, t1 = self._tree_range(start_iteration, num_iteration)
+        return {
+            "name": "tree",
+            "version": _MODEL_VERSION,
+            "num_class": self.num_class,
+            "num_tree_per_iteration": self.num_tree_per_iteration,
+            "label_index": self.label_idx,
+            "max_feature_idx": self.max_feature_idx,
+            "objective": self.objective.to_string() if self.objective else "",
+            "average_output": self.average_output,
+            "feature_names": self.feature_names,
+            "feature_infos": self.feature_infos,
+            "tree_info": [
+                {"tree_index": i - t0, **self.models_[i].to_json()}
+                for i in range(t0, t1)
+            ],
+            "feature_importances": {
+                self.feature_names[i]: float(v)
+                for i, v in enumerate(self.feature_importance("split"))
+                if v > 0
+            },
+        }
+
+    # ============================================================ inspection
+    def feature_importance(
+        self, importance_type: str = "split", iteration: Optional[int] = None
+    ) -> np.ndarray:
+        """Reference: GBDT::FeatureImportance (gbdt_model_text.cpp:654)."""
+        num_f = self.max_feature_idx + 1
+        k = self.num_tree_per_iteration
+        end = len(self.models_) if iteration is None or iteration <= 0 else iteration * k
+        out = np.zeros(num_f)
+        for tree in self.models_[:end]:
+            if importance_type == "split":
+                out += tree.split_counts(num_f)
+            else:
+                out += tree.gain_sums(num_f)
+        return out
+
+    def feature_name(self) -> List[str]:
+        return list(self.feature_names)
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """Reference: Booster::ResetConfig via LGBM_BoosterResetParameter."""
+        self.params.update(params)
+        self.config = Config.from_params(self.params)
+        cfg = self.config
+        self._shrinkage_rate = cfg.learning_rate
+        if self.train_set is not None:
+            self._grower_params = GrowerParams(
+                num_leaves=cfg.num_leaves,
+                max_bin=self._max_bin_padded,
+                max_depth=cfg.max_depth,
+                min_data_in_leaf=cfg.min_data_in_leaf,
+                min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+                lambda_l1=cfg.lambda_l1,
+                lambda_l2=cfg.lambda_l2,
+                min_gain_to_split=cfg.min_gain_to_split,
+                max_delta_step=cfg.max_delta_step,
+            )
+        return self
+
+    def merge_from(self, other: "Booster") -> "Booster":
+        """Continued training from an init model (reference: GBDT
+        MergeFrom/continued-training via num_init_iteration_, gbdt.h:614)."""
+        if other.num_tree_per_iteration != self.num_tree_per_iteration:
+            raise ValueError("init model has different num_tree_per_iteration")
+        k = self.num_tree_per_iteration
+        for idx, tree in enumerate(other.models_):
+            self.models_.append(tree)
+            rec = self._bin_record_from_tree(tree)
+            self._bin_records.append(rec)
+            kk = idx % k
+            # replay onto the train score
+            self._score = self._score.at[kk].add(
+                jnp.asarray(
+                    tree.predict(self._train_raw_for_replay()), dtype=jnp.float32
+                )
+            )
+        self._iter += len(other.models_) // k
+        return self
+
+    def _train_raw_for_replay(self) -> np.ndarray:
+        return self._raw_for_replay(self.train_set)
+
+    def _raw_for_replay(self, ds: Dataset) -> np.ndarray:
+        if ds.raw is not None:
+            return ds.raw
+        # reconstruct representative values from bins (inverse binning):
+        # exact for the tree decisions because thresholds are bin bounds
+        cols = np.zeros((ds.num_data, ds.num_total_features))
+        for ci, j in enumerate(ds.used_features):
+            mapper = ds.bin_mappers[j]
+            b = ds.bins[:, ci].astype(np.int64)
+            if mapper.is_categorical:
+                table = np.asarray(mapper.bin_to_cat, dtype=np.float64)
+                table = np.concatenate([table, [np.nan]])
+                cols[:, j] = table[np.minimum(b, len(table) - 1)]
+            else:
+                ub = np.asarray(mapper.bin_upper_bound)
+                reps = np.concatenate([ub[:-1], [mapper.max_value], [np.nan]])
+                cols[:, j] = reps[np.minimum(b, len(reps) - 1)]
+        return cols
+
+    def _bin_record_from_tree(self, tree: Tree) -> dict:
+        """Re-express a real-valued tree in bin space for the device predictor."""
+        ds = self.train_set
+        nn = tree.num_leaves - 1
+        sf_used = np.zeros(nn, dtype=np.int32)
+        sbin = np.zeros(nn, dtype=np.int32)
+        orig_to_used = {j: ci for ci, j in enumerate(ds.used_features)}
+        ok = True
+        for t in range(nn):
+            orig = int(tree.split_feature[t])
+            if orig not in orig_to_used:
+                ok = False
+                break
+            mapper = ds.bin_mappers[orig]
+            sf_used[t] = orig_to_used[orig]
+            if tree.decision_type[t] & 1:  # categorical: bins are freq-ordered
+                ok = False
+                break
+            ub = np.asarray(mapper.bin_upper_bound)
+            sbin[t] = int(np.searchsorted(ub, tree.threshold[t], side="left"))
+        if not ok:
+            return {
+                "split_feature": np.zeros(0, np.int32),
+                "split_bin": np.zeros(0, np.int32),
+                "default_left": np.zeros(0, bool),
+                "left_child": np.zeros(0, np.int32),
+                "right_child": np.zeros(0, np.int32),
+                "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
+                "no_bin_form": True,
+            }
+        return {
+            "split_feature": sf_used,
+            "split_bin": sbin,
+            "default_left": (np.asarray(tree.decision_type) & 2) != 0,
+            "left_child": np.asarray(tree.left_child),
+            "right_child": np.asarray(tree.right_child),
+            "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
+        }
+
+    def __copy__(self):
+        return self
+
+    def free_dataset(self) -> "Booster":
+        return self
+
+    def free_network(self) -> "Booster":
+        return self

@@ -1,0 +1,114 @@
+"""Row-sampling strategies: bagging and GOSS.
+
+Reference analogs: ``SampleStrategy`` (include/LightGBM/sample_strategy.h),
+``BaggingSampleStrategy`` (src/boosting/bagging.hpp — per-row Bernoulli
+``NextFloat() < bagging_fraction`` :239, balanced pos/neg variant :248) and
+``GOSSStrategy`` (src/boosting/goss.hpp:30 — keep top ``top_rate`` rows by
+sum_k |g_k*h_k|, sample ``other_rate`` of the rest, reweight by
+(cnt-top_k)/other_k; no sampling for the first 1/learning_rate iterations).
+
+TPU-native formulation: the reference's bag_data_indices index arrays become a
+dense ``[N]`` f32 mask (1 = in bag) consumed by the masked histogram kernel —
+shapes stay static, no gather/compaction.  GOSS's ArgMaxAtK partial sort
+becomes a ``top_k``-style threshold via ``jnp.sort``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+
+
+class SampleStrategy:
+    """Base: no sampling."""
+
+    is_hessian_change = False
+
+    def __init__(self, config: Config, num_data: int):
+        self.config = config
+        self.num_data = num_data
+        self._ones = jnp.ones((num_data,), jnp.float32)
+
+    def sample(
+        self, iteration: int, grad: jnp.ndarray, hess: jnp.ndarray, rng: jax.Array
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        return self._ones, grad, hess
+
+
+class BaggingStrategy(SampleStrategy):
+    """Per-row Bernoulli bagging, refreshed every ``bagging_freq`` iterations."""
+
+    def __init__(self, config: Config, num_data: int, is_pos=None):
+        super().__init__(config, num_data)
+        self._mask = self._ones
+        self._last_refresh = -1
+        self._is_pos = is_pos  # device bool [N] for balanced bagging, or None
+
+    def sample(self, iteration, grad, hess, rng):
+        cfg = self.config
+        freq = max(1, cfg.bagging_freq)
+        if iteration % freq == 0:
+            if self._is_pos is not None:
+                p = jnp.where(
+                    self._is_pos, cfg.pos_bagging_fraction, cfg.neg_bagging_fraction
+                )
+                self._mask = jax.random.uniform(rng, (self.num_data,)) < p
+                self._mask = self._mask.astype(jnp.float32)
+            else:
+                self._mask = jax.random.bernoulli(
+                    rng, cfg.bagging_fraction, (self.num_data,)
+                ).astype(jnp.float32)
+        return self._mask, grad, hess
+
+
+class GOSSStrategy(SampleStrategy):
+    """Gradient-based One-Side Sampling (src/boosting/goss.hpp)."""
+
+    is_hessian_change = True
+
+    def __init__(self, config: Config, num_data: int):
+        super().__init__(config, num_data)
+        if config.top_rate + config.other_rate > 1.0:
+            raise ValueError("top_rate + other_rate must be <= 1.0")
+        if config.top_rate <= 0 or config.other_rate <= 0:
+            raise ValueError("top_rate and other_rate must be > 0 for GOSS")
+        self._warmup = int(1.0 / max(config.learning_rate, 1e-12))
+
+    def sample(self, iteration, grad, hess, rng):
+        if iteration < self._warmup:
+            return self._ones, grad, hess
+        cfg = self.config
+        n = self.num_data
+        metric = jnp.abs(grad * hess).sum(axis=0)  # sum over classes [N]
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        threshold = jnp.sort(metric)[n - top_k]
+        is_top = metric >= threshold
+        rest_prob = other_k / max(1, n - top_k)
+        sampled = jax.random.uniform(rng, (n,)) < rest_prob
+        in_bag = is_top | (~is_top & sampled)
+        multiply = (n - top_k) / other_k
+        factor = jnp.where(is_top, 1.0, multiply)[None, :]
+        mask = in_bag.astype(jnp.float32)
+        return mask, grad * factor * mask[None, :], hess * factor * mask[None, :]
+
+
+def create_sample_strategy(config: Config, num_data: int, is_pos=None) -> SampleStrategy:
+    """Factory (reference: SampleStrategy::CreateSampleStrategy,
+    src/boosting/sample_strategy.cpp)."""
+    if config.boosting == "goss" or (config.raw or {}).get("data_sample_strategy") == "goss":
+        return GOSSStrategy(config, num_data)
+    need_balanced = (
+        config.pos_bagging_fraction < 1.0 or config.neg_bagging_fraction < 1.0
+    )
+    if config.bagging_freq > 0 and (config.bagging_fraction < 1.0 or need_balanced):
+        return BaggingStrategy(config, num_data, is_pos if need_balanced else None)
+    if config.boosting == "rf":
+        # RF requires bagging (reference rf.hpp:25 CHECK)
+        return BaggingStrategy(config, num_data)
+    return SampleStrategy(config, num_data)

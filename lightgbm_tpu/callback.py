@@ -1,0 +1,185 @@
+"""Training callbacks (reference: python-package/lightgbm/callback.py).
+
+The reference's callback protocol is reproduced exactly: callables receive a
+``CallbackEnv`` namedtuple; ``before_iteration`` attributes order them before
+the boosting update; ``EarlyStopException`` unwinds the training loop.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List
+
+
+class EarlyStopException(Exception):
+    """Raised to stop training (reference callback.py EarlyStopException)."""
+
+    def __init__(self, best_iteration: int, best_score):
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration", "evaluation_result_list"],
+)
+
+
+def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    """Print eval results every ``period`` iterations (reference
+    callback.py log_evaluation)."""
+
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.evaluation_result_list and (env.iteration + 1) % period == 0:
+            parts = []
+            for item in env.evaluation_result_list:
+                if len(item) == 4:
+                    data_name, eval_name, result, _ = item
+                    parts.append(f"{data_name}'s {eval_name}: {result:g}")
+                else:
+                    data_name, eval_name, result, _, stdv = item
+                    if show_stdv:
+                        parts.append(f"{data_name}'s {eval_name}: {result:g} + {stdv:g}")
+                    else:
+                        parts.append(f"{data_name}'s {eval_name}: {result:g}")
+            print(f"[{env.iteration + 1}]\t" + "\t".join(parts))
+
+    _callback.order = 10
+    return _callback
+
+
+print_evaluation = log_evaluation  # legacy alias
+
+
+def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callable:
+    """Record eval results into a nested dict (reference record_evaluation)."""
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result should be a dictionary")
+
+    def _init(env: CallbackEnv) -> None:
+        eval_result.clear()
+        for item in env.evaluation_result_list or []:
+            data_name, eval_name = item[0], item[1]
+            eval_result.setdefault(data_name, collections.OrderedDict()).setdefault(
+                eval_name, []
+            )
+
+    def _callback(env: CallbackEnv) -> None:
+        if not eval_result:
+            _init(env)
+        for item in env.evaluation_result_list or []:
+            data_name, eval_name, result = item[0], item[1], item[2]
+            eval_result.setdefault(data_name, collections.OrderedDict()).setdefault(
+                eval_name, []
+            ).append(result)
+
+    _callback.order = 20
+    return _callback
+
+
+def reset_parameter(**kwargs: Any) -> Callable:
+    """Reset parameters per iteration: value list or callable(iter) -> value
+    (reference reset_parameter; used for learning-rate schedules)."""
+
+    def _callback(env: CallbackEnv) -> None:
+        new_parameters = {}
+        for key, value in kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(
+                        f"Length of list {key!r} has to equal to 'num_boost_round'."
+                    )
+                new_param = value[env.iteration - env.begin_iteration]
+            elif callable(value):
+                new_param = value(env.iteration - env.begin_iteration)
+            else:
+                raise ValueError(f"invalid value for {key!r}")
+            new_parameters[key] = new_param
+        if new_parameters:
+            env.model.reset_parameter(new_parameters)
+
+    _callback.before_iteration = True
+    _callback.order = 10
+    return _callback
+
+
+def early_stopping(
+    stopping_rounds: int,
+    first_metric_only: bool = False,
+    verbose: bool = True,
+    min_delta: float = 0.0,
+) -> Callable:
+    """Early stopping on validation metrics (reference callback.py
+    early_stopping / _EarlyStoppingCallback)."""
+    best_score: List[float] = []
+    best_iter: List[int] = []
+    best_score_list: List[Any] = []
+    cmp_op: List[Callable] = []
+    enabled = [True]
+    first_metric = [""]
+
+    def _init(env: CallbackEnv) -> None:
+        enabled[0] = bool(env.evaluation_result_list)
+        if not enabled[0]:
+            return
+        best_score.clear()
+        best_iter.clear()
+        best_score_list.clear()
+        cmp_op.clear()
+        first_metric[0] = env.evaluation_result_list[0][1].split(" ")[-1]
+        deltas = (
+            min_delta
+            if isinstance(min_delta, list)
+            else [min_delta] * len(env.evaluation_result_list)
+        )
+        for item, delta in zip(env.evaluation_result_list, deltas):
+            best_iter.append(0)
+            best_score_list.append(None)
+            higher_better = item[3]
+            if higher_better:
+                best_score.append(float("-inf"))
+                cmp_op.append(lambda curr, best, d=delta: curr > best + d)
+            else:
+                best_score.append(float("inf"))
+                cmp_op.append(lambda curr, best, d=delta: curr < best - d)
+
+    def _callback(env: CallbackEnv) -> None:
+        if not best_score:
+            _init(env)
+        if not enabled[0]:
+            return
+        for i, item in enumerate(env.evaluation_result_list):
+            data_name, eval_name, score = item[0], item[1], item[2]
+            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
+                best_score[i] = score
+                best_iter[i] = env.iteration
+                best_score_list[i] = env.evaluation_result_list
+            if first_metric_only and first_metric[0] != eval_name.split(" ")[-1]:
+                continue
+            if data_name == "training":
+                continue
+            if env.iteration - best_iter[i] >= stopping_rounds:
+                env.model.best_iteration = best_iter[i] + 1
+                if verbose:
+                    print(
+                        f"Early stopping, best iteration is:\n[{best_iter[i] + 1}]\t"
+                        + "\t".join(
+                            f"{it[0]}'s {it[1]}: {it[2]:g}" for it in best_score_list[i]
+                        )
+                    )
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+            if env.iteration == env.end_iteration - 1:
+                env.model.best_iteration = best_iter[i] + 1
+                if verbose:
+                    print(
+                        "Did not meet early stopping. Best iteration is:\n"
+                        f"[{best_iter[i] + 1}]\t"
+                        + "\t".join(
+                            f"{it[0]}'s {it[1]}: {it[2]:g}" for it in best_score_list[i]
+                        )
+                    )
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+
+    _callback.order = 30
+    return _callback

@@ -1,0 +1,282 @@
+"""Training entry points: train() and cv().
+
+Reference analogs: python-package/lightgbm/engine.py — ``train`` (:109, the
+canonical loop: construct Booster, per-iteration callbacks + booster.update()
++ eval) and ``cv`` (:627, folds + aggregated eval).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .boosting import create_booster
+from .boosting.gbdt import Booster
+from .callback import CallbackEnv, EarlyStopException, early_stopping, log_evaluation
+from .config import Config
+from .dataset import Dataset
+
+
+def train(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    valid_sets: Optional[Union[Dataset, Sequence[Dataset]]] = None,
+    valid_names: Optional[Sequence[str]] = None,
+    feval: Optional[Callable] = None,
+    init_model: Optional[Union[str, Booster]] = None,
+    keep_training_booster: bool = False,
+    callbacks: Optional[List[Callable]] = None,
+    fobj: Optional[Callable] = None,
+) -> Booster:
+    """Train a GBDT model (reference: engine.py:109)."""
+    params = dict(params or {})
+    cfg = Config.from_params(params)
+    if "num_iterations" in cfg.raw:
+        num_boost_round = cfg.num_iterations
+    if cfg.objective in ("none", "custom", "na", "null", "") and fobj is None:
+        fobj = params.pop("_fobj", None)
+
+    if isinstance(valid_sets, Dataset):
+        valid_sets = [valid_sets]
+    valid_sets = list(valid_sets or [])
+    valid_names = list(valid_names or [])
+
+    booster = create_booster(params, train_set)
+    if init_model is not None:
+        init_booster = (
+            init_model if isinstance(init_model, Booster) else Booster(model_file=init_model)
+        )
+        booster.merge_from(init_booster)
+
+    is_valid_contain_train = False
+    train_data_name = "training"
+    for i, vs in enumerate(valid_sets):
+        name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
+        if vs is train_set:
+            is_valid_contain_train = True
+            train_data_name = name
+            continue
+        booster.add_valid(vs, name)
+
+    callbacks = list(callbacks or [])
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        callbacks.append(
+            early_stopping(cfg.early_stopping_round, cfg.first_metric_only, verbose=cfg.verbosity > 0)
+        )
+    if cfg.verbosity > 0 and cfg.metric_freq > 0 and not any(
+        getattr(cb, "order", None) == 10 and not getattr(cb, "before_iteration", False)
+        for cb in callbacks
+    ):
+        pass  # reference prints via Log; python API requires explicit log_evaluation
+    callbacks_before = [cb for cb in callbacks if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    begin_iteration = booster.current_iteration()
+    end_iteration = begin_iteration + num_boost_round
+    evaluation_result_list: List = []
+    try:
+        for it in range(begin_iteration, end_iteration):
+            for cb in callbacks_before:
+                cb(
+                    CallbackEnv(
+                        model=booster,
+                        params=params,
+                        iteration=it,
+                        begin_iteration=begin_iteration,
+                        end_iteration=end_iteration,
+                        evaluation_result_list=None,
+                    )
+                )
+            is_finished = booster.update(fobj=fobj)
+
+            evaluation_result_list = []
+            if (it + 1) % max(1, booster.config.metric_freq) == 0 or it + 1 == end_iteration:
+                if is_valid_contain_train:
+                    res = booster.eval_train(feval)
+                    evaluation_result_list.extend(
+                        [(train_data_name, n, v, hib) for (_, n, v, hib) in res]
+                    )
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            for cb in callbacks_after:
+                cb(
+                    CallbackEnv(
+                        model=booster,
+                        params=params,
+                        iteration=it,
+                        begin_iteration=begin_iteration,
+                        end_iteration=end_iteration,
+                        evaluation_result_list=evaluation_result_list,
+                    )
+                )
+            if is_finished:
+                break
+    except EarlyStopException as e:
+        booster.best_iteration = e.best_iteration + 1
+        evaluation_result_list = e.best_score
+    booster.best_score = {}
+    for item in evaluation_result_list or []:
+        data_name, eval_name, val = item[0], item[1], item[2]
+        booster.best_score.setdefault(data_name, {})[eval_name] = val
+    return booster
+
+
+class CVBooster:
+    """Container of per-fold boosters (reference: engine.py CVBooster)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name: str):
+        def handler(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+
+        return handler
+
+
+def _make_n_folds(
+    full_data: Dataset,
+    nfold: int,
+    params: Dict[str, Any],
+    seed: int,
+    stratified: bool,
+    shuffle: bool,
+):
+    full_data.construct()
+    num_data = full_data.num_data
+    rng = np.random.default_rng(seed)
+    label = full_data.get_label()
+    if stratified:
+        # per-class round-robin assignment after an optional shuffle
+        fold_id = np.zeros(num_data, dtype=np.int64)
+        for cls in np.unique(label):
+            idx = np.nonzero(label == cls)[0]
+            if shuffle:
+                rng.shuffle(idx)
+            fold_id[idx] = np.arange(len(idx)) % nfold
+    else:
+        idx = np.arange(num_data)
+        if shuffle:
+            rng.shuffle(idx)
+        fold_id = np.zeros(num_data, dtype=np.int64)
+        fold_id[idx] = np.arange(num_data) % nfold
+    for k in range(nfold):
+        test_mask = fold_id == k
+        yield np.nonzero(~test_mask)[0], np.nonzero(test_mask)[0]
+
+
+def cv(
+    params: Dict[str, Any],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    folds=None,
+    nfold: int = 5,
+    stratified: bool = True,
+    shuffle: bool = True,
+    metrics: Optional[Union[str, Sequence[str]]] = None,
+    feval: Optional[Callable] = None,
+    init_model=None,
+    seed: int = 0,
+    callbacks: Optional[List[Callable]] = None,
+    eval_train_metric: bool = False,
+    return_cvbooster: bool = False,
+    fobj: Optional[Callable] = None,
+) -> Dict[str, List[float]]:
+    """K-fold cross-validation (reference: engine.py:627)."""
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    cfg = Config.from_params(params)
+    if "num_iterations" in cfg.raw:
+        num_boost_round = cfg.num_iterations
+    if cfg.objective not in ("binary", "multiclass", "multiclassova"):
+        stratified = False
+
+    train_set.construct()
+    data_np = train_set.bins  # binned copy exists; rebuild folds from raw-ish data
+    label = train_set.get_label()
+    weight = train_set.get_weight()
+
+    # folds on raw arrays: reconstruct per-fold Datasets sharing bin mappers
+    if folds is None:
+        folds = list(_make_n_folds(train_set, nfold, params, seed, stratified, shuffle))
+    else:
+        folds = list(folds)
+
+    cvbooster = CVBooster()
+    raw = train_set.raw
+    if raw is None:
+        raise ValueError(
+            "cv requires the training Dataset to keep raw data; construct it "
+            "with free_raw_data=False"
+        )
+    for train_idx, test_idx in folds:
+        dtrain = Dataset(
+            raw[train_idx],
+            label[train_idx],
+            weight=None if weight is None else weight[train_idx],
+            params=params,
+            free_raw_data=False,
+        )
+        dtest = dtrain.create_valid(
+            raw[test_idx],
+            label[test_idx],
+            weight=None if weight is None else weight[test_idx],
+        )
+        booster = create_booster(params, dtrain)
+        booster.add_valid(dtest, "valid")
+        cvbooster.append(booster)
+
+    results: Dict[str, List[float]] = {}
+    callbacks = list(callbacks or [])
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        callbacks.append(early_stopping(cfg.early_stopping_round, cfg.first_metric_only, verbose=False))
+    callbacks_after = sorted(
+        [cb for cb in callbacks if not getattr(cb, "before_iteration", False)],
+        key=lambda cb: getattr(cb, "order", 0),
+    )
+
+    try:
+        for it in range(num_boost_round):
+            all_res: Dict[str, Any] = {}
+            for booster in cvbooster.boosters:
+                booster.update(fobj=fobj)
+                res = booster.eval_valid(feval)
+                if eval_train_metric:
+                    res = booster.eval_train(feval) + res
+                for data_name, name, val, hib in res:
+                    entry = all_res.setdefault(f"{data_name} {name}", ([], hib))
+                    entry[0].append(val)
+            agg = []
+            for key, (vals, hib) in all_res.items():
+                mean = float(np.mean(vals))
+                std = float(np.std(vals))
+                results.setdefault(f"{key}-mean", []).append(mean)
+                results.setdefault(f"{key}-stdv", []).append(std)
+                agg.append(("cv_agg", key, mean, hib, std))
+            for cb in callbacks_after:
+                cb(
+                    CallbackEnv(
+                        model=cvbooster,
+                        params=params,
+                        iteration=it,
+                        begin_iteration=0,
+                        end_iteration=num_boost_round,
+                        evaluation_result_list=agg,
+                    )
+                )
+    except EarlyStopException as e:
+        cvbooster.best_iteration = e.best_iteration + 1
+        for key in list(results.keys()):
+            results[key] = results[key][: cvbooster.best_iteration]
+    if return_cvbooster:
+        results["cvbooster"] = cvbooster  # type: ignore[assignment]
+    return results

@@ -1,0 +1,543 @@
+"""Evaluation metrics (reference: src/metric/*, factory src/metric/metric.cpp:21).
+
+Host-side NumPy: metrics run once per ``metric_freq`` iterations on the raw
+score vector pulled from device, exactly as the reference computes them on the
+CPU score copy.  Sorting metrics (AUC, NDCG, MAP) use NumPy sorts — the
+reference's ParallelSort equivalents.  All metrics support row weights.
+
+Each metric's ``eval(score, objective)`` takes a ``[num_class, N]`` raw-score
+array and returns ``[(name, value)]``; ``is_higher_better`` mirrors the
+reference's ``factor_to_bigger_better``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+
+_EPS = 1e-15
+
+
+def _to_np(x) -> np.ndarray:
+    return np.asarray(x, dtype=np.float64)
+
+
+def _convert(score: np.ndarray, objective) -> np.ndarray:
+    """Apply the objective's raw->output transform (reference: metrics call
+    objective->ConvertOutput when an objective is attached)."""
+    if objective is None:
+        return score
+    import jax.numpy as jnp
+
+    return np.asarray(objective.convert_output(jnp.asarray(score)))
+
+
+class Metric:
+    """Base metric (reference: include/LightGBM/metric.h:44)."""
+
+    name: str = ""
+    is_higher_better: bool = False
+
+    def __init__(self, config: Config):
+        self.config = config
+
+    def init(self, label: np.ndarray, weight: Optional[np.ndarray], query_boundaries=None) -> None:
+        self.label = _to_np(label)
+        self.weight = None if weight is None else _to_np(weight)
+        self.num_data = len(self.label)
+        self.sum_weights = float(self.num_data if weight is None else self.weight.sum())
+        self.query_boundaries = query_boundaries
+
+    def eval(self, score: np.ndarray, objective) -> List[Tuple[str, float]]:
+        raise NotImplementedError
+
+
+# ======================================================== pointwise regression
+class _PointwiseMetric(Metric):
+    """Average of a pointwise loss (reference: RegressionMetric,
+    src/metric/regression_metric.hpp:22)."""
+
+    convert_score = True
+
+    def loss(self, label: np.ndarray, score: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def average(self, sum_loss: float, sum_weights: float) -> float:
+        return sum_loss / sum_weights
+
+    def eval(self, score, objective):
+        s = score[0] if score.ndim == 2 else score
+        if self.convert_score:
+            s = _convert(s, objective)
+        pt = self.loss(self.label, _to_np(s))
+        if self.weight is not None:
+            pt = pt * self.weight
+        return [(self.name, self.average(float(pt.sum()), self.sum_weights))]
+
+
+class L2Metric(_PointwiseMetric):
+    name = "l2"
+
+    def loss(self, label, score):
+        d = score - label
+        return d * d
+
+
+class RMSEMetric(L2Metric):
+    name = "rmse"
+
+    def average(self, sum_loss, sum_weights):
+        return math.sqrt(sum_loss / sum_weights)
+
+
+class L1Metric(_PointwiseMetric):
+    name = "l1"
+
+    def loss(self, label, score):
+        return np.abs(score - label)
+
+
+class QuantileMetric(_PointwiseMetric):
+    name = "quantile"
+
+    def loss(self, label, score):
+        a = self.config.alpha
+        delta = label - score
+        return np.where(delta < 0, (a - 1.0) * delta, a * delta)
+
+
+class HuberMetric(_PointwiseMetric):
+    name = "huber"
+
+    def loss(self, label, score):
+        a = self.config.alpha
+        diff = score - label
+        ad = np.abs(diff)
+        return np.where(ad <= a, 0.5 * diff * diff, a * (ad - 0.5 * a))
+
+
+class FairMetric(_PointwiseMetric):
+    name = "fair"
+
+    def loss(self, label, score):
+        c = self.config.fair_c
+        x = np.abs(score - label)
+        return c * x - c * c * np.log1p(x / c)
+
+
+class PoissonMetric(_PointwiseMetric):
+    name = "poisson"
+
+    def loss(self, label, score):
+        s = np.maximum(score, 1e-10)
+        return s - label * np.log(s)
+
+
+class MAPEMetric(_PointwiseMetric):
+    name = "mape"
+
+    def loss(self, label, score):
+        return np.abs(label - score) / np.maximum(1.0, np.abs(label))
+
+
+class GammaMetric(_PointwiseMetric):
+    name = "gamma"
+
+    def loss(self, label, score):
+        # negative log-likelihood with psi = 1 (regression_metric.hpp:261)
+        theta = -1.0 / np.maximum(score, 1e-300)
+        b = -np.log(np.maximum(-theta, 1e-300))
+        return -(label * theta - b)
+
+
+class GammaDevianceMetric(_PointwiseMetric):
+    name = "gamma_deviance"
+
+    def loss(self, label, score):
+        tmp = label / (score + 1e-9)
+        return tmp - np.log(np.maximum(tmp, 1e-300)) - 1.0
+
+    def average(self, sum_loss, sum_weights):
+        return sum_loss * 2.0
+
+
+class TweedieMetric(_PointwiseMetric):
+    name = "tweedie"
+
+    def loss(self, label, score):
+        rho = self.config.tweedie_variance_power
+        s = np.maximum(score, 1e-10)
+        a = label * np.exp((1.0 - rho) * np.log(s)) / (1.0 - rho)
+        b = np.exp((2.0 - rho) * np.log(s)) / (2.0 - rho)
+        return -a + b
+
+
+# ================================================================== binary
+class BinaryLoglossMetric(_PointwiseMetric):
+    name = "binary_logloss"
+
+    def loss(self, label, prob):
+        p = np.clip(prob, _EPS, 1.0 - _EPS)
+        return np.where(label > 0, -np.log(p), -np.log(1.0 - p))
+
+
+class BinaryErrorMetric(_PointwiseMetric):
+    name = "binary_error"
+
+    def loss(self, label, prob):
+        pred_pos = prob > 0.5
+        return np.where(pred_pos != (label > 0), 1.0, 0.0)
+
+
+def _weighted_auc(label_pos: np.ndarray, score: np.ndarray, weight: Optional[np.ndarray]) -> float:
+    """Weighted AUC by threshold sweep (reference: AUCMetric::Eval,
+    src/metric/binary_metric.hpp:159 — global sort + tie-aware accumulate)."""
+    w = np.ones_like(score) if weight is None else weight
+    order = np.argsort(-score, kind="stable")
+    s = score[order]
+    y = label_pos[order]
+    ww = w[order]
+    pos_w = ww * y
+    neg_w = ww * (1.0 - y)
+    # ties contribute cur_neg * (cur_pos/2 + sum_pos_before)
+    group_id = np.zeros(len(s), dtype=np.int64)
+    if len(s) > 1:
+        group_id[1:] = np.cumsum(np.diff(s) != 0)
+    n_groups = int(group_id[-1]) + 1 if len(s) else 0
+    gp = np.bincount(group_id, weights=pos_w, minlength=n_groups)
+    gn = np.bincount(group_id, weights=neg_w, minlength=n_groups)
+    sum_pos_before = np.concatenate([[0.0], np.cumsum(gp)[:-1]])
+    accum = float((gn * (0.5 * gp + sum_pos_before)).sum())
+    sum_pos = float(gp.sum())
+    sum_all = float(ww.sum())
+    if sum_pos > 0 and sum_pos != sum_all:
+        return accum / (sum_pos * (sum_all - sum_pos))
+    return 1.0
+
+
+class AUCMetric(Metric):
+    name = "auc"
+    is_higher_better = True
+
+    def eval(self, score, objective):
+        s = _to_np(score[0] if score.ndim == 2 else score)
+        y = (self.label > 0).astype(np.float64)
+        return [(self.name, _weighted_auc(y, s, self.weight))]
+
+
+class AveragePrecisionMetric(Metric):
+    """Weighted average precision (reference: binary_metric.hpp
+    AveragePrecisionMetric)."""
+
+    name = "average_precision"
+    is_higher_better = True
+
+    def eval(self, score, objective):
+        s = _to_np(score[0] if score.ndim == 2 else score)
+        w = np.ones_like(s) if self.weight is None else self.weight
+        order = np.argsort(-s, kind="stable")
+        y = (self.label[order] > 0).astype(np.float64)
+        ww = w[order]
+        tp = np.cumsum(ww * y)
+        fp = np.cumsum(ww * (1.0 - y))
+        total_pos = tp[-1] if len(tp) else 0.0
+        if total_pos == 0:
+            return [(self.name, 1.0)]
+        precision = tp / np.maximum(tp + fp, _EPS)
+        recall_delta = np.diff(np.concatenate([[0.0], tp])) / total_pos
+        return [(self.name, float((precision * recall_delta).sum()))]
+
+
+# =============================================================== multiclass
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+
+    def eval(self, score, objective):
+        probs = _convert(np.asarray(score).T, objective)  # [N, K] softmax
+        li = self.label.astype(np.int64)
+        p = np.clip(probs[np.arange(len(li)), li], _EPS, None)
+        loss = -np.log(p)
+        if self.weight is not None:
+            loss = loss * self.weight
+        return [(self.name, float(loss.sum()) / self.sum_weights)]
+
+
+class MultiErrorMetric(Metric):
+    def __init__(self, config: Config):
+        super().__init__(config)
+        k = config.multi_error_top_k
+        self.top_k = k
+        self.name = "multi_error" if k == 1 else f"multi_error@{k}"
+
+    def eval(self, score, objective):
+        s = np.asarray(score).T  # [N, K]
+        li = self.label.astype(np.int64)
+        own = s[np.arange(len(li)), li][:, None]
+        num_larger = (s >= own).sum(axis=1)
+        err = (num_larger > self.top_k).astype(np.float64)
+        if self.weight is not None:
+            err = err * self.weight
+        return [(self.name, float(err.sum()) / self.sum_weights)]
+
+
+class AucMuMetric(Metric):
+    """AUC-mu (reference: AucMuMetric, multiclass_metric.hpp:182;
+    Kleiman & Page, ICML'19)."""
+
+    name = "auc_mu"
+    is_higher_better = True
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.num_class = config.num_class
+        k = self.num_class
+        if config.auc_mu_weights:
+            self.class_weights = np.asarray(config.auc_mu_weights, dtype=np.float64).reshape(k, k)
+        else:
+            self.class_weights = np.ones((k, k)) - np.eye(k)
+
+    def eval(self, score, objective):
+        s = np.asarray(score)  # [K, N]
+        k = self.num_class
+        li = self.label.astype(np.int64)
+        w = np.ones(self.num_data) if self.weight is None else self.weight
+        total = 0.0
+        for i in range(k):
+            for j in range(i + 1, k):
+                curr_v = self.class_weights[i] - self.class_weights[j]
+                t1 = curr_v[i] - curr_v[j]
+                sel = (li == i) | (li == j)
+                if not sel.any():
+                    continue
+                v = t1 * (curr_v @ s[:, sel])
+                y = (li[sel] == i).astype(np.float64)  # class i as "positive"
+                total += _weighted_auc(y, v, w[sel])
+        denom = k * (k - 1) / 2
+        return [(self.name, total / denom)]
+
+
+# ================================================================== ranking
+def _default_label_gain(max_label: int = 31) -> np.ndarray:
+    return (2.0 ** np.arange(max_label + 1)) - 1.0
+
+
+class NDCGMetric(Metric):
+    """NDCG@k (reference: rank_metric.hpp + dcg_calculator.cpp)."""
+
+    name = "ndcg"
+    is_higher_better = True
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.eval_at = list(config.eval_at) or [1, 2, 3, 4, 5]
+        lg = config.label_gain
+        self.label_gain = np.asarray(lg, dtype=np.float64) if lg else _default_label_gain()
+
+    def eval(self, score, objective):
+        s = _to_np(score[0] if score.ndim == 2 else score)
+        qb = self.query_boundaries
+        if qb is None:
+            raise ValueError("ndcg metric requires query data")
+        ks = self.eval_at
+        sums = np.zeros(len(ks))
+        sum_q_weight = 0.0
+        max_q = int(np.max(np.diff(qb)))
+        disc = 1.0 / np.log2(np.arange(2, 2 + max_q))
+        for qi in range(len(qb) - 1):
+            b, e = qb[qi], qb[qi + 1]
+            lab = self.label[b:e].astype(np.int64)
+            sc = s[b:e]
+            qw = 1.0  # per-query weight = mean row weight (reference query_weights)
+            if self.weight is not None:
+                qw = float(self.weight[b:e].mean())
+            order = np.argsort(-sc, kind="stable")
+            gains = self.label_gain[lab]
+            ideal = np.sort(gains)[::-1]
+            for ki, k in enumerate(ks):
+                kk = min(k, e - b)
+                max_dcg = float((ideal[:kk] * disc[:kk]).sum())
+                if max_dcg <= 0:
+                    sums[ki] += 1.0 * qw  # all-zero-label query counts as perfect
+                else:
+                    dcg = float((gains[order[:kk]] * disc[:kk]).sum())
+                    sums[ki] += (dcg / max_dcg) * qw
+            sum_q_weight += qw
+        return [(f"{self.name}@{k}", float(sums[ki] / sum_q_weight)) for ki, k in enumerate(ks)]
+
+
+class MapMetric(Metric):
+    """MAP@k (reference: map_metric.hpp CalMapAtK)."""
+
+    name = "map"
+    is_higher_better = True
+
+    def __init__(self, config: Config):
+        super().__init__(config)
+        self.eval_at = list(config.eval_at) or [1, 2, 3, 4, 5]
+
+    def eval(self, score, objective):
+        s = _to_np(score[0] if score.ndim == 2 else score)
+        qb = self.query_boundaries
+        if qb is None:
+            raise ValueError("map metric requires query data")
+        ks = self.eval_at
+        sums = np.zeros(len(ks))
+        sum_q_weight = 0.0
+        for qi in range(len(qb) - 1):
+            b, e = qb[qi], qb[qi + 1]
+            lab = self.label[b:e]
+            sc = s[b:e]
+            qw = 1.0
+            if self.weight is not None:
+                qw = float(self.weight[b:e].mean())
+            order = np.argsort(-sc, kind="stable")
+            is_pos = lab[order] > 0.5
+            npos = int(is_pos.sum())
+            hits = np.cumsum(is_pos)
+            ap_terms = np.where(is_pos, hits / (np.arange(e - b) + 1.0), 0.0)
+            for ki, k in enumerate(ks):
+                kk = min(k, e - b)
+                if npos > 0:
+                    sums[ki] += (ap_terms[:kk].sum() / min(npos, kk)) * qw
+                else:
+                    sums[ki] += 1.0 * qw
+            sum_q_weight += qw
+        return [(f"{self.name}@{k}", float(sums[ki] / sum_q_weight)) for ki, k in enumerate(ks)]
+
+
+# ================================================================= xentropy
+class CrossEntropyMetric(_PointwiseMetric):
+    name = "cross_entropy"
+
+    def loss(self, label, prob):
+        p = np.clip(prob, _EPS, 1.0 - _EPS)
+        return -label * np.log(p) - (1.0 - label) * np.log(1.0 - p)
+
+
+class CrossEntropyLambdaMetric(Metric):
+    """xentlambda (reference: xentropy_metric.hpp CrossEntropyLambdaMetric)."""
+
+    name = "cross_entropy_lambda"
+
+    def eval(self, score, objective):
+        s = _to_np(score[0] if score.ndim == 2 else score)
+        hhat = np.log1p(np.exp(s))
+        w = np.ones_like(s) if self.weight is None else self.weight
+        z = np.clip(1.0 - np.exp(-w * hhat), _EPS, 1.0 - _EPS)
+        loss = -self.label * np.log(z) - (1.0 - self.label) * np.log(1.0 - z)
+        return [(self.name, float(loss.sum()) / self.sum_weights)]
+
+
+class KullbackLeiblerDivergence(Metric):
+    """kldiv (reference: xentropy_metric.hpp KullbackLeiblerDivergence)."""
+
+    name = "kullback_leibler"
+
+    def eval(self, score, objective):
+        s = _to_np(score[0] if score.ndim == 2 else score)
+        p = np.clip(1.0 / (1.0 + np.exp(-s)), _EPS, 1.0 - _EPS)
+        y = np.clip(self.label, 0.0, 1.0)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            term_p = np.where(y > 0, y * np.log(np.maximum(y, _EPS) / p), 0.0)
+            term_n = np.where(y < 1, (1 - y) * np.log(np.maximum(1 - y, _EPS) / (1 - p)), 0.0)
+        loss = term_p + term_n
+        if self.weight is not None:
+            loss = loss * self.weight
+        return [(self.name, float(loss.sum()) / self.sum_weights)]
+
+
+# ================================================================== factory
+_METRIC_ALIASES = {
+    "l2": "l2",
+    "mean_squared_error": "l2",
+    "mse": "l2",
+    "regression": "l2",
+    "regression_l2": "l2",
+    "l2_root": "rmse",
+    "root_mean_squared_error": "rmse",
+    "rmse": "rmse",
+    "l1": "l1",
+    "mean_absolute_error": "l1",
+    "mae": "l1",
+    "regression_l1": "l1",
+    "quantile": "quantile",
+    "huber": "huber",
+    "fair": "fair",
+    "poisson": "poisson",
+    "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma",
+    "gamma_deviance": "gamma_deviance",
+    "tweedie": "tweedie",
+    "binary_logloss": "binary_logloss",
+    "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "auc": "auc",
+    "average_precision": "average_precision",
+    "multi_logloss": "multi_logloss",
+    "multiclass": "multi_logloss",
+    "softmax": "multi_logloss",
+    "multiclassova": "multi_logloss",
+    "multiclass_ova": "multi_logloss",
+    "ova": "multi_logloss",
+    "ovr": "multi_logloss",
+    "multi_error": "multi_error",
+    "auc_mu": "auc_mu",
+    "ndcg": "ndcg",
+    "lambdarank": "ndcg",
+    "rank_xendcg": "ndcg",
+    "xendcg": "ndcg",
+    "map": "map",
+    "mean_average_precision": "map",
+    "cross_entropy": "cross_entropy",
+    "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda",
+    "xentlambda": "cross_entropy_lambda",
+    "kullback_leibler": "kullback_leibler",
+    "kldiv": "kldiv",
+}
+_METRIC_ALIASES["kldiv"] = "kullback_leibler"
+
+_METRICS = {
+    "l2": L2Metric,
+    "rmse": RMSEMetric,
+    "l1": L1Metric,
+    "quantile": QuantileMetric,
+    "huber": HuberMetric,
+    "fair": FairMetric,
+    "poisson": PoissonMetric,
+    "mape": MAPEMetric,
+    "gamma": GammaMetric,
+    "gamma_deviance": GammaDevianceMetric,
+    "tweedie": TweedieMetric,
+    "binary_logloss": BinaryLoglossMetric,
+    "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric,
+    "average_precision": AveragePrecisionMetric,
+    "multi_logloss": MultiLoglossMetric,
+    "multi_error": MultiErrorMetric,
+    "auc_mu": AucMuMetric,
+    "ndcg": NDCGMetric,
+    "map": MapMetric,
+    "cross_entropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric,
+    "kullback_leibler": KullbackLeiblerDivergence,
+}
+
+
+def create_metric(name: str, config: Config) -> Optional[Metric]:
+    """Factory (reference: Metric::CreateMetric, src/metric/metric.cpp:21)."""
+    base = name.split("@")[0].strip()
+    if "@" in name:
+        ats = [int(x) for x in name.split("@")[1].split(",")]
+        config = Config.from_params({**config.raw, "eval_at": ats})
+    canon = _METRIC_ALIASES.get(base)
+    if canon is None:
+        if base in ("none", "null", "custom", "na", ""):
+            return None
+        raise ValueError(f"unknown metric: {name!r}")
+    return _METRICS[canon](config)

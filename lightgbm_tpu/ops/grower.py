@@ -51,6 +51,27 @@ class GrowerParams:
     max_delta_step: float = 0.0
     hist_method: str = "auto"
     axis_name: Optional[str] = None
+    # "gather": compact the smaller child's rows into a static-capacity
+    # buffer before the histogram pass (rows touched ~ N*log L per tree,
+    # the reference's ordered_gradients complexity); "full": masked pass
+    # over all rows per split (rows touched ~ N*L).
+    hist_mode: str = "gather"
+
+
+def _hist_caps(n: int) -> list:
+    """Static capacity ladder for the smaller child: N/2, N/8, N/32, ...
+
+    The smaller child of any split holds <= floor(parent/2) <= floor(N/2)
+    rows, so the top capacity always fits; smaller buckets avoid paying the
+    top capacity for deep (small) leaves."""
+    caps = []
+    cap = 1 << max(0, (max(n // 2, 1) - 1).bit_length())
+    floor_cap = min(4096, cap)
+    while cap > floor_cap:
+        caps.append(cap)
+        cap //= 4
+    caps.append(cap)
+    return caps  # descending
 
 
 class TreeArrays(NamedTuple):
@@ -146,6 +167,34 @@ def grow_tree(
     p = params
     n, f = bins.shape
     L, B = p.num_leaves, p.max_bin
+
+    use_gather = p.hist_mode == "gather" and f > 0 and n > 1
+    if use_gather:
+        caps = sorted(_hist_caps(n))  # ascending
+        caps_arr = jnp.asarray(caps, dtype=jnp.int32)
+        cap0 = caps[-1]
+        # one zero padding row so fill indices contribute nothing
+        bins_pad = jnp.concatenate([bins, jnp.zeros((1, f), bins.dtype)], axis=0)
+        grad_pad = jnp.concatenate([grad, jnp.zeros((1,), grad.dtype)])
+        hess_pad = jnp.concatenate([hess, jnp.zeros((1,), hess.dtype)])
+        mask_pad = jnp.concatenate([count_mask, jnp.zeros((1,), count_mask.dtype)])
+
+        def _make_hist_branch(cap: int):
+            def branch(idx):
+                sub = idx[:cap]
+                return leaf_histogram(
+                    bins_pad[sub],
+                    grad_pad[sub],
+                    hess_pad[sub],
+                    mask_pad[sub],
+                    B,
+                    method=p.hist_method,
+                    axis_name=p.axis_name,
+                )
+
+            return branch
+
+        hist_branches = [_make_hist_branch(c) for c in caps]
 
     hist0 = leaf_histogram(
         bins, grad, hess, count_mask, B, method=p.hist_method, axis_name=p.axis_name
@@ -245,15 +294,38 @@ def grow_tree(
             leaf_parent = st.leaf_parent.at[l].set(t).at[nl].set(t)
             leaf_is_right = st.leaf_is_right.at[l].set(False).at[nl].set(True)
 
-            # ---- histograms: masked pass for the smaller child, subtraction
-            # for the sibling (serial_tree_learner.cpp:558-583)
+            # ---- histograms: pass over the smaller child only, subtraction
+            # for the sibling (serial_tree_learner.cpp:558-583).  In gather
+            # mode the child's rows are first compacted into a static-capacity
+            # buffer (jnp.nonzero with static size) and the histogram runs
+            # over that buffer — the TPU formulation of the reference's
+            # ordered_gradients gather (rows touched per tree ~ N log L).
             parent_hist = st.hist_buf[l]
-            left_smaller = lc <= rc
-            target = jnp.where(left_smaller, l, nl)
-            mask = count_mask * (leaf_id == target)
-            sm = leaf_histogram(
-                bins, grad, hess, mask, B, method=p.hist_method, axis_name=p.axis_name
-            )
+            if use_gather:
+                # choose the smaller child by RAW row count (capacity bound);
+                # masked (bagging) stats still flow through lc/rc above
+                rows_l = jnp.sum(in_leaf & go_left).astype(jnp.int32)
+                rows_in = jnp.sum(in_leaf).astype(jnp.int32)
+                rows_r = rows_in - rows_l
+                left_smaller = rows_l <= rows_r
+                target = jnp.where(left_smaller, l, nl)
+                tc = jnp.minimum(rows_l, rows_r)
+                if p.axis_name is not None:
+                    # uniform bucket across shards so the psum inside the
+                    # selected branch lines up on every device
+                    tc = lax.pmax(tc, p.axis_name)
+                bucket = jnp.clip(
+                    jnp.searchsorted(caps_arr, tc, side="left"), 0, len(caps) - 1
+                ).astype(jnp.int32)
+                (idx,) = jnp.nonzero(leaf_id == target, size=cap0, fill_value=n)
+                sm = lax.switch(bucket, hist_branches, idx)
+            else:
+                left_smaller = lc <= rc
+                target = jnp.where(left_smaller, l, nl)
+                mask = count_mask * (leaf_id == target)
+                sm = leaf_histogram(
+                    bins, grad, hess, mask, B, method=p.hist_method, axis_name=p.axis_name
+                )
             other = parent_hist - sm
             left_hist = jnp.where(left_smaller, sm, other)
             right_hist = jnp.where(left_smaller, other, sm)

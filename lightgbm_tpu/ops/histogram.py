@@ -104,8 +104,16 @@ def leaf_histogram(
     collective over ICI instead of hand-rolled TCP recursive-halving).
     """
     if method == "auto":
-        method = "onehot" if jax.default_backend() in ("tpu", "axon") else "segment"
-    if method == "onehot":
+        method = "pallas" if jax.default_backend() in ("tpu", "axon") else "segment"
+    if method == "pallas":
+        from .pallas.histogram import histogram_pallas
+
+        hist = histogram_pallas(bins, grad, hess, mask, num_bins)
+    elif method == "pallas_interpret":
+        from .pallas.histogram import histogram_pallas
+
+        hist = histogram_pallas(bins, grad, hess, mask, num_bins, interpret=True)
+    elif method == "onehot":
         hist = leaf_histogram_onehot(bins, grad, hess, mask, num_bins)
     else:
         hist = leaf_histogram_segment(bins, grad, hess, mask, num_bins)

@@ -1,0 +1,233 @@
+"""Jitted batch prediction: level-synchronous tree walks on device.
+
+Reference analogs: the fork's batch path ``GBDT::PredictRawBatch``
+(src/boosting/gbdt_prediction.cpp:60) -> ``PredictTreeBatchAVX512``
+(include/LightGBM/tree_avx512.hpp:41) — 8-row level-synchronous walks; and the
+scalar ``Tree::Predict`` (include/LightGBM/tree.h:596).
+
+TPU-native formulation: ALL rows x ALL trees advance one level per step of a
+``lax.while_loop`` — the AVX512 kernel's ``nodes[8]`` array becomes a
+``[rows, trees]`` node-index matrix, every step is a pair of gathers plus a
+compare (vectorized over the full batch), and the loop exits when every walk
+has reached a leaf.  Two variants:
+
+  * bin space (exact, used when BinMappers are available): decisions are
+    ``bin <= split_bin`` with the NaN-bin default-direction rule — bit-for-bit
+    the same decisions the trainer made;
+  * real-value space (used for models loaded from text without mappers):
+    ``NumericalDecision`` semantics (tree.h:346) in f32.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .tree import (
+    K_CATEGORICAL_MASK,
+    K_DEFAULT_LEFT_MASK,
+    K_ZERO_THRESHOLD,
+    MISSING_NAN,
+    MISSING_ZERO,
+    Tree,
+)
+
+
+class BinTreeBatch(NamedTuple):
+    """Stacked bin-space trees [T, ...]; bin-space mirrors the trainer."""
+
+    split_feature: jnp.ndarray  # [T, M] used-feature column index
+    split_bin: jnp.ndarray  # [T, M] int32
+    default_left: jnp.ndarray  # [T, M] bool
+    left_child: jnp.ndarray  # [T, M] int32 (neg = ~leaf)
+    right_child: jnp.ndarray  # [T, M] int32
+    leaf_value: jnp.ndarray  # [T, L] f32
+
+
+class RealTreeBatch(NamedTuple):
+    """Stacked real-value trees (numeric splits only)."""
+
+    split_feature: jnp.ndarray  # [T, M] original feature index
+    threshold: jnp.ndarray  # [T, M] f32
+    decision_type: jnp.ndarray  # [T, M] int32
+    left_child: jnp.ndarray  # [T, M] int32
+    right_child: jnp.ndarray  # [T, M] int32
+    leaf_value: jnp.ndarray  # [T, L] f32
+
+
+def stack_bin_trees(records: List[dict], num_leaves_cap: int) -> BinTreeBatch:
+    """Pad per-tree bin-space arrays (host dicts) into one [T, ...] batch."""
+    t = len(records)
+    m = max(1, max(len(r["split_feature"]) for r in records))
+    # merged init-model trees may exceed the current config's num_leaves
+    L = max(1, num_leaves_cap, max(len(r["leaf_value"]) for r in records))
+
+    def padded(key, fill, dtype):
+        out = np.full((t, m), fill, dtype=dtype)
+        for i, r in enumerate(records):
+            arr = np.asarray(r[key])
+            out[i, : len(arr)] = arr
+        return out
+
+    leaf = np.zeros((t, L), dtype=np.float32)
+    for i, r in enumerate(records):
+        lv = np.asarray(r["leaf_value"], dtype=np.float32)
+        leaf[i, : len(lv)] = lv
+    left = padded("left_child", -1, np.int32)
+    # single-leaf trees: route node 0 to leaf 0
+    for i, r in enumerate(records):
+        if len(r["split_feature"]) == 0:
+            left[i, 0] = -1
+    return BinTreeBatch(
+        split_feature=jnp.asarray(padded("split_feature", 0, np.int32)),
+        split_bin=jnp.asarray(padded("split_bin", 0, np.int32)),
+        default_left=jnp.asarray(padded("default_left", False, bool)),
+        left_child=jnp.asarray(left),
+        right_child=jnp.asarray(padded("right_child", -1, np.int32)),
+        leaf_value=jnp.asarray(leaf),
+    )
+
+
+def stack_real_trees(trees: List[Tree]) -> RealTreeBatch:
+    t = len(trees)
+    m = max(1, max(tr.num_leaves - 1 for tr in trees))
+    L = max(1, max(tr.num_leaves for tr in trees))
+    sf = np.zeros((t, m), dtype=np.int32)
+    th = np.zeros((t, m), dtype=np.float32)
+    dt = np.zeros((t, m), dtype=np.int32)
+    lc = np.full((t, m), -1, dtype=np.int32)
+    rc = np.full((t, m), -1, dtype=np.int32)
+    lv = np.zeros((t, L), dtype=np.float32)
+    for i, tr in enumerate(trees):
+        nn = tr.num_leaves - 1
+        sf[i, :nn] = tr.split_feature
+        th[i, :nn] = tr.threshold
+        dt[i, :nn] = tr.decision_type
+        lc[i, :nn] = tr.left_child
+        rc[i, :nn] = tr.right_child
+        lv[i, : tr.num_leaves] = tr.leaf_value
+    return RealTreeBatch(
+        split_feature=jnp.asarray(sf),
+        threshold=jnp.asarray(th),
+        decision_type=jnp.asarray(dt),
+        left_child=jnp.asarray(lc),
+        right_child=jnp.asarray(rc),
+        leaf_value=jnp.asarray(lv),
+    )
+
+
+def _walk(gather_decide, left, right, n_rows: int, n_trees: int):
+    """Shared level-synchronous loop: advance [rows, trees] node indices."""
+    tree_ids = jnp.arange(n_trees, dtype=jnp.int32)[None, :]
+
+    def cond(nodes):
+        return jnp.any(nodes >= 0)
+
+    def body(nodes):
+        cur = jnp.maximum(nodes, 0)
+        go_left = gather_decide(cur, tree_ids)
+        nxt = jnp.where(
+            go_left, left[tree_ids, cur], right[tree_ids, cur]
+        )
+        return jnp.where(nodes >= 0, nxt, nodes)
+
+    nodes0 = jnp.zeros((n_rows, n_trees), dtype=jnp.int32)
+    return lax.while_loop(cond, body, nodes0)
+
+
+@jax.jit
+def predict_bins_leaves(batch: BinTreeBatch, bins: jnp.ndarray, nan_bins: jnp.ndarray) -> jnp.ndarray:
+    """Leaf index per (row, tree). bins: [N, F_used] int32; nan_bins: [F_used]."""
+    n = bins.shape[0]
+    t = batch.split_feature.shape[0]
+
+    def decide(cur, tree_ids):
+        feat = batch.split_feature[tree_ids, cur]  # [N, T]
+        tbin = batch.split_bin[tree_ids, cur]
+        dl = batch.default_left[tree_ids, cur]
+        fval = jnp.take_along_axis(bins, feat, axis=1)
+        nb = nan_bins[feat]
+        return (fval <= tbin) | (dl & (nb >= 0) & (fval == nb))
+
+    nodes = _walk(decide, batch.left_child, batch.right_child, n, t)
+    return ~nodes  # [N, T] leaf indices
+
+
+@jax.jit
+def predict_bins_raw(batch: BinTreeBatch, bins: jnp.ndarray, nan_bins: jnp.ndarray) -> jnp.ndarray:
+    """Sum of per-tree outputs [N, T] (caller groups by class and sums)."""
+    leaves = predict_bins_leaves(batch, bins, nan_bins)
+    t = batch.split_feature.shape[0]
+    tree_ids = jnp.arange(t, dtype=jnp.int32)[None, :]
+    return batch.leaf_value[tree_ids, leaves]  # [N, T]
+
+
+@jax.jit
+def predict_real_leaves(batch: RealTreeBatch, X: jnp.ndarray) -> jnp.ndarray:
+    """Leaf index per (row, tree) with NumericalDecision semantics (f32)."""
+    n = X.shape[0]
+    t = batch.split_feature.shape[0]
+
+    def decide(cur, tree_ids):
+        feat = batch.split_feature[tree_ids, cur]
+        thr = batch.threshold[tree_ids, cur]
+        dt = batch.decision_type[tree_ids, cur]
+        fval = jnp.take_along_axis(X, feat, axis=1)
+        missing = (dt >> 2) & 3
+        is_nan = jnp.isnan(fval)
+        fv = jnp.where(is_nan & (missing != MISSING_NAN), 0.0, fval)
+        is_missing = ((missing == MISSING_ZERO) & (jnp.abs(fv) <= K_ZERO_THRESHOLD)) | (
+            (missing == MISSING_NAN) & jnp.isnan(fv)
+        )
+        dl = (dt & K_DEFAULT_LEFT_MASK) != 0
+        return jnp.where(is_missing, dl, fv <= thr)
+
+    nodes = _walk(decide, batch.left_child, batch.right_child, n, t)
+    return ~nodes
+
+
+@jax.jit
+def predict_real_raw(batch: RealTreeBatch, X: jnp.ndarray) -> jnp.ndarray:
+    leaves = predict_real_leaves(batch, X)
+    t = batch.split_feature.shape[0]
+    tree_ids = jnp.arange(t, dtype=jnp.int32)[None, :]
+    return batch.leaf_value[tree_ids, leaves]
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def add_tree_to_score(
+    score_k: jnp.ndarray,  # [N] f32 (donated)
+    bins: jnp.ndarray,  # [N, F_used]
+    nan_bins: jnp.ndarray,  # [F_used]
+    split_feature: jnp.ndarray,  # [L-1]
+    split_bin: jnp.ndarray,
+    default_left: jnp.ndarray,
+    left_child: jnp.ndarray,
+    right_child: jnp.ndarray,
+    leaf_value: jnp.ndarray,  # [L] ALREADY shrunk
+) -> jnp.ndarray:
+    """Walk one bin-space tree over a dataset and add leaf outputs to score —
+    the valid-set ScoreUpdater::AddScore (src/boosting/score_updater.hpp:54)."""
+    n = bins.shape[0]
+
+    def cond(nodes):
+        return jnp.any(nodes >= 0)
+
+    def body(nodes):
+        cur = jnp.maximum(nodes, 0)
+        feat = split_feature[cur]
+        tbin = split_bin[cur]
+        dl = default_left[cur]
+        fval = jnp.take_along_axis(bins, feat[:, None], axis=1)[:, 0]
+        nb = nan_bins[feat]
+        go_left = (fval <= tbin) | (dl & (nb >= 0) & (fval == nb))
+        nxt = jnp.where(go_left, left_child[cur], right_child[cur])
+        return jnp.where(nodes >= 0, nxt, nodes)
+
+    nodes = lax.while_loop(cond, body, jnp.zeros((n,), jnp.int32))
+    return score_k + leaf_value[~nodes]

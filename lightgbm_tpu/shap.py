@@ -1,0 +1,231 @@
+"""SHAP feature contributions (pred_contrib) via exact TreeSHAP.
+
+Reference analog: ``Tree::TreeSHAP`` / ``GBDT::PredictContrib`` path
+(src/io/tree.cpp TreeSHAP implementation, from Lundberg et al.'s algorithm).
+Host NumPy implementation: contributions are an explainability feature, not a
+training-hot-path; per-row cost is O(num_leaves * depth^2) like the reference.
+
+Output layout matches LightGBM: ``[N, (num_features + 1) * num_class]`` with
+the last column per class being the expected value (bias).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .tree import (
+    K_CATEGORICAL_MASK,
+    K_DEFAULT_LEFT_MASK,
+    K_ZERO_THRESHOLD,
+    MISSING_NAN,
+    MISSING_ZERO,
+    Tree,
+)
+
+
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction", "pweight")
+
+    def __init__(self, feature_index=-1, zero_fraction=0.0, one_fraction=0.0, pweight=0.0):
+        self.feature_index = feature_index
+        self.zero_fraction = zero_fraction
+        self.one_fraction = one_fraction
+        self.pweight = pweight
+
+    def copy(self):
+        return _PathElement(
+            self.feature_index, self.zero_fraction, self.one_fraction, self.pweight
+        )
+
+
+def _extend_path(path: List[_PathElement], unique_depth: int, zero_fraction, one_fraction, feature_index):
+    path[unique_depth].feature_index = feature_index
+    path[unique_depth].zero_fraction = zero_fraction
+    path[unique_depth].one_fraction = one_fraction
+    path[unique_depth].pweight = 1.0 if unique_depth == 0 else 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1) / (unique_depth + 1)
+        path[i].pweight = zero_fraction * path[i].pweight * (unique_depth - i) / (unique_depth + 1)
+
+
+def _unwind_path(path: List[_PathElement], unique_depth: int, path_index: int):
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path[i].pweight
+            path[i].pweight = next_one_portion * (unique_depth + 1) / ((i + 1) * one_fraction)
+            next_one_portion = tmp - path[i].pweight * zero_fraction * (unique_depth - i) / (unique_depth + 1)
+        else:
+            path[i].pweight = path[i].pweight * (unique_depth + 1) / (zero_fraction * (unique_depth - i))
+    for i in range(path_index, unique_depth):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+
+
+def _unwound_path_sum(path: List[_PathElement], unique_depth: int, path_index: int) -> float:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    total = 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = next_one_portion * (unique_depth + 1) / ((i + 1) * one_fraction)
+            total += tmp
+            next_one_portion = path[i].pweight - tmp * zero_fraction * (unique_depth - i) / (unique_depth + 1)
+        else:
+            total += path[i].pweight / (zero_fraction * (unique_depth - i) / (unique_depth + 1))
+    return total
+
+
+def _decide_left(tree: Tree, node: int, fval: float) -> bool:
+    dt = int(tree.decision_type[node])
+    if dt & K_CATEGORICAL_MASK:
+        if np.isnan(fval) or fval < 0:
+            return False
+        int_fval = int(fval)
+        cat_idx = int(tree.threshold[node])
+        b0, b1 = tree.cat_boundaries[cat_idx], tree.cat_boundaries[cat_idx + 1]
+        w = int_fval // 32
+        return bool(
+            b0 + w < b1 and (int(tree.cat_threshold[b0 + w]) >> (int_fval % 32)) & 1
+        )
+    missing = (dt >> 2) & 3
+    if np.isnan(fval) and missing != MISSING_NAN:
+        fval = 0.0
+    if (missing == MISSING_ZERO and abs(fval) <= K_ZERO_THRESHOLD) or (
+        missing == MISSING_NAN and np.isnan(fval)
+    ):
+        return bool(dt & K_DEFAULT_LEFT_MASK)
+    return fval <= tree.threshold[node]
+
+
+def _node_weight(tree: Tree, node: int) -> float:
+    """Data count passing through a node (internal: internal_count; leaf: leaf_count)."""
+    if node < 0:
+        return float(tree.leaf_count[~node])
+    return float(tree.internal_count[node])
+
+
+def _tree_shap_recurse(
+    tree: Tree,
+    row: np.ndarray,
+    phi: np.ndarray,
+    node: int,
+    unique_depth: int,
+    parent_path: List[_PathElement],
+    parent_zero_fraction: float,
+    parent_one_fraction: float,
+    parent_feature_index: int,
+):
+    path = [p.copy() for p in parent_path[:unique_depth]] + [
+        _PathElement() for _ in range(2)
+    ]
+    # ensure capacity: depth+1 elements used
+    while len(path) < unique_depth + 2:
+        path.append(_PathElement())
+    _extend_path(path, unique_depth, parent_zero_fraction, parent_one_fraction, parent_feature_index)
+
+    if node < 0:  # leaf
+        leaf = ~node
+        for i in range(1, unique_depth + 1):
+            w = _unwound_path_sum(path, unique_depth, i)
+            el = path[i]
+            phi[el.feature_index] += (
+                w * (el.one_fraction - el.zero_fraction) * tree.leaf_value[leaf]
+            )
+        return
+
+    hot = (
+        int(tree.left_child[node])
+        if _decide_left(tree, node, float(row[tree.split_feature[node]]))
+        else int(tree.right_child[node])
+    )
+    cold = (
+        int(tree.right_child[node])
+        if hot == int(tree.left_child[node])
+        else int(tree.left_child[node])
+    )
+    w_node = max(_node_weight(tree, node), 1e-300)
+    hot_zero_fraction = _node_weight(tree, hot) / w_node
+    cold_zero_fraction = _node_weight(tree, cold) / w_node
+    incoming_zero_fraction = 1.0
+    incoming_one_fraction = 1.0
+
+    # if this feature already appears on the path, undo its previous split
+    feature = int(tree.split_feature[node])
+    path_index = -1
+    for i in range(1, unique_depth + 1):
+        if path[i].feature_index == feature:
+            path_index = i
+            break
+    if path_index >= 0:
+        incoming_zero_fraction = path[path_index].zero_fraction
+        incoming_one_fraction = path[path_index].one_fraction
+        _unwind_path(path, unique_depth, path_index)
+        unique_depth -= 1
+
+    _tree_shap_recurse(
+        tree,
+        row,
+        phi,
+        hot,
+        unique_depth + 1,
+        path,
+        hot_zero_fraction * incoming_zero_fraction,
+        incoming_one_fraction,
+        feature,
+    )
+    _tree_shap_recurse(
+        tree,
+        row,
+        phi,
+        cold,
+        unique_depth + 1,
+        path,
+        cold_zero_fraction * incoming_zero_fraction,
+        0.0,
+        feature,
+    )
+
+
+def tree_shap(tree: Tree, row: np.ndarray, num_features: int) -> np.ndarray:
+    """phi[num_features + 1]: per-feature contributions + expected value."""
+    phi = np.zeros(num_features + 1)
+    if tree.num_leaves <= 1:
+        phi[-1] = float(tree.leaf_value[0])
+        return phi
+    phi[-1] = tree_expected_value(tree)
+    _tree_shap_recurse(tree, row, phi, 0, 0, [], 1.0, 1.0, -1)
+    return phi
+
+
+def tree_expected_value(tree: Tree) -> float:
+    """Leaf-count weighted mean output (reference Tree expected value)."""
+    total = float(tree.leaf_count.sum())
+    if total <= 0:
+        return float(np.mean(tree.leaf_value[: tree.num_leaves]))
+    return float(
+        (tree.leaf_value[: tree.num_leaves] * tree.leaf_count[: tree.num_leaves]).sum()
+        / total
+    )
+
+
+def predict_contrib(booster, X: np.ndarray, t0: int, t1: int) -> np.ndarray:
+    """Booster-level pred_contrib (reference GBDT::PredictContrib)."""
+    k = booster.num_tree_per_iteration
+    num_f = booster.max_feature_idx + 1
+    n = X.shape[0]
+    out = np.zeros((n, k, num_f + 1))
+    for idx in range(t0, t1):
+        tree = booster.models_[idx]
+        kk = idx % k
+        for i in range(n):
+            out[i, kk] += tree_shap(tree, X[i], num_f)
+    if k == 1:
+        return out[:, 0, :]
+    return out.reshape(n, k * (num_f + 1))

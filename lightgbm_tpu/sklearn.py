@@ -1,0 +1,317 @@
+"""scikit-learn estimator API (reference: python-package/lightgbm/sklearn.py).
+
+LGBMModel/LGBMRegressor/LGBMClassifier/LGBMRanker with the same constructor
+parameters, fit/predict contracts, and fitted attributes (``booster_``,
+``best_iteration_``, ``best_score_``, ``feature_importances_``, ``classes_``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .callback import early_stopping as early_stopping_cb
+from .dataset import Dataset
+from .engine import train as engine_train
+
+
+class LGBMModel:
+    def __init__(
+        self,
+        boosting_type: str = "gbdt",
+        num_leaves: int = 31,
+        max_depth: int = -1,
+        learning_rate: float = 0.1,
+        n_estimators: int = 100,
+        subsample_for_bin: int = 200000,
+        objective: Optional[str] = None,
+        class_weight=None,
+        min_split_gain: float = 0.0,
+        min_child_weight: float = 1e-3,
+        min_child_samples: int = 20,
+        subsample: float = 1.0,
+        subsample_freq: int = 0,
+        colsample_bytree: float = 1.0,
+        reg_alpha: float = 0.0,
+        reg_lambda: float = 0.0,
+        random_state: Optional[int] = None,
+        n_jobs: int = -1,
+        importance_type: str = "split",
+        **kwargs: Any,
+    ):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.importance_type = importance_type
+        self._other_params = dict(kwargs)
+        self._Booster = None
+        self._evals_result: Dict = {}
+        self._best_iteration = -1
+        self._classes = None
+        self._n_classes = -1
+
+    # ------------------------------------------------------------- sklearn API
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        params = {
+            "boosting_type": self.boosting_type,
+            "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth,
+            "learning_rate": self.learning_rate,
+            "n_estimators": self.n_estimators,
+            "subsample_for_bin": self.subsample_for_bin,
+            "objective": self.objective,
+            "class_weight": self.class_weight,
+            "min_split_gain": self.min_split_gain,
+            "min_child_weight": self.min_child_weight,
+            "min_child_samples": self.min_child_samples,
+            "subsample": self.subsample,
+            "subsample_freq": self.subsample_freq,
+            "colsample_bytree": self.colsample_bytree,
+            "reg_alpha": self.reg_alpha,
+            "reg_lambda": self.reg_lambda,
+            "random_state": self.random_state,
+            "n_jobs": self.n_jobs,
+            "importance_type": self.importance_type,
+        }
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params: Any) -> "LGBMModel":
+        for key, value in params.items():
+            if hasattr(self, key):
+                setattr(self, key, value)
+            else:
+                self._other_params[key] = value
+        return self
+
+    def _default_objective(self) -> str:
+        return "regression"
+
+    def _lgb_params(self) -> Dict[str, Any]:
+        params = {
+            "boosting": self.boosting_type,
+            "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth,
+            "learning_rate": self.learning_rate,
+            "bin_construct_sample_cnt": self.subsample_for_bin,
+            "objective": self.objective or self._default_objective(),
+            "min_gain_to_split": self.min_split_gain,
+            "min_sum_hessian_in_leaf": self.min_child_weight,
+            "min_data_in_leaf": self.min_child_samples,
+            "bagging_fraction": self.subsample,
+            "bagging_freq": self.subsample_freq,
+            "feature_fraction": self.colsample_bytree,
+            "lambda_l1": self.reg_alpha,
+            "lambda_l2": self.reg_lambda,
+            "verbosity": -1,
+        }
+        if self.random_state is not None:
+            params["seed"] = self.random_state
+        params.update(self._other_params)
+        return params
+
+    def _sample_weight_with_class_weight(self, y, sample_weight):
+        if self.class_weight is None:
+            return sample_weight
+        classes, counts = np.unique(y, return_counts=True)
+        if self.class_weight == "balanced":
+            cw = {c: len(y) / (len(classes) * cnt) for c, cnt in zip(classes, counts)}
+        else:
+            cw = dict(self.class_weight)
+        w = np.asarray([cw.get(v, 1.0) for v in y], dtype=np.float64)
+        if sample_weight is not None:
+            w = w * np.asarray(sample_weight, dtype=np.float64)
+        return w
+
+    def fit(
+        self,
+        X,
+        y,
+        sample_weight=None,
+        init_score=None,
+        group=None,
+        eval_set=None,
+        eval_names=None,
+        eval_sample_weight=None,
+        eval_init_score=None,
+        eval_group=None,
+        eval_metric=None,
+        early_stopping_rounds: Optional[int] = None,
+        feature_name: Union[str, List[str]] = "auto",
+        categorical_feature: Union[str, List] = "auto",
+        callbacks: Optional[List[Callable]] = None,
+        init_model=None,
+    ) -> "LGBMModel":
+        params = self._lgb_params()
+        if eval_metric is not None:
+            params["metric"] = eval_metric
+        sample_weight = self._sample_weight_with_class_weight(y, sample_weight)
+        train_set = Dataset(
+            np.asarray(X, dtype=np.float64),
+            np.asarray(y, dtype=np.float64),
+            weight=sample_weight,
+            group=group,
+            init_score=init_score,
+            feature_name=feature_name,
+            categorical_feature=categorical_feature,
+            params=params,
+        )
+        valid_sets = []
+        valid_names = []
+        for i, pair in enumerate(eval_set or []):
+            vx, vy = pair
+            vw = eval_sample_weight[i] if eval_sample_weight else None
+            vg = eval_group[i] if eval_group else None
+            vi = eval_init_score[i] if eval_init_score else None
+            valid_sets.append(
+                train_set.create_valid(
+                    np.asarray(vx, dtype=np.float64),
+                    np.asarray(vy, dtype=np.float64),
+                    weight=vw,
+                    group=vg,
+                    init_score=vi,
+                )
+            )
+            valid_names.append(eval_names[i] if eval_names else f"valid_{i}")
+        callbacks = list(callbacks or [])
+        if early_stopping_rounds is not None and early_stopping_rounds > 0:
+            callbacks.append(early_stopping_cb(early_stopping_rounds))
+        from .callback import record_evaluation
+
+        self._evals_result = {}
+        callbacks.append(record_evaluation(self._evals_result))
+        self._Booster = engine_train(
+            params,
+            train_set,
+            num_boost_round=self.n_estimators,
+            valid_sets=valid_sets,
+            valid_names=valid_names,
+            callbacks=callbacks,
+            init_model=init_model,
+        )
+        self._best_iteration = self._Booster.best_iteration
+        return self
+
+    def predict(
+        self,
+        X,
+        raw_score: bool = False,
+        start_iteration: int = 0,
+        num_iteration: Optional[int] = None,
+        pred_leaf: bool = False,
+        pred_contrib: bool = False,
+        **kwargs,
+    ):
+        if self._Booster is None:
+            raise ValueError("Estimator not fitted, call fit first")
+        if num_iteration is None and self._best_iteration > 0:
+            num_iteration = self._best_iteration
+        return self._Booster.predict(
+            np.asarray(X, dtype=np.float64),
+            raw_score=raw_score,
+            start_iteration=start_iteration,
+            num_iteration=num_iteration,
+            pred_leaf=pred_leaf,
+            pred_contrib=pred_contrib,
+            **kwargs,
+        )
+
+    # --------------------------------------------------------------- fitted
+    @property
+    def booster_(self):
+        if self._Booster is None:
+            raise ValueError("Estimator not fitted")
+        return self._Booster
+
+    @property
+    def best_iteration_(self) -> int:
+        return self._best_iteration
+
+    @property
+    def best_score_(self):
+        return self._Booster.best_score if self._Booster else {}
+
+    @property
+    def evals_result_(self):
+        return self._evals_result
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        return self.booster_.feature_importance(self.importance_type)
+
+    @property
+    def n_features_(self) -> int:
+        return self.booster_.num_feature()
+
+    @property
+    def feature_name_(self) -> List[str]:
+        return self.booster_.feature_name()
+
+
+class LGBMRegressor(LGBMModel):
+    def _default_objective(self) -> str:
+        return "regression"
+
+
+class LGBMClassifier(LGBMModel):
+    def _default_objective(self) -> str:
+        return "binary" if (self._n_classes or 2) <= 2 else "multiclass"
+
+    def fit(self, X, y, **kwargs):
+        y = np.asarray(y)
+        self._classes = np.unique(y)
+        self._n_classes = len(self._classes)
+        y_enc = np.searchsorted(self._classes, y).astype(np.float64)
+        if self.objective is None:
+            if self._n_classes > 2:
+                self._other_params.setdefault("num_class", self._n_classes)
+        super().fit(X, y_enc, **kwargs)
+        return self
+
+    @property
+    def classes_(self):
+        return self._classes
+
+    @property
+    def n_classes_(self) -> int:
+        return self._n_classes
+
+    def predict_proba(self, X, **kwargs):
+        prob = super().predict(X, **kwargs)
+        if self._n_classes <= 2 and prob.ndim == 1:
+            return np.stack([1.0 - prob, prob], axis=1)
+        return prob
+
+    def predict(self, X, raw_score=False, pred_leaf=False, pred_contrib=False, **kwargs):
+        if raw_score or pred_leaf or pred_contrib:
+            return super().predict(
+                X, raw_score=raw_score, pred_leaf=pred_leaf, pred_contrib=pred_contrib, **kwargs
+            )
+        prob = self.predict_proba(X, **kwargs)
+        return self._classes[np.argmax(prob, axis=1)]
+
+
+class LGBMRanker(LGBMModel):
+    def _default_objective(self) -> str:
+        return "lambdarank"
+
+    def fit(self, X, y, group=None, **kwargs):
+        if group is None:
+            raise ValueError("LGBMRanker requires the group parameter")
+        return super().fit(X, y, group=group, **kwargs)

@@ -1,0 +1,466 @@
+"""Host-side tree model: real-valued thresholds, serialization, prediction.
+
+Reference analogs: ``Tree`` (include/LightGBM/tree.h:497 SoA arrays,
+NumericalDecision :346, CategoricalDecision :382), text round-trip
+``Tree::ToString`` (src/io/tree.cpp:343) / ``Tree(const char*, size_t*)``.
+
+The device-side grower (ops/grower.py) emits bin-space TreeArrays; this module
+materializes them into the reference's representation — original feature
+indices, real-valued thresholds, decision_type bitfield — so the text model
+format matches LightGBM's and models interoperate both ways.
+
+Categorical splits are stored the reference way: ``threshold`` holds an index
+into ``cat_boundaries_``/``cat_threshold_`` bitsets of category values that go
+left (tree.h:87 SplitCategorical).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+# decision_type bit layout (reference include/LightGBM/tree.h:21-22, :283)
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+K_ZERO_THRESHOLD = 1e-35
+
+
+def _missing_type_of(decision_type: int) -> int:
+    return (decision_type >> 2) & 3
+
+
+def _make_decision_type(categorical: bool, default_left: bool, missing_type: int) -> int:
+    dt = 0
+    if categorical:
+        dt |= K_CATEGORICAL_MASK
+    if default_left:
+        dt |= K_DEFAULT_LEFT_MASK
+    dt |= (missing_type & 3) << 2
+    return dt
+
+
+def _fmt(x: float) -> str:
+    """High-precision float formatting like the reference's ArrayToString<true>."""
+    return repr(float(x)) if np.isfinite(x) else ("inf" if x > 0 else "-inf")
+
+
+def _arr_str(arr, high_precision: bool = False) -> str:
+    if high_precision:
+        return " ".join(_fmt(v) for v in arr)
+    out = []
+    for v in arr:
+        if isinstance(v, (bool, np.bool_)):
+            out.append(str(int(v)))
+        elif float(v).is_integer() and not isinstance(v, (float, np.floating)):
+            out.append(str(int(v)))
+        elif isinstance(v, (int, np.integer)):
+            out.append(str(int(v)))
+        else:
+            out.append(f"{float(v):g}")
+    return " ".join(out)
+
+
+@dataclasses.dataclass
+class Tree:
+    """One decision tree in reference representation (SoA over nodes/leaves)."""
+
+    num_leaves: int
+    split_feature: np.ndarray  # [n-1] int32, ORIGINAL feature index
+    split_gain: np.ndarray  # [n-1] f32
+    threshold: np.ndarray  # [n-1] f64 (real value; cat: index into cat_boundaries)
+    decision_type: np.ndarray  # [n-1] int8 bitfield
+    left_child: np.ndarray  # [n-1] int32 (neg = ~leaf)
+    right_child: np.ndarray  # [n-1] int32
+    leaf_value: np.ndarray  # [n] f64
+    leaf_weight: np.ndarray  # [n] f64
+    leaf_count: np.ndarray  # [n] int64
+    internal_value: np.ndarray  # [n-1] f64
+    internal_weight: np.ndarray  # [n-1] f64
+    internal_count: np.ndarray  # [n-1] int64
+    shrinkage: float = 1.0
+    # categorical split storage (reference tree.h cat_boundaries_/cat_threshold_)
+    num_cat: int = 0
+    cat_boundaries: Optional[np.ndarray] = None  # [num_cat+1] int32 (word offsets)
+    cat_threshold: Optional[np.ndarray] = None  # uint32 bitset words
+    # per-leaf linear models (linear_tree)
+    is_linear: bool = False
+    leaf_const: Optional[np.ndarray] = None  # [n] f64
+    leaf_features: Optional[List[np.ndarray]] = None  # per-leaf orig feature idx
+    leaf_coeff: Optional[List[np.ndarray]] = None  # per-leaf f64 coefficients
+
+    # ------------------------------------------------------------------ build
+    @classmethod
+    def from_device_arrays(
+        cls,
+        ta,  # ops.grower.TreeArrays pulled to host (numpy-compatible)
+        bin_mappers,  # List[BinMapper] for ALL original features
+        used_features: Sequence[int],  # used-col -> original feature index
+    ) -> "Tree":
+        """Materialize bin-space device TreeArrays into a real-valued Tree."""
+        n = int(ta.num_leaves)
+        nn = max(n - 1, 0)
+        split_feature_used = np.asarray(ta.split_feature)[:nn]
+        split_bin = np.asarray(ta.split_bin)[:nn]
+        default_left = np.asarray(ta.default_left)[:nn]
+
+        split_feature = np.zeros(nn, dtype=np.int32)
+        threshold = np.zeros(nn, dtype=np.float64)
+        decision_type = np.zeros(nn, dtype=np.int8)
+        cat_boundaries = [0]
+        cat_threshold: List[int] = []
+        num_cat = 0
+        for t in range(nn):
+            orig = used_features[int(split_feature_used[t])]
+            split_feature[t] = orig
+            mapper = bin_mappers[orig]
+            if mapper.is_categorical:
+                # left = categories whose frequency-ordered bin index <= split_bin
+                cats = mapper.bin_to_cat[: int(split_bin[t]) + 1]
+                max_cat = int(cats.max()) if len(cats) else 0
+                words = [0] * (max_cat // 32 + 1)
+                for c in cats:
+                    words[int(c) // 32] |= 1 << (int(c) % 32)
+                threshold[t] = num_cat
+                cat_threshold.extend(words)
+                cat_boundaries.append(len(cat_threshold))
+                num_cat += 1
+                decision_type[t] = _make_decision_type(True, False, mapper.missing_type)
+            else:
+                threshold[t] = mapper.bin_to_threshold(int(split_bin[t]))
+                decision_type[t] = _make_decision_type(
+                    False, bool(default_left[t]), mapper.missing_type
+                )
+
+        return cls(
+            num_leaves=n,
+            split_feature=split_feature,
+            split_gain=np.asarray(ta.split_gain, dtype=np.float64)[:nn],
+            threshold=threshold,
+            decision_type=decision_type,
+            left_child=np.asarray(ta.left_child, dtype=np.int32)[:nn],
+            right_child=np.asarray(ta.right_child, dtype=np.int32)[:nn],
+            leaf_value=np.asarray(ta.leaf_value, dtype=np.float64)[:n],
+            leaf_weight=np.asarray(ta.leaf_weight, dtype=np.float64)[:n],
+            leaf_count=np.asarray(ta.leaf_count, dtype=np.int64)[:n],
+            internal_value=np.asarray(ta.internal_value, dtype=np.float64)[:nn],
+            internal_weight=np.asarray(ta.internal_weight, dtype=np.float64)[:nn],
+            internal_count=np.asarray(ta.internal_count, dtype=np.int64)[:nn],
+            shrinkage=1.0,
+            num_cat=num_cat,
+            cat_boundaries=np.asarray(cat_boundaries, dtype=np.int64) if num_cat else None,
+            cat_threshold=np.asarray(cat_threshold, dtype=np.uint32) if num_cat else None,
+        )
+
+    # ---------------------------------------------------------------- mutate
+    def apply_shrinkage(self, rate: float) -> None:
+        """Tree::Shrinkage (include/LightGBM/tree.h:197)."""
+        self.leaf_value = self.leaf_value * rate
+        self.internal_value = self.internal_value * rate
+        if self.is_linear and self.leaf_const is not None:
+            self.leaf_const = self.leaf_const * rate
+            self.leaf_coeff = [c * rate for c in self.leaf_coeff]
+        self.shrinkage *= rate
+
+    def set_leaf_values(self, values: np.ndarray) -> None:
+        self.leaf_value = np.asarray(values, dtype=np.float64)[: self.num_leaves]
+
+    def add_bias(self, val: float) -> None:
+        """Tree::AddBias — used by boost_from_average fold-in."""
+        self.leaf_value = self.leaf_value + val
+        self.internal_value = self.internal_value + val
+
+    @classmethod
+    def constant_tree(cls, val: float = 0.0) -> "Tree":
+        """Tree::AsConstantTree — single-leaf tree."""
+        z = np.zeros(0)
+        zi = np.zeros(0, dtype=np.int32)
+        return cls(
+            num_leaves=1,
+            split_feature=zi,
+            split_gain=z,
+            threshold=z,
+            decision_type=np.zeros(0, dtype=np.int8),
+            left_child=zi,
+            right_child=zi,
+            leaf_value=np.array([val]),
+            leaf_weight=np.zeros(1),
+            leaf_count=np.zeros(1, dtype=np.int64),
+            internal_value=z,
+            internal_weight=z,
+            internal_count=np.zeros(0, dtype=np.int64),
+        )
+
+    # --------------------------------------------------------------- predict
+    def _decide(self, fval: float, node: int) -> int:
+        dt = int(self.decision_type[node])
+        if dt & K_CATEGORICAL_MASK:
+            if np.isnan(fval) or fval < 0:
+                return int(self.right_child[node])
+            int_fval = int(fval)
+            cat_idx = int(self.threshold[node])
+            b0, b1 = self.cat_boundaries[cat_idx], self.cat_boundaries[cat_idx + 1]
+            w = int_fval // 32
+            if b0 + w < b1 and (int(self.cat_threshold[b0 + w]) >> (int_fval % 32)) & 1:
+                return int(self.left_child[node])
+            return int(self.right_child[node])
+        missing = _missing_type_of(dt)
+        if np.isnan(fval) and missing != MISSING_NAN:
+            fval = 0.0
+        if (missing == MISSING_ZERO and abs(fval) <= K_ZERO_THRESHOLD) or (
+            missing == MISSING_NAN and np.isnan(fval)
+        ):
+            return int(self.left_child[node]) if dt & K_DEFAULT_LEFT_MASK else int(self.right_child[node])
+        return int(self.left_child[node]) if fval <= self.threshold[node] else int(self.right_child[node])
+
+    def predict_leaf(self, row: np.ndarray) -> int:
+        """Per-row leaf index (reference Tree::PredictLeafIndex)."""
+        if self.num_leaves <= 1:
+            return 0
+        node = 0
+        while node >= 0:
+            node = self._decide(float(row[self.split_feature[node]]), node)
+        return ~node
+
+    def predict_row(self, row: np.ndarray) -> float:
+        leaf = self.predict_leaf(row)
+        out = float(self.leaf_value[leaf])
+        if self.is_linear and self.leaf_coeff is not None:
+            feats = self.leaf_features[leaf]
+            if len(feats):
+                vals = row[feats]
+                if np.isnan(vals).any():
+                    return out
+                out = float(self.leaf_const[leaf] + (self.leaf_coeff[leaf] * vals).sum())
+        return out
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Vectorized level-synchronous batch walk (the fork's
+        tree_avx512.hpp:41 idea, full-width instead of 8 rows)."""
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.full(n, float(self.leaf_value[0]))
+        nodes = np.zeros(n, dtype=np.int64)
+        while True:
+            active = nodes >= 0
+            if not active.any():
+                break
+            idx = np.nonzero(active)[0]
+            cur = nodes[idx]
+            feat = self.split_feature[cur]
+            fval = X[idx, feat].astype(np.float64)
+            dt = self.decision_type[cur].astype(np.int64)
+            is_cat = (dt & K_CATEGORICAL_MASK) != 0
+            left = self.left_child[cur]
+            right = self.right_child[cur]
+            go_left = np.zeros(len(idx), dtype=bool)
+
+            num = ~is_cat
+            if num.any():
+                missing = (dt >> 2) & 3
+                v = fval.copy()
+                nanv = np.isnan(v)
+                v[nanv & (missing != MISSING_NAN)] = 0.0
+                is_missing = ((missing == MISSING_ZERO) & (np.abs(v) <= K_ZERO_THRESHOLD)) | (
+                    (missing == MISSING_NAN) & np.isnan(v)
+                )
+                dl = (dt & K_DEFAULT_LEFT_MASK) != 0
+                gl = np.where(is_missing, dl, v <= self.threshold[cur])
+                go_left[num] = gl[num]
+            if is_cat.any():
+                ci = np.nonzero(is_cat)[0]
+                for k in ci:
+                    fv = fval[k]
+                    if np.isnan(fv) or fv < 0:
+                        go_left[k] = False
+                        continue
+                    int_fval = int(fv)
+                    cat_idx = int(self.threshold[cur[k]])
+                    b0 = self.cat_boundaries[cat_idx]
+                    b1 = self.cat_boundaries[cat_idx + 1]
+                    w = int_fval // 32
+                    go_left[k] = bool(
+                        b0 + w < b1
+                        and (int(self.cat_threshold[b0 + w]) >> (int_fval % 32)) & 1
+                    )
+            nodes[idx] = np.where(go_left, left, right)
+        leaves = ~nodes
+        out = self.leaf_value[leaves]
+        if self.is_linear and self.leaf_coeff is not None:
+            for i in range(n):
+                leaf = leaves[i]
+                feats = self.leaf_features[leaf]
+                if len(feats):
+                    vals = X[i, feats]
+                    if not np.isnan(vals).any():
+                        out[i] = self.leaf_const[leaf] + (self.leaf_coeff[leaf] * vals).sum()
+        return out
+
+    # ----------------------------------------------------------- serialization
+    def to_string(self, tree_index: int) -> str:
+        """LightGBM text format (reference Tree::ToString, src/io/tree.cpp:343)."""
+        n = self.num_leaves
+        lines = [f"Tree={tree_index}"]
+        lines.append(f"num_leaves={n}")
+        lines.append(f"num_cat={self.num_cat}")
+        lines.append("split_feature=" + _arr_str(self.split_feature))
+        lines.append("split_gain=" + _arr_str(self.split_gain))
+        lines.append("threshold=" + _arr_str(self.threshold, high_precision=True))
+        lines.append("decision_type=" + _arr_str(self.decision_type))
+        lines.append("left_child=" + _arr_str(self.left_child))
+        lines.append("right_child=" + _arr_str(self.right_child))
+        lines.append("leaf_value=" + _arr_str(self.leaf_value, high_precision=True))
+        lines.append("leaf_weight=" + _arr_str(self.leaf_weight, high_precision=True))
+        lines.append("leaf_count=" + _arr_str(self.leaf_count))
+        lines.append("internal_value=" + _arr_str(self.internal_value))
+        lines.append("internal_weight=" + _arr_str(self.internal_weight))
+        lines.append("internal_count=" + _arr_str(self.internal_count))
+        if self.num_cat > 0:
+            lines.append("cat_boundaries=" + _arr_str(self.cat_boundaries))
+            lines.append("cat_threshold=" + _arr_str(self.cat_threshold))
+        lines.append(f"is_linear={int(self.is_linear)}")
+        if self.is_linear:
+            lines.append("leaf_const=" + _arr_str(self.leaf_const, high_precision=True))
+            num_feat = [len(f) for f in self.leaf_features]
+            lines.append("num_features=" + _arr_str(num_feat))
+            lf = []
+            for f in self.leaf_features:
+                if len(f):
+                    lf.append(_arr_str(f) + " ")
+                lf.append(" ")
+            lines.append("leaf_features=" + "".join(lf).rstrip())
+            lc = []
+            for c in self.leaf_coeff:
+                if len(c):
+                    lc.append(_arr_str(c, high_precision=True) + " ")
+                lc.append(" ")
+            lines.append("leaf_coeff=" + "".join(lc).rstrip())
+        lines.append(f"shrinkage={self.shrinkage:g}")
+        lines.append("")
+        lines.append("")
+        return "\n".join(lines)
+
+    @classmethod
+    def from_string(cls, block: str) -> "Tree":
+        """Parse one Tree= block of a model file (reference Tree ctor from
+        string, src/io/tree.cpp:714)."""
+        kv = {}
+        for line in block.splitlines():
+            line = line.strip()
+            if not line or line.startswith("Tree="):
+                continue
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k] = v
+
+        def ints(key, default=None):
+            if key not in kv:
+                return default
+            s = kv[key].split()
+            return np.asarray([int(float(x)) for x in s], dtype=np.int64)
+
+        def floats(key, default=None):
+            if key not in kv:
+                return default
+            return np.asarray([float(x) for x in kv[key].split()], dtype=np.float64)
+
+        n = int(kv["num_leaves"])
+        num_cat = int(kv.get("num_cat", 0))
+        nn = max(n - 1, 0)
+        tree = cls(
+            num_leaves=n,
+            split_feature=(ints("split_feature", np.zeros(nn))).astype(np.int32),
+            split_gain=floats("split_gain", np.zeros(nn)),
+            threshold=floats("threshold", np.zeros(nn)),
+            decision_type=(ints("decision_type", np.zeros(nn))).astype(np.int8),
+            left_child=(ints("left_child", np.zeros(nn))).astype(np.int32),
+            right_child=(ints("right_child", np.zeros(nn))).astype(np.int32),
+            leaf_value=floats("leaf_value", np.zeros(n)),
+            leaf_weight=floats("leaf_weight", np.zeros(n)),
+            leaf_count=ints("leaf_count", np.zeros(n, dtype=np.int64)),
+            internal_value=floats("internal_value", np.zeros(nn)),
+            internal_weight=floats("internal_weight", np.zeros(nn)),
+            internal_count=ints("internal_count", np.zeros(nn, dtype=np.int64)),
+            shrinkage=float(kv.get("shrinkage", 1.0)),
+            num_cat=num_cat,
+        )
+        if num_cat > 0:
+            tree.cat_boundaries = ints("cat_boundaries")
+            tree.cat_threshold = ints("cat_threshold").astype(np.uint32)
+        if int(kv.get("is_linear", 0)):
+            tree.is_linear = True
+            tree.leaf_const = floats("leaf_const", np.zeros(n))
+            num_feat = ints("num_features", np.zeros(n, dtype=np.int64))
+            feats_flat = kv.get("leaf_features", "").split()
+            coefs_flat = kv.get("leaf_coeff", "").split()
+            tree.leaf_features = []
+            tree.leaf_coeff = []
+            fpos = cpos = 0
+            for i in range(n):
+                k = int(num_feat[i])
+                tree.leaf_features.append(
+                    np.asarray([int(x) for x in feats_flat[fpos : fpos + k]], dtype=np.int32)
+                )
+                tree.leaf_coeff.append(
+                    np.asarray([float(x) for x in coefs_flat[cpos : cpos + k]])
+                )
+                fpos += k
+                cpos += k
+        return tree
+
+    def to_json(self) -> dict:
+        """Structured dump (reference Tree::ToJSON, src/io/tree.cpp:418)."""
+
+        def node(i: int) -> dict:
+            if i < 0:
+                leaf = ~i
+                return {
+                    "leaf_index": int(leaf),
+                    "leaf_value": float(self.leaf_value[leaf]),
+                    "leaf_weight": float(self.leaf_weight[leaf]),
+                    "leaf_count": int(self.leaf_count[leaf]),
+                }
+            dt = int(self.decision_type[i])
+            is_cat = bool(dt & K_CATEGORICAL_MASK)
+            missing = _missing_type_of(dt)
+            d = {
+                "split_index": int(i),
+                "split_feature": int(self.split_feature[i]),
+                "split_gain": float(self.split_gain[i]),
+                "threshold": float(self.threshold[i]),
+                "decision_type": "==" if is_cat else "<=",
+                "default_left": bool(dt & K_DEFAULT_LEFT_MASK),
+                "missing_type": ["None", "Zero", "NaN"][missing],
+                "internal_value": float(self.internal_value[i]),
+                "internal_weight": float(self.internal_weight[i]),
+                "internal_count": int(self.internal_count[i]),
+                "left_child": node(int(self.left_child[i])),
+                "right_child": node(int(self.right_child[i])),
+            }
+            return d
+
+        return {
+            "num_leaves": int(self.num_leaves),
+            "num_cat": int(self.num_cat),
+            "shrinkage": float(self.shrinkage),
+            "tree_structure": node(0 if self.num_leaves > 1 else ~0),
+        }
+
+    # ------------------------------------------------------------ importance
+    def split_counts(self, num_features: int) -> np.ndarray:
+        out = np.zeros(num_features)
+        for f in self.split_feature:
+            out[int(f)] += 1
+        return out
+
+    def gain_sums(self, num_features: int) -> np.ndarray:
+        out = np.zeros(num_features)
+        for f, g in zip(self.split_feature, self.split_gain):
+            out[int(f)] += float(g)
+        return out

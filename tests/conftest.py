@@ -1,13 +1,28 @@
-"""Test config: run on a virtual 8-device CPU mesh.
+"""Test config: make an 8-device virtual CPU mesh available.
 
 Multi-chip hardware isn't available in CI; sharding tests run over
 ``--xla_force_host_platform_device_count=8`` as the reference's distributed
 tests run N CLI processes on localhost (tests/distributed/_test_distributed.py).
+
+jax may already be imported (sitecustomize preloads the TPU tunnel), so the
+flag is injected before the FIRST CPU client creation — the CPU backend is
+lazy, which keeps this effective; tests that need the mesh use
+``jax.devices("cpu")`` explicitly.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+
+    devs = jax.devices("cpu")
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

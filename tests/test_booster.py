@@ -1,0 +1,193 @@
+"""End-to-end Booster tests (the reference's test_engine.py style: train ->
+eval -> predict assertions per objective family, model IO round-trip,
+early stopping, continued training)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+RNG = np.random.default_rng(0)
+N, F = 600, 6
+X = RNG.normal(size=(N, F))
+Y_REG = X[:, 0] * 2 + np.sin(3 * X[:, 1]) + RNG.normal(scale=0.1, size=N)
+Y_BIN = ((X[:, 0] - X[:, 1] + RNG.normal(scale=0.4, size=N)) > 0).astype(np.float64)
+
+PARAMS = {"verbosity": -1, "num_leaves": 15, "learning_rate": 0.1, "min_data_in_leaf": 5}
+
+
+def test_regression_improves_and_roundtrips():
+    d = lgb.Dataset(X, Y_REG)
+    b = lgb.train({**PARAMS, "objective": "regression"}, d, 30)
+    p = b.predict(X)
+    assert np.mean((p - Y_REG) ** 2) < 0.2 * np.var(Y_REG)
+    s = b.model_to_string()
+    b2 = lgb.Booster(model_str=s)
+    np.testing.assert_array_equal(b2.predict(X), p)
+    # loaded model predicts without any Dataset attached (real-space walker)
+    assert b2.train_set is None
+
+
+def test_binary_probabilities():
+    d = lgb.Dataset(X, Y_BIN)
+    b = lgb.train({**PARAMS, "objective": "binary"}, d, 30)
+    p = b.predict(X)
+    assert p.min() >= 0 and p.max() <= 1
+    assert ((p > 0.5) == Y_BIN).mean() > 0.85
+    raw = b.predict(X, raw_score=True)
+    np.testing.assert_allclose(p, 1 / (1 + np.exp(-raw)), rtol=1e-5, atol=1e-6)
+
+
+def test_multiclass_softmax_output():
+    y3 = np.argmax(X[:, :3], axis=1).astype(np.float64)
+    d = lgb.Dataset(X, y3)
+    b = lgb.train({**PARAMS, "objective": "multiclass", "num_class": 3}, d, 20)
+    p = b.predict(X)
+    assert p.shape == (N, 3)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-4)
+    assert (np.argmax(p, axis=1) == y3).mean() > 0.85
+
+
+def test_early_stopping_and_best_iteration_predict():
+    d = lgb.Dataset(X[:400], Y_REG[:400], free_raw_data=False)
+    dv = d.create_valid(X[400:], Y_REG[400:])
+    b = lgb.train(
+        {**PARAMS, "objective": "regression"},
+        d,
+        200,
+        valid_sets=[dv],
+        callbacks=[lgb.early_stopping(5, verbose=False)],
+    )
+    assert 0 < b.best_iteration < 200
+    # default predict uses best_iteration
+    p_default = b.predict(X[400:])
+    p_best = b.predict(X[400:], num_iteration=b.best_iteration)
+    np.testing.assert_array_equal(p_default, p_best)
+    p_all = b.predict(X[400:], num_iteration=-1)
+    assert b.num_trees() == b.current_iteration()
+
+
+def test_weights_change_model():
+    w = np.where(X[:, 0] > 0, 5.0, 0.1)
+    d1 = lgb.Dataset(X, Y_REG)
+    d2 = lgb.Dataset(X, Y_REG, weight=w)
+    b1 = lgb.train({**PARAMS, "objective": "regression"}, d1, 10)
+    b2 = lgb.train({**PARAMS, "objective": "regression"}, d2, 10)
+    assert not np.allclose(b1.predict(X), b2.predict(X))
+
+
+def test_bagging_and_feature_fraction():
+    d = lgb.Dataset(X, Y_REG)
+    b = lgb.train(
+        {
+            **PARAMS,
+            "objective": "regression",
+            "bagging_fraction": 0.6,
+            "bagging_freq": 1,
+            "feature_fraction": 0.7,
+        },
+        d,
+        15,
+    )
+    p = b.predict(X)
+    assert np.mean((p - Y_REG) ** 2) < 0.5 * np.var(Y_REG)
+
+
+def test_goss():
+    d = lgb.Dataset(X, Y_REG)
+    b = lgb.train(
+        {**PARAMS, "objective": "regression", "boosting": "goss"}, d, 25
+    )
+    assert np.mean((b.predict(X) - Y_REG) ** 2) < 0.3 * np.var(Y_REG)
+
+
+def test_dart():
+    d = lgb.Dataset(X, Y_REG)
+    b = lgb.train(
+        {**PARAMS, "objective": "regression", "boosting": "dart", "drop_rate": 0.3},
+        d,
+        20,
+    )
+    assert np.mean((b.predict(X) - Y_REG) ** 2) < 0.6 * np.var(Y_REG)
+
+
+def test_rf():
+    d = lgb.Dataset(X, Y_REG)
+    b = lgb.train(
+        {
+            **PARAMS,
+            "objective": "regression",
+            "boosting": "rf",
+            "bagging_fraction": 0.7,
+            "bagging_freq": 1,
+        },
+        d,
+        15,
+    )
+    p = b.predict(X)
+    # averaged forest output must be in the label range neighborhood
+    assert np.mean((p - Y_REG) ** 2) < np.var(Y_REG)
+
+
+def test_continued_training():
+    d = lgb.Dataset(X, Y_REG, free_raw_data=False)
+    b1 = lgb.train({**PARAMS, "objective": "regression"}, d, 10)
+    l1 = np.mean((b1.predict(X) - Y_REG) ** 2)
+    b2 = lgb.train({**PARAMS, "objective": "regression"}, d, 10, init_model=b1)
+    l2 = np.mean((b2.predict(X) - Y_REG) ** 2)
+    assert b2.num_trees() == 20
+    assert l2 < l1
+
+
+def test_pred_leaf_and_contrib():
+    d = lgb.Dataset(X, Y_REG)
+    b = lgb.train({**PARAMS, "objective": "regression"}, d, 8)
+    leaves = b.predict(X[:20], pred_leaf=True)
+    assert leaves.shape == (20, 8)
+    assert leaves.max() < PARAMS["num_leaves"]
+    contrib = b.predict(X[:10], pred_contrib=True)
+    raw = b.predict(X[:10], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-5, atol=1e-5)
+
+
+def test_categorical_feature():
+    rng = np.random.default_rng(9)
+    Xc = X.copy()
+    cats = rng.integers(0, 5, size=N).astype(np.float64)
+    Xc[:, 3] = cats
+    effect = np.array([2.0, -1.0, 0.5, 3.0, -2.0])
+    yc = effect[cats.astype(int)] + 0.2 * Xc[:, 0] + rng.normal(scale=0.1, size=N)
+    d = lgb.Dataset(Xc, yc, categorical_feature=[3])
+    b = lgb.train({**PARAMS, "objective": "regression"}, d, 25)
+    p = b.predict(Xc)
+    assert np.mean((p - yc) ** 2) < 0.1 * np.var(yc)
+    # model round-trip with categorical splits
+    b2 = lgb.Booster(model_str=b.model_to_string())
+    np.testing.assert_allclose(b2.predict(Xc), p, rtol=1e-5, atol=1e-5)
+
+
+def test_cv_runs():
+    d = lgb.Dataset(X, Y_REG, free_raw_data=False)
+    res = lgb.cv({**PARAMS, "objective": "regression", "metric": "l2"}, d, 5, nfold=3)
+    assert len(res["valid l2-mean"]) == 5
+    assert res["valid l2-mean"][-1] < res["valid l2-mean"][0]
+
+
+def test_sklearn_classifier():
+    clf = lgb.LGBMClassifier(n_estimators=15, num_leaves=15, verbosity=-1)
+    clf.fit(X, Y_BIN)
+    acc = (clf.predict(X) == Y_BIN).mean()
+    assert acc > 0.85
+    proba = clf.predict_proba(X)
+    assert proba.shape == (N, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+
+
+def test_feature_importance():
+    d = lgb.Dataset(X, Y_REG)
+    b = lgb.train({**PARAMS, "objective": "regression"}, d, 10)
+    imp_split = b.feature_importance("split")
+    imp_gain = b.feature_importance("gain")
+    assert imp_split.sum() > 0
+    # features 0 and 1 carry the signal
+    assert imp_gain[0] + imp_gain[1] > imp_gain[2:].sum()

@@ -1,0 +1,178 @@
+"""Objective gradient/hessian parity vs NumPy oracles.
+
+Mirrors the reference's objective math (src/objective/*.hpp); each case
+cross-checks get_gradients against a direct NumPy transcription.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.objectives import create_objective  # noqa: E402
+
+N = 64
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    score = rng.normal(size=N).astype(np.float32)
+    label = rng.normal(size=N)
+    weight = rng.uniform(0.5, 2.0, size=N)
+    return score, label, weight
+
+
+def _grads(obj_name, score, label, weight=None, extra=None):
+    params = {"objective": obj_name}
+    params.update(extra or {})
+    cfg = Config.from_params(params)
+    obj = create_objective(cfg)
+    obj.init(label, weight)
+    g, h = obj.get_gradients(jnp.asarray(score)[None])
+    return np.asarray(g[0], dtype=np.float64), np.asarray(h[0], dtype=np.float64), obj
+
+
+def test_l2(data):
+    score, label, weight = data
+    g, h, _ = _grads("regression", score, label)
+    np.testing.assert_allclose(g, score - label, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h, np.ones(N))
+
+
+def test_l2_weighted(data):
+    score, label, weight = data
+    g, h, _ = _grads("regression", score, label, weight)
+    np.testing.assert_allclose(g, (score - label) * weight, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h, weight, rtol=1e-5)
+
+
+def test_l1(data):
+    score, label, _ = data
+    g, h, _ = _grads("regression_l1", score, label)
+    np.testing.assert_allclose(g, np.sign(score - label), atol=1e-6)
+
+
+def test_huber(data):
+    score, label, _ = data
+    g, h, _ = _grads("huber", score, label, extra={"alpha": 0.5})
+    diff = score.astype(np.float64) - label
+    expect = np.where(np.abs(diff) <= 0.5, diff, 0.5 * np.sign(diff))
+    np.testing.assert_allclose(g, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_fair(data):
+    score, label, _ = data
+    g, h, _ = _grads("fair", score, label, extra={"fair_c": 1.0})
+    x = score.astype(np.float64) - label
+    np.testing.assert_allclose(g, x / (np.abs(x) + 1.0), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h, 1.0 / (np.abs(x) + 1.0) ** 2, rtol=1e-4, atol=1e-5)
+
+
+def test_poisson(data):
+    score, label, _ = data
+    pos_label = np.abs(label) + 0.1
+    g, h, obj = _grads("poisson", score, pos_label)
+    es = np.exp(score.astype(np.float64))
+    np.testing.assert_allclose(g, es - pos_label, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(h, es * np.exp(0.7), rtol=1e-4, atol=1e-4)
+    # boost-from-score is log of the mean
+    assert obj.boost_from_score() == pytest.approx(np.log(pos_label.mean()), rel=1e-6)
+
+
+def test_quantile(data):
+    score, label, _ = data
+    g, h, _ = _grads("quantile", score, label, extra={"alpha": 0.3})
+    delta = score.astype(np.float64) - label
+    expect = np.where(delta >= 0, 0.7, -0.3)
+    np.testing.assert_allclose(g, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_gamma_tweedie(data):
+    score, label, _ = data
+    pos_label = np.abs(label) + 0.1
+    g, h, _ = _grads("gamma", score, pos_label)
+    en = np.exp(-score.astype(np.float64))
+    np.testing.assert_allclose(g, 1.0 - pos_label * en, rtol=1e-4, atol=1e-4)
+    g2, h2, _ = _grads("tweedie", score, pos_label, extra={"tweedie_variance_power": 1.3})
+    s = score.astype(np.float64)
+    e1, e2 = np.exp(-0.3 * s), np.exp(0.7 * s)
+    np.testing.assert_allclose(g2, -pos_label * e1 + e2, rtol=1e-3, atol=1e-3)
+
+
+def test_binary(data):
+    score, _, _ = data
+    y01 = (np.random.default_rng(3).random(N) > 0.5).astype(np.float64)
+    g, h, obj = _grads("binary", score, y01)
+    yy = np.where(y01 > 0, 1.0, -1.0)
+    resp = -yy / (1.0 + np.exp(yy * score.astype(np.float64)))
+    np.testing.assert_allclose(g, resp, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h, np.abs(resp) * (1.0 - np.abs(resp)), rtol=1e-4, atol=1e-5)
+    p = y01.mean()
+    assert obj.boost_from_score() == pytest.approx(np.log(p / (1 - p)), rel=1e-6)
+
+
+def test_multiclass_softmax():
+    rng = np.random.default_rng(5)
+    k, n = 3, 32
+    score = rng.normal(size=(k, n)).astype(np.float32)
+    label = rng.integers(0, k, size=n).astype(np.float64)
+    cfg = Config.from_params({"objective": "multiclass", "num_class": k})
+    obj = create_objective(cfg)
+    obj.init(label, None)
+    g, h = obj.get_gradients(jnp.asarray(score))
+    sm = np.exp(score) / np.exp(score).sum(axis=0, keepdims=True)
+    onehot = np.eye(k)[label.astype(int)].T
+    np.testing.assert_allclose(np.asarray(g), sm - onehot, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(h), (k / (k - 1.0)) * sm * (1 - sm), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_lambdarank_directions():
+    # higher-labeled items must get negative gradients (pushed up)
+    n_q, qs = 4, 8
+    rng = np.random.default_rng(11)
+    label = np.tile(np.arange(qs) % 4, n_q).astype(np.float64)
+    score = rng.normal(size=n_q * qs).astype(np.float32)
+    cfg = Config.from_params({"objective": "lambdarank"})
+    obj = create_objective(cfg)
+    obj.init(label, None, query_boundaries=np.arange(0, (n_q + 1) * qs, qs))
+    g, h = obj.get_gradients(jnp.asarray(score)[None])
+    g = np.asarray(g[0])
+    h = np.asarray(h[0])
+    assert np.all(h >= -1e-6)
+    # per query, mean gradient of top-label items < mean of bottom-label items
+    for q in range(n_q):
+        seg = slice(q * qs, (q + 1) * qs)
+        gl, ll = g[seg], label[seg]
+        assert gl[ll == 3].mean() < gl[ll == 0].mean()
+
+
+def test_xendcg_zero_sum():
+    n_q, qs = 3, 8
+    rng = np.random.default_rng(13)
+    label = rng.integers(0, 4, size=n_q * qs).astype(np.float64)
+    score = rng.normal(size=n_q * qs).astype(np.float32)
+    cfg = Config.from_params({"objective": "rank_xendcg"})
+    obj = create_objective(cfg)
+    obj.init(label, None, query_boundaries=np.arange(0, (n_q + 1) * qs, qs))
+    g, h = obj.get_gradients(jnp.asarray(score)[None], jax.random.PRNGKey(0))
+    g = np.asarray(g[0]).reshape(n_q, qs)
+    # per-query lambdas approximately sum to zero (gradient of a softmax loss)
+    np.testing.assert_allclose(g.sum(axis=1), 0.0, atol=1e-4)
+
+
+def test_renew_tree_output_median():
+    rng = np.random.default_rng(17)
+    label = rng.normal(size=40)
+    score = np.zeros(40)
+    leaf_id = np.repeat([0, 1], 20)
+    cfg = Config.from_params({"objective": "regression_l1"})
+    obj = create_objective(cfg)
+    obj.init(label, None)
+    out = obj.renew_tree_output(score, leaf_id, np.zeros(2), None)
+    assert out[0] == pytest.approx(np.median(label[:20]), abs=1e-9)
+    assert out[1] == pytest.approx(np.median(label[20:]), abs=1e-9)
