@@ -28,7 +28,7 @@ from ..config import Config
 from ..dataset import Dataset
 from ..metrics import Metric, create_metric
 from ..objectives import ObjectiveFunction, create_objective
-from ..ops.grower import GrowerParams, grow_tree
+from ..ops.grower import GrowerParams, fetch_tree_arrays, grow_tree
 from ..predict import (
     BinTreeBatch,
     add_tree_to_score,
@@ -147,17 +147,8 @@ class Booster:
             nan_bins = np.array([-1], dtype=np.int32)  # pairs with the dummy column
         self._nan_bins = jnp.asarray(nan_bins)
         self._max_bin_padded = _ceil_pow2(int(nb.max()) if len(nb) else 2)
-        self._grower_params = GrowerParams(
-            num_leaves=cfg.num_leaves,
-            max_bin=self._max_bin_padded,
-            max_depth=cfg.max_depth,
-            min_data_in_leaf=cfg.min_data_in_leaf,
-            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
-            lambda_l1=cfg.lambda_l1,
-            lambda_l2=cfg.lambda_l2,
-            min_gain_to_split=cfg.min_gain_to_split,
-            max_delta_step=cfg.max_delta_step,
-        )
+        self._setup_constraints()
+        self._grower_params = self._make_grower_params()
         self._ones_mask = jnp.ones((n,), jnp.float32)
         self._full_feature_mask = jnp.ones((self._bins.shape[1],), bool)
         self._rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
@@ -180,6 +171,126 @@ class Booster:
             self.objective.class_need_train(kk) if self.objective else True
             for kk in range(k)
         ]
+
+    def _setup_constraints(self) -> None:
+        """Map per-original-feature constraints onto used columns."""
+        cfg = self.config
+        ds = self.train_set
+        used = ds.used_features
+        self._monotone = None
+        if cfg.monotone_constraints and any(v != 0 for v in cfg.monotone_constraints):
+            mc = np.zeros(len(used), dtype=np.int8)
+            for ci, j in enumerate(used):
+                if j < len(cfg.monotone_constraints):
+                    mc[ci] = cfg.monotone_constraints[j]
+            self._monotone = jnp.asarray(mc)
+        self._interaction_sets = None
+        ic = cfg.interaction_constraints
+        sets: List[List[int]] = []
+        if isinstance(ic, str) and ic.strip():
+            import re
+
+            for grp in re.findall(r"\[([^\]]*)\]", ic):
+                sets.append([int(x) for x in grp.split(",") if x.strip() != ""])
+        elif isinstance(ic, (list, tuple)) and ic:
+            sets = [list(map(int, g)) for g in ic]
+        if sets:
+            mat = np.zeros((len(sets), len(used)), dtype=bool)
+            orig_to_used = {j: ci for ci, j in enumerate(used)}
+            for si, grp in enumerate(sets):
+                for j in grp:
+                    if j in orig_to_used:
+                        mat[si, orig_to_used[j]] = True
+            self._interaction_sets = jnp.asarray(mat)
+
+    def _make_grower_params(self) -> GrowerParams:
+        cfg = self.config
+        return GrowerParams(
+            num_leaves=cfg.num_leaves,
+            max_bin=self._max_bin_padded,
+            max_depth=cfg.max_depth,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+            lambda_l1=cfg.lambda_l1,
+            lambda_l2=cfg.lambda_l2,
+            min_gain_to_split=cfg.min_gain_to_split,
+            max_delta_step=cfg.max_delta_step,
+            path_smooth=cfg.path_smooth,
+            use_monotone=self._monotone is not None,
+            use_interaction=self._interaction_sets is not None,
+            feature_fraction_bynode=cfg.feature_fraction_bynode,
+        )
+
+    def _fit_linear_leaves(
+        self,
+        tree: Tree,
+        leaf_id: np.ndarray,
+        grad: np.ndarray,
+        hess: np.ndarray,
+        mask: Optional[np.ndarray],
+    ) -> None:
+        """Fit a linear model per leaf on the path's numerical features
+        (reference: LinearTreeLearner::CalculateLinear,
+        src/treelearner/linear_tree_learner.cpp:182 — weighted normal
+        equations XᵀHX w = -Xᵀg with linear_lambda ridge, Eigen solve;
+        NumPy lstsq here — this is per-tree host work like the reference)."""
+        ds = self.train_set
+        raw = ds.raw if ds.raw is not None else self._raw_for_replay(ds)
+        lam = self.config.linear_lambda
+        n_leaves = tree.num_leaves
+        # path features per leaf from the tree structure
+        paths: List[List[int]] = [[] for _ in range(n_leaves)]
+
+        def walk(node: int, feats: List[int]):
+            if node < 0:
+                paths[~node] = feats
+                return
+            fsplit = int(tree.split_feature[node])
+            is_cat = bool(tree.decision_type[node] & 1)
+            nxt = feats if is_cat else feats + [fsplit]
+            walk(int(tree.left_child[node]), nxt)
+            walk(int(tree.right_child[node]), nxt)
+
+        if n_leaves > 1:
+            walk(0, [])
+        tree.is_linear = True
+        tree.leaf_const = np.array(tree.leaf_value, dtype=np.float64)
+        tree.leaf_features = []
+        tree.leaf_coeff = []
+        sel_all = np.ones(len(leaf_id), bool) if mask is None else mask > 0
+        for leaf in range(n_leaves):
+            feats = sorted(set(paths[leaf]))
+            rows = np.nonzero((leaf_id == leaf) & sel_all)[0]
+            if not feats or len(rows) < len(feats) + 1:
+                tree.leaf_features.append(np.zeros(0, dtype=np.int32))
+                tree.leaf_coeff.append(np.zeros(0))
+                continue
+            Xl = raw[np.ix_(rows, feats)]
+            ok = ~np.isnan(Xl).any(axis=1)
+            if ok.sum() < len(feats) + 1:
+                tree.leaf_features.append(np.zeros(0, dtype=np.int32))
+                tree.leaf_coeff.append(np.zeros(0))
+                continue
+            Xl = Xl[ok]
+            g = grad[rows][ok]
+            h = hess[rows][ok]
+            design = np.concatenate([Xl, np.ones((len(Xl), 1))], axis=1)
+            A = design.T @ (design * h[:, None])
+            A[np.arange(len(feats)), np.arange(len(feats))] += lam
+            b = -design.T @ g
+            try:
+                w = np.linalg.solve(A + 1e-10 * np.eye(len(A)), b)
+            except np.linalg.LinAlgError:
+                tree.leaf_features.append(np.zeros(0, dtype=np.int32))
+                tree.leaf_coeff.append(np.zeros(0))
+                continue
+            if not np.isfinite(w).all():
+                tree.leaf_features.append(np.zeros(0, dtype=np.int32))
+                tree.leaf_coeff.append(np.zeros(0))
+                continue
+            tree.leaf_features.append(np.asarray(feats, dtype=np.int32))
+            tree.leaf_coeff.append(w[:-1])
+            tree.leaf_const[leaf] = w[-1]
 
     def _create_metrics(self) -> List[Metric]:
         cfg = self.config
@@ -299,8 +410,18 @@ class Booster:
                     self._nan_bins,
                     feature_mask,
                     self._grower_params,
+                    monotone=self._monotone,
+                    interaction_sets=self._interaction_sets,
+                    rng=(
+                        self._next_rng()
+                        if self.config.feature_fraction_bynode < 1.0
+                        else None
+                    ),
                 )
-                n_leaves = int(ta.num_leaves)
+                # two bulk transfers instead of ~14 small ones (remote TPU
+                # round-trips dominate otherwise)
+                ta_host = fetch_tree_arrays(ta)
+                n_leaves = int(ta_host.num_leaves)
             else:
                 n_leaves = 1
 
@@ -311,48 +432,74 @@ class Booster:
                     lv = self.objective.renew_tree_output(
                         np.asarray(self._score[kk], dtype=np.float64),
                         np.asarray(leaf_id),
-                        np.asarray(leaf_value, dtype=np.float64),
+                        np.asarray(ta_host.leaf_value, dtype=np.float64),
                         np.asarray(mask),
                     )
                     leaf_value = jnp.asarray(lv, dtype=jnp.float32)
                     ta = ta._replace(leaf_value=leaf_value)
-                shrunk = leaf_value * self._shrinkage_rate
-                # train score update: one gather (reference UpdateScore :501)
-                self._score = self._score.at[kk].add(shrunk[leaf_id])
-                # valid score updates: bin-space walk of the new tree
-                for entry in self._valid:
-                    entry.score = entry.score.at[kk].set(
-                        add_tree_to_score(
-                            entry.score[kk],
-                            entry.dataset.device_bins(),
-                            self._nan_bins,
-                            ta.split_feature,
-                            ta.split_bin,
-                            ta.default_left,
-                            ta.left_child,
-                            ta.right_child,
-                            shrunk,
-                        )
-                    )
+                    ta_host = ta_host._replace(leaf_value=lv)
                 tree = Tree.from_device_arrays(
-                    ta,
+                    ta_host,
                     self.train_set.bin_mappers,
                     self.train_set.used_features,
                 )
+                is_linear = bool(cfg.linear_tree)
+                if is_linear:
+                    self._fit_linear_leaves(
+                        tree,
+                        np.asarray(leaf_id),
+                        np.asarray(grad[kk], dtype=np.float64),
+                        np.asarray(hess[kk], dtype=np.float64),
+                        np.asarray(mask),
+                    )
                 tree.apply_shrinkage(self._shrinkage_rate)
+
+                if is_linear:
+                    # linear leaves: per-row output depends on raw features;
+                    # scores advance by a host tree walk (the reference's
+                    # LinearTreeLearner AddPredictionToScore equivalent)
+                    delta = tree.predict(self._raw_for_replay(self.train_set))
+                    self._score = self._score.at[kk].add(
+                        jnp.asarray(delta, dtype=jnp.float32)
+                    )
+                    for entry in self._valid:
+                        vdelta = tree.predict(self._raw_for_replay(entry.dataset))
+                        entry.score = entry.score.at[kk].add(
+                            jnp.asarray(vdelta, dtype=jnp.float32)
+                        )
+                else:
+                    shrunk = leaf_value * self._shrinkage_rate
+                    # train score update: one gather (reference UpdateScore :501)
+                    self._score = self._score.at[kk].add(shrunk[leaf_id])
+                    # valid score updates: bin-space walk of the new tree
+                    for entry in self._valid:
+                        entry.score = entry.score.at[kk].set(
+                            add_tree_to_score(
+                                entry.score[kk],
+                                entry.dataset.device_bins(),
+                                self._nan_bins,
+                                ta.split_feature,
+                                ta.split_bin,
+                                ta.default_left,
+                                ta.left_child,
+                                ta.right_child,
+                                shrunk,
+                            )
+                        )
                 if abs(init_scores[kk]) > _EPS:
                     tree.add_bias(init_scores[kk])
                 nn = n_leaves - 1
-                self._bin_records.append(
-                    {
-                        "split_feature": np.asarray(ta.split_feature)[:nn],
-                        "split_bin": np.asarray(ta.split_bin)[:nn],
-                        "default_left": np.asarray(ta.default_left)[:nn],
-                        "left_child": np.asarray(ta.left_child)[:nn],
-                        "right_child": np.asarray(ta.right_child)[:nn],
-                        "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
-                    }
-                )
+                rec = {
+                    "split_feature": np.asarray(ta_host.split_feature)[:nn],
+                    "split_bin": np.asarray(ta_host.split_bin)[:nn],
+                    "default_left": np.asarray(ta_host.default_left)[:nn],
+                    "left_child": np.asarray(ta_host.left_child)[:nn],
+                    "right_child": np.asarray(ta_host.right_child)[:nn],
+                    "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
+                }
+                if is_linear:
+                    rec["no_bin_form"] = True  # device walker can't see coeffs
+                self._bin_records.append(rec)
                 self.models_.append(tree)
             else:
                 # constant tree (reference gbdt.cpp:428-441)
@@ -824,20 +971,10 @@ class Booster:
         """Reference: Booster::ResetConfig via LGBM_BoosterResetParameter."""
         self.params.update(params)
         self.config = Config.from_params(self.params)
-        cfg = self.config
-        self._shrinkage_rate = cfg.learning_rate
+        self._shrinkage_rate = self.config.learning_rate
         if self.train_set is not None:
-            self._grower_params = GrowerParams(
-                num_leaves=cfg.num_leaves,
-                max_bin=self._max_bin_padded,
-                max_depth=cfg.max_depth,
-                min_data_in_leaf=cfg.min_data_in_leaf,
-                min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
-                lambda_l1=cfg.lambda_l1,
-                lambda_l2=cfg.lambda_l2,
-                min_gain_to_split=cfg.min_gain_to_split,
-                max_delta_step=cfg.max_delta_step,
-            )
+            self._setup_constraints()
+            self._grower_params = self._make_grower_params()
         return self
 
     def merge_from(self, other: "Booster") -> "Booster":

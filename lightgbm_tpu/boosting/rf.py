@@ -16,7 +16,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..dataset import Dataset
-from ..ops.grower import grow_tree
+from ..ops.grower import fetch_tree_arrays, grow_tree
 from ..predict import add_tree_to_score
 from ..tree import Tree
 from .gbdt import Booster, _EPS
@@ -70,7 +70,8 @@ class RFBooster(Booster):
                     feature_mask,
                     self._grower_params,
                 )
-                n_leaves = int(ta.num_leaves)
+                ta_host = fetch_tree_arrays(ta)
+                n_leaves = int(ta_host.num_leaves)
             else:
                 n_leaves = 1
 
@@ -82,14 +83,19 @@ class RFBooster(Booster):
                     lv = self.objective.renew_tree_output(
                         np.full(self.train_set.num_data, init),
                         np.asarray(leaf_id),
-                        np.asarray(leaf_value, dtype=np.float64),
+                        np.asarray(ta_host.leaf_value, dtype=np.float64),
                         np.asarray(mask),
                     )
                     leaf_value = jnp.asarray(lv, dtype=jnp.float32)
                     ta = ta._replace(leaf_value=leaf_value)
+                    ta_host = ta_host._replace(leaf_value=lv)
                 if abs(self._init_scores[kk]) > _EPS:
                     leaf_value = leaf_value + self._init_scores[kk]
                     ta = ta._replace(leaf_value=leaf_value)
+                    ta_host = ta_host._replace(
+                        leaf_value=np.asarray(ta_host.leaf_value, dtype=np.float64)
+                        + self._init_scores[kk]
+                    )
                 # running average: score = (score*t + tree)/(t+1)  (rf.hpp:149)
                 t = float(self._iter)
                 self._score = self._score.at[kk].set(
@@ -109,18 +115,18 @@ class RFBooster(Booster):
                     )
                     entry.score = entry.score.at[kk].set(updated / (t + 1.0))
                 tree = Tree.from_device_arrays(
-                    ta,
+                    ta_host,
                     self.train_set.bin_mappers,
                     self.train_set.used_features,
                 )
                 nn = n_leaves - 1
                 self._bin_records.append(
                     {
-                        "split_feature": np.asarray(ta.split_feature)[:nn],
-                        "split_bin": np.asarray(ta.split_bin)[:nn],
-                        "default_left": np.asarray(ta.default_left)[:nn],
-                        "left_child": np.asarray(ta.left_child)[:nn],
-                        "right_child": np.asarray(ta.right_child)[:nn],
+                        "split_feature": np.asarray(ta_host.split_feature)[:nn],
+                        "split_bin": np.asarray(ta_host.split_bin)[:nn],
+                        "default_left": np.asarray(ta_host.default_left)[:nn],
+                        "left_child": np.asarray(ta_host.left_child)[:nn],
+                        "right_child": np.asarray(ta_host.right_child)[:nn],
                         "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
                     }
                 )

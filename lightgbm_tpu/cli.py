@@ -1,0 +1,137 @@
+"""Config-file driven CLI (reference: src/main.cpp, src/application/
+application.cpp — Application::Run dispatching train/predict/convert_model,
+config parsing conventions from include/LightGBM/config.h:1-16).
+
+Usage mirrors the reference binary:
+
+    python -m lightgbm_tpu config=train.conf [key=value ...]
+    python -m lightgbm_tpu task=predict data=test.tsv input_model=model.txt
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+from typing import Any, Dict, List
+
+import numpy as np
+
+from .boosting import create_booster
+from .boosting.gbdt import Booster
+from .config import Config
+from .dataset import Dataset, _load_text_file
+from .engine import train as engine_train
+
+
+def parse_args(argv: List[str]) -> Dict[str, Any]:
+    """key=value args; config file first, CLI overrides (reference
+    Application::Application, config precedence CLI > file)."""
+    cli: Dict[str, Any] = {}
+    for tok in argv:
+        if "=" not in tok:
+            raise SystemExit(f"arguments must be key=value, got {tok!r}")
+        key, v = tok.split("=", 1)
+        cli[key.strip()] = v.strip().strip('"')
+    params: Dict[str, Any] = {}
+    conf = cli.get("config", cli.get("config_file"))
+    if conf:
+        for line in Path(conf).read_text().splitlines():
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            key, v = line.split("=", 1)
+            params.setdefault(key.strip(), v.strip().strip('"'))
+    params.update(cli)  # CLI wins
+    return params
+
+
+def run_train(params: Dict[str, Any], cfg: Config) -> None:
+    if not cfg.data:
+        raise SystemExit("task=train requires data=<training file>")
+    dtrain = Dataset(cfg.data, params=params)
+    valid_sets = []
+    valid_names = []
+    for i, vpath in enumerate(cfg.valid):
+        valid_sets.append(dtrain.create_valid(vpath))
+        valid_names.append(Path(vpath).stem)
+    from .callback import log_evaluation
+
+    callbacks = []
+    if cfg.verbosity > 0 and (valid_sets or cfg.is_provide_training_metric):
+        callbacks.append(log_evaluation(max(1, cfg.metric_freq)))
+    if cfg.is_provide_training_metric:
+        valid_sets.insert(0, dtrain)
+        valid_names.insert(0, "training")
+    booster = engine_train(
+        params,
+        dtrain,
+        num_boost_round=cfg.num_iterations,
+        valid_sets=valid_sets,
+        valid_names=valid_names,
+        callbacks=callbacks,
+        init_model=params.get("input_model") or None,
+    )
+    out = params.get("output_model", "LightGBM_model.txt")
+    booster.save_model(out)
+    print(f"Finished training; model written to {out}")
+
+
+def run_predict(params: Dict[str, Any], cfg: Config) -> None:
+    model_path = params.get("input_model")
+    if not model_path:
+        raise SystemExit("task=predict requires input_model=<model file>")
+    if not cfg.data:
+        raise SystemExit("task=predict requires data=<input file>")
+    booster = Booster(model_file=model_path)
+    loaded = _load_text_file(cfg.data, cfg)
+    X = loaded["data"]
+    pred = booster.predict(
+        X,
+        raw_score=cfg.predict_raw_score,
+        pred_leaf=cfg.predict_leaf_index,
+        pred_contrib=cfg.predict_contrib,
+        start_iteration=cfg.start_iteration_predict,
+        num_iteration=(
+            cfg.num_iteration_predict if cfg.num_iteration_predict > 0 else None
+        ),
+    )
+    out = params.get("output_result", "LightGBM_predict_result.txt")
+    np.savetxt(out, np.asarray(pred), fmt="%.10g", delimiter="\t")
+    print(f"Finished prediction; results written to {out}")
+
+
+def run_convert_model(params: Dict[str, Any], cfg: Config) -> None:
+    model_path = params.get("input_model")
+    if not model_path:
+        raise SystemExit("task=convert_model requires input_model=<model file>")
+    booster = Booster(model_file=model_path)
+    import json
+
+    out = params.get("convert_model", "gbdt_prediction.json")
+    with open(out, "w") as fp:
+        json.dump(booster.dump_model(), fp, indent=2)
+    print(f"Model dumped to {out}")
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print(__doc__)
+        raise SystemExit(2)
+    params = parse_args(argv)
+    cfg = Config.from_params(params)
+    task = cfg.task
+    if task == "train":
+        run_train(params, cfg)
+    elif task in ("predict", "prediction", "test"):
+        run_predict(params, cfg)
+    elif task == "convert_model":
+        run_convert_model(params, cfg)
+    elif task == "refit":
+        raise SystemExit("task=refit: use Booster.refit via the python API")
+    else:
+        raise SystemExit(f"unknown task: {task!r}")
+
+
+if __name__ == "__main__":
+    main()

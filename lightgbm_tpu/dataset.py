@@ -190,6 +190,10 @@ class Dataset:
             data = data.to_numpy(dtype=np.float64, na_value=np.nan)
         if data is None:
             raise ValueError("Dataset has no data")
+        if hasattr(data, "toarray"):  # scipy CSR/CSC (reference: CreateFromCSR)
+            # the dense uint8 bin matrix is the storage format either way;
+            # sparse inputs densify once at construction
+            data = data.toarray()
         data = np.asarray(data, dtype=np.float64)
         if data.ndim != 2:
             raise ValueError(f"data must be 2-D, got shape {data.shape}")
@@ -386,6 +390,102 @@ class Dataset:
             init_score=init_score,
             params=params if params is not None else self.params,
         )
+
+    # ------------------------------------------------------------- binary IO
+    def save_binary(self, filename: str) -> "Dataset":
+        """Serialize the constructed (binned) dataset (reference:
+        Dataset::SaveBinaryFile via save_binary, src/io/dataset_loader.cpp:424).
+        Format: npz with bins, metadata and per-feature mapper tables."""
+        self.construct()
+        import pickle
+
+        with open(filename, "wb") as fh:
+            pickle.dump(
+                {
+                    "format": "lightgbm_tpu.dataset.v1",
+                    "bins": self.bins,
+                    "used_features": self.used_features,
+                    "bin_mappers": self.bin_mappers,
+                    "feature_names": self.feature_names,
+                    "num_total_features": self.num_total_features,
+                    "label": self.metadata.label,
+                    "weight": self.metadata.weight,
+                    "init_score": self.metadata.init_score,
+                    "query_boundaries": self.metadata.query_boundaries,
+                    "raw": self.raw,
+                },
+                fh,
+            )
+        return self
+
+    @classmethod
+    def load_binary(cls, filename: str, params=None) -> "Dataset":
+        import pickle
+
+        with open(filename, "rb") as fh:
+            blob = pickle.load(fh)
+        if blob.get("format") != "lightgbm_tpu.dataset.v1":
+            raise ValueError(f"{filename} is not a lightgbm_tpu binary dataset")
+        ds = cls.__new__(cls)
+        ds.params = dict(params or {})
+        ds.config = Config.from_params(ds.params)
+        ds._raw_data = None
+        ds._label = None
+        ds._weight = None
+        ds._group = None
+        ds._init_score = None
+        ds._feature_name = "auto"
+        ds._categorical_feature = "auto"
+        ds.reference = None
+        ds.free_raw_data = True
+        ds._constructed = True
+        ds.bin_mappers = blob["bin_mappers"]
+        ds.used_features = blob["used_features"]
+        ds.bins = blob["bins"]
+        ds.raw = blob.get("raw")
+        ds.feature_names = blob["feature_names"]
+        ds.num_total_features = blob["num_total_features"]
+        ds.metadata = Metadata(
+            label=blob["label"],
+            weight=blob["weight"],
+            init_score=blob["init_score"],
+            query_boundaries=blob["query_boundaries"],
+        )
+        ds._device_cache = {}
+        return ds
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row subset sharing the bin mappers (reference: Dataset::CopySubrow,
+        python basic.py Dataset.subset)."""
+        self.construct()
+        idx = np.asarray(used_indices, dtype=np.int64)
+        ds = Dataset.__new__(Dataset)
+        ds.params = dict(params or self.params)
+        ds.config = Config.from_params(ds.params)
+        ds._raw_data = None
+        ds._label = None
+        ds._weight = None
+        ds._group = None
+        ds._init_score = None
+        ds._feature_name = "auto"
+        ds._categorical_feature = "auto"
+        ds.reference = self
+        ds.free_raw_data = self.free_raw_data
+        ds._constructed = True
+        ds.bin_mappers = self.bin_mappers
+        ds.used_features = self.used_features
+        ds.bins = self.bins[idx]
+        ds.raw = None if self.raw is None else self.raw[idx]
+        ds.feature_names = self.feature_names
+        ds.num_total_features = self.num_total_features
+        md = self.metadata
+        ds.metadata = Metadata(
+            label=md.label[idx],
+            weight=None if md.weight is None else md.weight[idx],
+            init_score=None if md.init_score is None else md.init_score[idx],
+        )
+        ds._device_cache = {}
+        return ds
 
     # -------------------------------------------------------------- device
     def device_bins(self):

@@ -56,6 +56,10 @@ class GrowerParams:
     # the reference's ordered_gradients complexity); "full": masked pass
     # over all rows per split (rows touched ~ N*L).
     hist_mode: str = "gather"
+    path_smooth: float = 0.0
+    use_monotone: bool = False  # monotone_constraints (basic method)
+    use_interaction: bool = False  # interaction_constraints
+    feature_fraction_bynode: float = 1.0
 
 
 def _hist_caps(n: int) -> list:
@@ -108,6 +112,9 @@ class _State(NamedTuple):
     leaf_depth: jnp.ndarray
     leaf_parent: jnp.ndarray
     leaf_is_right: jnp.ndarray
+    leaf_lb: jnp.ndarray  # [L] monotone output lower bound
+    leaf_ub: jnp.ndarray  # [L] monotone output upper bound
+    leaf_allowed: jnp.ndarray  # [L, F] interaction-constraint feature mask
     cand: SplitCandidate  # arrays of shape [L]
     split_feature: jnp.ndarray
     split_bin: jnp.ndarray
@@ -122,7 +129,10 @@ class _State(NamedTuple):
     done: jnp.ndarray
 
 
-def _candidate_for_leaf(hist, g, h, c, num_bins, nan_bins, feature_mask, p: GrowerParams):
+def _candidate_for_leaf(
+    hist, g, h, c, num_bins, nan_bins, feature_mask, p: GrowerParams,
+    monotone=None, lb=None, ub=None, parent_output=0.0,
+):
     return best_split(
         hist,
         g,
@@ -137,6 +147,11 @@ def _candidate_for_leaf(hist, g, h, c, num_bins, nan_bins, feature_mask, p: Grow
         min_sum_hessian_in_leaf=p.min_sum_hessian_in_leaf,
         min_gain_to_split=p.min_gain_to_split,
         max_delta_step=p.max_delta_step,
+        path_smooth=p.path_smooth,
+        monotone=monotone,
+        leaf_lb=lb,
+        leaf_ub=ub,
+        parent_output=parent_output,
     )
 
 
@@ -152,6 +167,71 @@ def _set_cand(cand: SplitCandidate, idx, new: SplitCandidate, gain_override=None
     ])
 
 
+@jax.jit
+def pack_tree_arrays(ta: "TreeArrays"):
+    """Pack a TreeArrays into (ints, floats) flat vectors so the host can
+    fetch a whole tree in two transfers instead of ~14 (each transfer is a
+    full round-trip on remote-attached TPUs)."""
+    ints = jnp.concatenate(
+        [
+            ta.split_feature,
+            ta.split_bin,
+            ta.left_child,
+            ta.right_child,
+            ta.default_left.astype(jnp.int32),
+            ta.leaf_depth,
+            ta.num_leaves[None],
+        ]
+    )
+    floats = jnp.concatenate(
+        [
+            ta.split_gain,
+            ta.internal_value,
+            ta.internal_weight,
+            ta.internal_count,
+            ta.leaf_value,
+            ta.leaf_weight,
+            ta.leaf_count,
+        ]
+    )
+    return ints, floats
+
+
+def fetch_tree_arrays(ta: "TreeArrays") -> "TreeArrays":
+    """Pull a device TreeArrays to host as numpy with two transfers."""
+    import numpy as np
+
+    ints_d, floats_d = pack_tree_arrays(ta)
+    ints = np.asarray(ints_d)
+    floats = np.asarray(floats_d)
+    nn = ta.split_feature.shape[0]  # L - 1
+    L = ta.leaf_value.shape[0]
+    io = [ints[i * nn : (i + 1) * nn] for i in range(4)]
+    off = 4 * nn
+    default_left = ints[off : off + nn].astype(bool)
+    leaf_depth = ints[off + nn : off + nn + L]
+    num_leaves = ints[off + nn + L]
+    fo = [floats[i * nn : (i + 1) * nn] for i in range(4)]
+    off = 4 * nn
+    fl = [floats[off + i * L : off + (i + 1) * L] for i in range(3)]
+    return TreeArrays(
+        split_feature=io[0],
+        split_bin=io[1],
+        split_gain=fo[0],
+        default_left=default_left,
+        left_child=io[2],
+        right_child=io[3],
+        internal_value=fo[1],
+        internal_weight=fo[2],
+        internal_count=fo[3],
+        leaf_value=fl[0],
+        leaf_weight=fl[1],
+        leaf_count=fl[2],
+        leaf_depth=leaf_depth,
+        num_leaves=num_leaves,
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("params",))
 def grow_tree(
     bins: jnp.ndarray,  # [N, F] int32
@@ -162,17 +242,35 @@ def grow_tree(
     nan_bins: jnp.ndarray,  # [F] int32 (-1 when the feature has no NaN bin)
     feature_mask: jnp.ndarray,  # [F] bool (feature_fraction sampling)
     params: GrowerParams,
+    monotone: Optional[jnp.ndarray] = None,  # [F] int8 (use_monotone)
+    interaction_sets: Optional[jnp.ndarray] = None,  # [S, F] bool
+    rng: Optional[jax.Array] = None,  # for feature_fraction_bynode
 ):
     """Grow one tree. Returns (TreeArrays, leaf_id[N])."""
     p = params
     n, f = bins.shape
     L, B = p.num_leaves, p.max_bin
+    use_mono = p.use_monotone and monotone is not None
+    mono_arr = monotone if use_mono else None
+
+    def node_feature_mask(node_seed, used_row):
+        """Per-node usable features: feature_fraction_bynode sampling
+        (col_sampler.hpp by-node) + interaction constraints (allowed = union
+        of constraint sets containing every feature used on the path)."""
+        m = feature_mask
+        if p.use_interaction and interaction_sets is not None:
+            contains = (interaction_sets | ~used_row[None, :]).all(axis=1)  # [S]
+            allowed = (contains[:, None] & interaction_sets).any(axis=0)  # [F]
+            m = m & allowed
+        if p.feature_fraction_bynode < 1.0 and rng is not None:
+            key = jax.random.fold_in(rng, node_seed)
+            m = m & (jax.random.uniform(key, (f,)) < p.feature_fraction_bynode)
+        return m
 
     use_gather = p.hist_mode == "gather" and f > 0 and n > 1
     if use_gather:
         caps = sorted(_hist_caps(n))  # ascending
         caps_arr = jnp.asarray(caps, dtype=jnp.int32)
-        cap0 = caps[-1]
         # one zero padding row so fill indices contribute nothing
         bins_pad = jnp.concatenate([bins, jnp.zeros((1, f), bins.dtype)], axis=0)
         grad_pad = jnp.concatenate([grad, jnp.zeros((1,), grad.dtype)])
@@ -180,13 +278,15 @@ def grow_tree(
         mask_pad = jnp.concatenate([count_mask, jnp.zeros((1,), count_mask.dtype)])
 
         def _make_hist_branch(cap: int):
-            def branch(idx):
-                sub = idx[:cap]
+            # nonzero lives INSIDE the branch so its scatter is sized to the
+            # branch capacity — deep (small) leaves compact into small buffers
+            def branch(member):
+                (idx,) = jnp.nonzero(member, size=cap, fill_value=n)
                 return leaf_histogram(
-                    bins_pad[sub],
-                    grad_pad[sub],
-                    hess_pad[sub],
-                    mask_pad[sub],
+                    bins_pad[idx],
+                    grad_pad[idx],
+                    hess_pad[idx],
+                    mask_pad[idx],
                     B,
                     method=p.hist_method,
                     axis_name=p.axis_name,
@@ -196,12 +296,24 @@ def grow_tree(
 
         hist_branches = [_make_hist_branch(c) for c in caps]
 
+    # transposed copy for contiguous per-feature column reads in the
+    # partition step (bins is row-major; a column gather is strided)
+    bins_t_cols = bins.T if f > 0 else bins.reshape(f, n)
+
     hist0 = leaf_histogram(
         bins, grad, hess, count_mask, B, method=p.hist_method, axis_name=p.axis_name
     )
     totals = hist0[0].sum(axis=0)  # every row lands in exactly one bin of feature 0
+    root_used = jnp.zeros((f,), bool)
+    neg_inf_s = jnp.float32(-jnp.inf)
+    pos_inf_s = jnp.float32(jnp.inf)
     cand0 = _candidate_for_leaf(
-        hist0, totals[0], totals[1], totals[2], num_bins, nan_bins, feature_mask, p
+        hist0, totals[0], totals[1], totals[2], num_bins, nan_bins,
+        node_feature_mask(0, root_used), p,
+        monotone=mono_arr,
+        lb=neg_inf_s if use_mono else None,
+        ub=pos_inf_s if use_mono else None,
+        parent_output=leaf_output(totals[0], totals[1], p.lambda_l1, p.lambda_l2, p.max_delta_step),
     )
 
     neg_inf = jnp.full((L,), -jnp.inf, dtype=jnp.float32)
@@ -228,6 +340,9 @@ def grow_tree(
         leaf_depth=jnp.zeros((L,), jnp.int32),
         leaf_parent=jnp.full((L,), -1, jnp.int32),
         leaf_is_right=jnp.zeros((L,), bool),
+        leaf_lb=jnp.full((L,), -jnp.inf, jnp.float32),
+        leaf_ub=jnp.full((L,), jnp.inf, jnp.float32),
+        leaf_allowed=jnp.zeros((L, f), bool),  # stores USED features per path
         cand=cand,
         split_feature=jnp.zeros((L - 1,), jnp.int32),
         split_bin=jnp.zeros((L - 1,), jnp.int32),
@@ -257,7 +372,7 @@ def grow_tree(
             dl = st.cand.default_left[l]
 
             # ---- partition rows of leaf l (reference DataPartition::Split)
-            col = jnp.take(bins, feat, axis=1)
+            col = lax.dynamic_slice_in_dim(bins_t_cols, feat, 1, axis=0)[0]
             nb = nan_bins[feat]
             go_left = (col <= tbin) | (dl & (nb >= 0) & (col == nb))
             in_leaf = st.leaf_id == l
@@ -317,8 +432,7 @@ def grow_tree(
                 bucket = jnp.clip(
                     jnp.searchsorted(caps_arr, tc, side="left"), 0, len(caps) - 1
                 ).astype(jnp.int32)
-                (idx,) = jnp.nonzero(leaf_id == target, size=cap0, fill_value=n)
-                sm = lax.switch(bucket, hist_branches, idx)
+                sm = lax.switch(bucket, hist_branches, leaf_id == target)
             else:
                 left_smaller = lc <= rc
                 target = jnp.where(left_smaller, l, nl)
@@ -331,12 +445,59 @@ def grow_tree(
             right_hist = jnp.where(left_smaller, other, sm)
             hist_buf = st.hist_buf.at[l].set(left_hist).at[nl].set(right_hist)
 
+            # ---- monotone bounds for the children (BasicConstraint,
+            # monotone_constraints.hpp:465 — split midpoint partitions the
+            # parent's output interval)
+            leaf_lb, leaf_ub = st.leaf_lb, st.leaf_ub
+            lb_par, ub_par = st.leaf_lb[l], st.leaf_ub[l]
+            out_l_c = out_r_c = None
+            if use_mono:
+                out_l_c = jnp.clip(
+                    leaf_output(lg, lh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
+                    lb_par, ub_par,
+                )
+                out_r_c = jnp.clip(
+                    leaf_output(rg, rh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
+                    lb_par, ub_par,
+                )
+                mc_f = mono_arr[feat]
+                mid = 0.5 * (out_l_c + out_r_c)
+                lb_l = jnp.where(mc_f < 0, mid, lb_par)
+                ub_l = jnp.where(mc_f > 0, mid, ub_par)
+                lb_r = jnp.where(mc_f > 0, mid, lb_par)
+                ub_r = jnp.where(mc_f < 0, mid, ub_par)
+                leaf_lb = st.leaf_lb.at[l].set(lb_l).at[nl].set(lb_r)
+                leaf_ub = st.leaf_ub.at[l].set(ub_l).at[nl].set(ub_r)
+            else:
+                lb_l = ub_l = lb_r = ub_r = None
+
+            # path-used features for interaction constraints
+            leaf_allowed = st.leaf_allowed
+            if p.use_interaction:
+                new_used = st.leaf_allowed[l] | (
+                    jnp.arange(f, dtype=jnp.int32) == feat
+                )
+                leaf_allowed = st.leaf_allowed.at[l].set(new_used).at[nl].set(new_used)
+                used_l = used_r = new_used
+            else:
+                used_l = used_r = root_used
+
             # ---- refresh split candidates for the two children
             cand_l = _candidate_for_leaf(
-                left_hist, lg, lh, lc, num_bins, nan_bins, feature_mask, p
+                left_hist, lg, lh, lc, num_bins, nan_bins,
+                node_feature_mask(2 * t + 1, used_l), p,
+                monotone=mono_arr,
+                lb=lb_l if use_mono else None,
+                ub=ub_l if use_mono else None,
+                parent_output=leaf_output(lg, lh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
             )
             cand_r = _candidate_for_leaf(
-                right_hist, rg, rh, rc, num_bins, nan_bins, feature_mask, p
+                right_hist, rg, rh, rc, num_bins, nan_bins,
+                node_feature_mask(2 * t + 2, used_r), p,
+                monotone=mono_arr,
+                lb=lb_r if use_mono else None,
+                ub=ub_r if use_mono else None,
+                parent_output=leaf_output(rg, rh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
             )
             depth_ok = (p.max_depth <= 0) | (d_new < p.max_depth)
             cand = _set_cand(
@@ -355,6 +516,9 @@ def grow_tree(
                 leaf_depth=leaf_depth,
                 leaf_parent=leaf_parent,
                 leaf_is_right=leaf_is_right,
+                leaf_lb=leaf_lb,
+                leaf_ub=leaf_ub,
+                leaf_allowed=leaf_allowed,
                 cand=cand,
                 split_feature=split_feature,
                 split_bin=split_bin,
@@ -376,11 +540,20 @@ def grow_tree(
 
     leaf_idx = jnp.arange(L, dtype=jnp.int32)
     active = leaf_idx < state.num_leaves
-    leaf_value = jnp.where(
-        active,
-        leaf_output(state.leaf_g, state.leaf_h, p.lambda_l1, p.lambda_l2, p.max_delta_step),
-        0.0,
+    out = leaf_output(
+        state.leaf_g, state.leaf_h, p.lambda_l1, p.lambda_l2, p.max_delta_step
     )
+    if p.path_smooth > 0.0:
+        parent_out = jnp.where(
+            state.leaf_parent >= 0,
+            state.internal_value[jnp.maximum(state.leaf_parent, 0)],
+            0.0,
+        )
+        ratio = state.leaf_cnt / p.path_smooth
+        out = out * ratio / (ratio + 1.0) + parent_out / (ratio + 1.0)
+    if use_mono:
+        out = jnp.clip(out, state.leaf_lb, state.leaf_ub)
+    leaf_value = jnp.where(active, out, 0.0)
 
     tree = TreeArrays(
         split_feature=state.split_feature,

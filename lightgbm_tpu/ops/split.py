@@ -15,7 +15,7 @@ gates) follows feature_histogram.hpp:711-828.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
 
@@ -54,6 +54,37 @@ class SplitCandidate(NamedTuple):
     right_cnt: jnp.ndarray
 
 
+def constrained_output(
+    g,
+    h,
+    l1: float,
+    l2: float,
+    max_delta_step: float,
+    path_smooth: float = 0.0,
+    num_data=None,
+    parent_output=0.0,
+    lb=None,
+    ub=None,
+):
+    """CalculateSplittedLeafOutput with smoothing + monotone bounds
+    (feature_histogram.hpp:717-755)."""
+    out = leaf_output(g, h, l1, l2, max_delta_step)
+    if path_smooth > 0.0 and num_data is not None:
+        ratio = num_data / path_smooth
+        out = out * ratio / (ratio + 1.0) + parent_output / (ratio + 1.0)
+    if lb is not None:
+        out = jnp.maximum(out, lb)
+    if ub is not None:
+        out = jnp.minimum(out, ub)
+    return out
+
+
+def gain_given_output(g, h, l1: float, l2: float, out):
+    """GetLeafGainGivenOutput (feature_histogram.hpp:739)."""
+    t = threshold_l1(g, l1)
+    return -(2.0 * t * out + (h + l2 + _EPS) * out * out)
+
+
 def best_split(
     hist: jnp.ndarray,  # [F, B, 3] (sum_grad, sum_hess, count)
     parent_g: jnp.ndarray,
@@ -69,8 +100,14 @@ def best_split(
     min_sum_hessian_in_leaf: float,
     min_gain_to_split: float,
     max_delta_step: float = 0.0,
+    path_smooth: float = 0.0,
+    monotone: Optional[jnp.ndarray] = None,  # [F] int8 in {-1, 0, +1}
+    leaf_lb=None,  # scalar lower bound on child outputs (monotone)
+    leaf_ub=None,
+    parent_output=0.0,  # current output of the leaf (path smoothing)
 ) -> SplitCandidate:
     f, b, _ = hist.shape
+    use_full_gain = monotone is not None or path_smooth > 0.0
 
     has_nan = nan_bins >= 0
     nan_idx = jnp.where(has_nan, nan_bins, 0)
@@ -102,9 +139,27 @@ def best_split(
             & (rh >= min_sum_hessian_in_leaf)
             & feature_mask[:, None]
         )
-        gain = leaf_gain(lg, lh, lambda_l1, lambda_l2) + leaf_gain(
-            rg, rh, lambda_l1, lambda_l2
-        )
+        if not use_full_gain:
+            gain = leaf_gain(lg, lh, lambda_l1, lambda_l2) + leaf_gain(
+                rg, rh, lambda_l1, lambda_l2
+            )
+        else:
+            # full path: constrained outputs + GetLeafGainGivenOutput
+            # (GetSplitGains with USE_MC, feature_histogram.hpp:759-828)
+            out_l = constrained_output(
+                lg, lh, lambda_l1, lambda_l2, max_delta_step,
+                path_smooth, lc, parent_output, leaf_lb, leaf_ub,
+            )
+            out_r = constrained_output(
+                rg, rh, lambda_l1, lambda_l2, max_delta_step,
+                path_smooth, rc, parent_output, leaf_lb, leaf_ub,
+            )
+            gain = gain_given_output(lg, lh, lambda_l1, lambda_l2, out_l) + \
+                gain_given_output(rg, rh, lambda_l1, lambda_l2, out_r)
+            if monotone is not None:
+                mc = monotone[:, None]
+                violated = ((mc > 0) & (out_l > out_r)) | ((mc < 0) & (out_l < out_r))
+                ok = ok & ~violated
         return jnp.where(ok, gain, -jnp.inf)
 
     gain_right = eval_case(cum)  # missing -> right (default_left = False)
@@ -121,7 +176,16 @@ def best_split(
     best_gain_raw = gains.reshape(-1)[flat]
 
     left = cum[feat, tbin] + jnp.where(dl == 1, nan_stats[feat], 0.0)
-    parent_gain = leaf_gain(parent[0], parent[1], lambda_l1, lambda_l2)
+    if not use_full_gain:
+        parent_gain = leaf_gain(parent[0], parent[1], lambda_l1, lambda_l2)
+    else:
+        parent_gain = gain_given_output(
+            parent[0], parent[1], lambda_l1, lambda_l2,
+            constrained_output(
+                parent[0], parent[1], lambda_l1, lambda_l2, max_delta_step,
+                0.0, None, 0.0, leaf_lb, leaf_ub,
+            ),
+        )
     improvement = best_gain_raw - parent_gain - min_gain_to_split
     improvement = jnp.where(jnp.isfinite(best_gain_raw), improvement, -jnp.inf)
 
