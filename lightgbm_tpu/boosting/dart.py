@@ -141,6 +141,7 @@ class DARTBooster(Booster):
                     self._bin_records[idx]["leaf_value"] = np.asarray(
                         self.models_[idx].leaf_value, dtype=np.float32
                     )
+                    self._bump_model_version()
                     self._walk_add(
                         self._bin_records[idx], (v * factor).astype(np.float32), kk, False
                     )

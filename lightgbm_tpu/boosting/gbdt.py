@@ -89,6 +89,10 @@ class Booster:
         self.config = Config.from_params(self.params)
         self.pandas_categorical = None
         self._stack_cache: Dict[Any, BinTreeBatch] = {}
+        # bumped on EVERY models_/_bin_records mutation (append, pop, DART
+        # renormalize, merge) so _stacked_bins never serves a stale batch
+        # after rollback-then-retrain at the same tree count
+        self._model_version = 0
 
         if model_file is not None:
             with open(model_file) as f:
@@ -501,6 +505,7 @@ class Booster:
                     rec["no_bin_form"] = True  # device walker can't see coeffs
                 self._bin_records.append(rec)
                 self.models_.append(tree)
+                self._bump_model_version()
             else:
                 # constant tree (reference gbdt.cpp:428-441)
                 if len(self.models_) < k:
@@ -527,12 +532,14 @@ class Booster:
                     }
                 )
                 self.models_.append(tree)
+                self._bump_model_version()
 
         if not should_continue:
             if len(self.models_) > k:
                 for _ in range(k):
                     self.models_.pop()
                     self._bin_records.pop()
+                self._bump_model_version()
             return True
         self._iter += 1
         return False
@@ -559,7 +566,25 @@ class Booster:
             tree = self.models_[idx]
             rec = self._bin_records[idx]
             neg = jnp.asarray(-np.asarray(tree.leaf_value, dtype=np.float32))
-            if len(rec["split_feature"]):
+            if rec.get("no_bin_form"):
+                # linear trees / re-expressed init-model trees: the bin-space
+                # walk with plain leaf_value would ignore per-leaf linear
+                # coefficients — un-apply with the same real-valued predict
+                # the forward path used
+                self._score = self._score.at[kk].add(
+                    -jnp.asarray(
+                        tree.predict(self._train_raw_for_replay()),
+                        dtype=jnp.float32,
+                    )
+                )
+                for entry in self._valid:
+                    entry.score = entry.score.at[kk].add(
+                        -jnp.asarray(
+                            tree.predict(self._raw_for_replay(entry.dataset)),
+                            dtype=jnp.float32,
+                        )
+                    )
+            elif len(rec["split_feature"]):
                 self._score = self._score.at[kk].set(
                     add_tree_to_score(
                         self._score[kk],
@@ -594,6 +619,7 @@ class Booster:
         for _ in range(k):
             self.models_.pop()
             self._bin_records.pop()
+        self._bump_model_version()
         self._iter -= 1
         return self
 
@@ -792,8 +818,11 @@ class Booster:
         )
         return jnp.asarray(mat.astype(np.int32))
 
+    def _bump_model_version(self) -> None:
+        self._model_version = getattr(self, "_model_version", 0) + 1
+
     def _stacked_bins(self, t0: int, t1: int) -> BinTreeBatch:
-        key = (t0, t1, len(self.models_))
+        key = (t0, t1, self._model_version)
         if key not in self._stack_cache:
             self._stack_cache = {}  # invalidate older stacks
             self._stack_cache[key] = stack_bin_trees(
@@ -911,6 +940,7 @@ class Booster:
             if not block.strip():
                 continue
             self.models_.append(Tree.from_string(block))
+        self._bump_model_version()
         self._iter = len(self.models_) // max(1, self.num_tree_per_iteration)
         # objective needs label stats for convert_output only for a few
         # objectives; predict-time convert uses config scalars, so a light
@@ -987,6 +1017,7 @@ class Booster:
             self.models_.append(tree)
             rec = self._bin_record_from_tree(tree)
             self._bin_records.append(rec)
+            self._bump_model_version()
             kk = idx % k
             # replay onto the train score
             self._score = self._score.at[kk].add(

@@ -131,6 +131,7 @@ class RFBooster(Booster):
                     }
                 )
                 self.models_.append(tree)
+                self._bump_model_version()
             else:
                 output = 0.0
                 if len(self.models_) < k and not self._class_need_train[kk]:
@@ -149,5 +150,6 @@ class RFBooster(Booster):
                     }
                 )
                 self.models_.append(tree)
+                self._bump_model_version()
         self._iter += 1
         return not any_tree

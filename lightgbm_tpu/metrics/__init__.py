@@ -429,7 +429,9 @@ class CrossEntropyLambdaMetric(Metric):
         w = np.ones_like(s) if self.weight is None else self.weight
         z = np.clip(1.0 - np.exp(-w * hhat), _EPS, 1.0 - _EPS)
         loss = -self.label * np.log(z) - (1.0 - self.label) * np.log(1.0 - z)
-        return [(self.name, float(loss.sum()) / self.sum_weights)]
+        # reference xentropy_metric.hpp keeps sum_weights_ = num_data for
+        # xentlambda: weights enter only through z, not the normalizer
+        return [(self.name, float(loss.sum()) / max(len(self.label), 1))]
 
 
 class KullbackLeiblerDivergence(Metric):

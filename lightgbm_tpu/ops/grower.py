@@ -62,14 +62,17 @@ class GrowerParams:
     feature_fraction_bynode: float = 1.0
 
 
-def _hist_caps(n: int) -> list:
+def _hist_caps(n: int, full_range: bool = False) -> list:
     """Static capacity ladder for the smaller child: N/2, N/8, N/32, ...
 
     The smaller child of any split holds <= floor(parent/2) <= floor(N/2)
     rows, so the top capacity always fits; smaller buckets avoid paying the
-    top capacity for deep (small) leaves."""
+    top capacity for deep (small) leaves.  ``full_range`` extends the top to
+    N: under data-parallel sharding the GLOBALLY smaller child can still hold
+    up to all local rows of one shard."""
     caps = []
-    cap = 1 << max(0, (max(n // 2, 1) - 1).bit_length())
+    top = max(n, 1) if full_range else max(n // 2, 1)
+    cap = 1 << max(0, (top - 1).bit_length())
     floor_cap = min(4096, cap)
     while cap > floor_cap:
         caps.append(cap)
@@ -269,7 +272,7 @@ def grow_tree(
 
     use_gather = p.hist_mode == "gather" and f > 0 and n > 1
     if use_gather:
-        caps = sorted(_hist_caps(n))  # ascending
+        caps = sorted(_hist_caps(n, full_range=p.axis_name is not None))  # ascending
         caps_arr = jnp.asarray(caps, dtype=jnp.int32)
         # one zero padding row so fill indices contribute nothing
         bins_pad = jnp.concatenate([bins, jnp.zeros((1, f), bins.dtype)], axis=0)
@@ -422,13 +425,26 @@ def grow_tree(
                 rows_l = jnp.sum(in_leaf & go_left).astype(jnp.int32)
                 rows_in = jnp.sum(in_leaf).astype(jnp.int32)
                 rows_r = rows_in - rows_l
-                left_smaller = rows_l <= rows_r
-                target = jnp.where(left_smaller, l, nl)
-                tc = jnp.minimum(rows_l, rows_r)
                 if p.axis_name is not None:
-                    # uniform bucket across shards so the psum inside the
-                    # selected branch lines up on every device
-                    tc = lax.pmax(tc, p.axis_name)
+                    # the smaller-child choice must be GLOBAL: if shards chose
+                    # locally, some would histogram the left child and others
+                    # the right, and the psum would mix the two (the reference
+                    # decides smaller/larger from global counts too,
+                    # serial_tree_learner.cpp:343).  The capacity bucket is the
+                    # max over shards of the chosen child's LOCAL rows — which
+                    # can exceed local_n/2 on imbalanced shards, hence the
+                    # full_range ladder.
+                    rows_l_g = lax.psum(rows_l, p.axis_name)
+                    rows_r_g = lax.psum(rows_r, p.axis_name)
+                    left_smaller = rows_l_g <= rows_r_g
+                    target = jnp.where(left_smaller, l, nl)
+                    tc = lax.pmax(
+                        jnp.where(left_smaller, rows_l, rows_r), p.axis_name
+                    )
+                else:
+                    left_smaller = rows_l <= rows_r
+                    target = jnp.where(left_smaller, l, nl)
+                    tc = jnp.minimum(rows_l, rows_r)
                 bucket = jnp.clip(
                     jnp.searchsorted(caps_arr, tc, side="left"), 0, len(caps) - 1
                 ).astype(jnp.int32)

@@ -104,7 +104,26 @@ def leaf_histogram(
     collective over ICI instead of hand-rolled TCP recursive-halving).
     """
     if method == "auto":
-        method = "pallas" if jax.default_backend() in ("tpu", "axon") else "segment"
+        # Dispatch on the LOWERING platform, not the process-global default
+        # backend: with a TPU backend registered but the computation placed on
+        # CPU devices (virtual CPU mesh tests, dryrun_multichip), selecting
+        # Pallas would crash ("Only interpret mode is supported on CPU
+        # backend").  lax.platform_dependent specializes per lowering target.
+        # The axon (tunneled TPU) backend lowers with platform name "tpu", so
+        # the tpu= branch covers it (verified empirically).
+        from .pallas.histogram import histogram_pallas
+
+        hist = jax.lax.platform_dependent(
+            bins,
+            grad,
+            hess,
+            mask,
+            tpu=functools.partial(histogram_pallas, num_bins=num_bins),
+            default=functools.partial(leaf_histogram_segment, num_bins=num_bins),
+        )
+        if axis_name is not None:
+            hist = jax.lax.psum(hist, axis_name)
+        return hist
     if method == "pallas":
         from .pallas.histogram import histogram_pallas
 

@@ -6,13 +6,25 @@ Reference analogs: the scalar gather loop ``DenseBin::ConstructHistogramInner``
 NUM_DATA_PER_THREAD/SHARED_HIST_SIZE tuning in the .hpp).
 
 TPU formulation: TPUs have no fast scatter-add, so the per-row bin increment
-becomes a dense masked accumulation — but materializing the one-hot
-``[rows, F, B]`` in HBM is a bandwidth disaster (measured 20x slowdown).
-This kernel tiles rows into VMEM, forms each feature's ``[tile, B]`` one-hot
-IN VMEM via an iota compare, and contracts it against the ``[tile, 3]``
-(g, h, count) panel on the MXU, accumulating ``[F, B, 3]`` in the output ref
-across sequential grid steps.  HBM traffic is exactly bins + ghc once — the
-VMEM-resident accumulation mirrors the CUDA kernel's shared-memory histogram.
+becomes a dense one-hot contraction on the MXU.  The naive per-feature matmul
+``[TR,B] x [TR,3]`` has a 3-wide output — ~2% of the MXU lane width — so this
+kernel instead:
+
+  * tiles rows into VMEM (grid over row tiles, accumulating across steps);
+  * builds the one-hot for a GROUP of features at once into a VMEM scratch
+    ``[TR, FG*B_pad]`` via per-feature iota compares (VPU work, one [TR,B]
+    block store per feature — no MXU involvement);
+  * contracts ``ghc6[TR, 6] x onehot[TR, FG*B_pad] -> [6, FG*B_pad]`` — the
+    contraction (TR) and lane (FG*B_pad ~ 2048) dims are both MXU-sized, so
+    one wide matmul replaces FG narrow ones;
+  * ghc6 packs (g, h, count) split hi/lo into two bf16 terms each: the
+    one-hot factor is exact in bf16 and the residual carries ~8 extra
+    mantissa bits, giving ~2^-16 relative accuracy per element at full MXU
+    speed (ADVICE r1: this is NOT bit-exact f32 — the residual is itself
+    re-rounded to bf16; oracle tests bound the error).
+
+HBM traffic is exactly bins + ghc read once; the VMEM-resident accumulation
+mirrors the CUDA kernel's shared-memory histogram.
 """
 
 from __future__ import annotations
@@ -28,10 +40,24 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
-_TILE_ROWS = 2048
+_TILE_ROWS = 1024
+_TARGET_LANES = 2048  # FG*B_pad per matmul
 
 
-def _hist_kernel(bins_ref, ghc_ref, out_ref, *, num_features: int, num_bins: int):
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _hist_kernel(
+    bins_ref,
+    ghc_ref,
+    out_ref,
+    onehot_ref,
+    *,
+    num_features: int,
+    bpad: int,
+    group: int,
+):
     step = pl.program_id(0)
 
     @pl.when(step == 0)
@@ -39,29 +65,42 @@ def _hist_kernel(bins_ref, ghc_ref, out_ref, *, num_features: int, num_bins: int
         out_ref[...] = jnp.zeros_like(out_ref)
 
     ghc_t = ghc_ref[...]  # [TR, 3] f32 (mask already folded in)
-    bins_t = bins_ref[...]  # [TR, F] int32
-    iota = jax.lax.iota(jnp.int32, num_bins)
-    # Split each stat into two bf16 terms (hi + lo).  The one-hot factor is
-    # exactly representable in bf16, so both partial products are EXACT and
-    # only the f32 accumulation rounds — full fp32-sum accuracy at bf16 MXU
-    # speed (2 fast passes instead of 6 under Precision.HIGHEST).
+    bins_t = bins_ref[...].astype(jnp.int32)  # [TR, F]
+    tr = ghc_t.shape[0]
+    # hi/lo bf16 split packed as one [TR, 6] operand -> single wide matmul
     ghc_hi = ghc_t.astype(jnp.bfloat16)
     ghc_lo = (ghc_t - ghc_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    for f in range(num_features):
-        col = bins_t[:, f]
-        onehot = (col[:, None] == iota[None, :]).astype(jnp.bfloat16)  # [TR, B]
-        dims = (((0,), (0,)), ((), ()))
-        part = jax.lax.dot_general(
-            onehot, ghc_hi, dimension_numbers=dims, preferred_element_type=jnp.float32
-        ) + jax.lax.dot_general(
-            onehot, ghc_lo, dimension_numbers=dims, preferred_element_type=jnp.float32
-        )  # [B, 3]
-        out_ref[f, :, :] += part
+    ghc6 = jnp.concatenate([ghc_hi, ghc_lo], axis=1)  # [TR, 6]
+
+    iota = jax.lax.broadcasted_iota(jnp.int32, (tr, bpad), 1)
+    ngroups = (num_features + group - 1) // group
+    for gi in range(ngroups):
+        base = gi * group
+        nf = min(group, num_features - base)
+        for j in range(nf):
+            col = bins_t[:, base + j]
+            onehot_ref[:, j * bpad : (j + 1) * bpad] = (
+                col[:, None] == iota
+            ).astype(jnp.bfloat16)
+        if nf < group:  # tail group: clear stale columns
+            onehot_ref[:, nf * bpad :] = jnp.zeros(
+                (tr, (group - nf) * bpad), jnp.bfloat16
+            )
+        part6 = jax.lax.dot_general(
+            ghc6,
+            onehot_ref[...],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [6, FG*bpad]
+        width = nf * bpad  # tail group writes only its live columns
+        out_ref[:, base * bpad : base * bpad + width] += (
+            part6[:3, :width] + part6[3:, :width]
+        )
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))
 def histogram_pallas(
-    bins: jnp.ndarray,  # [N, F] int32
+    bins: jnp.ndarray,  # [N, F] integer bins (int8/uint8/int32 ...)
     grad: jnp.ndarray,  # [N] f32
     hess: jnp.ndarray,  # [N] f32
     mask: jnp.ndarray,  # [N] f32
@@ -70,28 +109,42 @@ def histogram_pallas(
 ) -> jnp.ndarray:
     """Masked histogram [F, B, 3] = (sum_g, sum_h, count) per (feature, bin)."""
     n, f = bins.shape
+    if f == 0:  # all-constant datasets: platform_dependent traces all branches
+        return jnp.zeros((0, num_bins, 3), jnp.float32)
+    if pltpu is None:  # no TPU pallas support in this install
+        from ..histogram import leaf_histogram_segment
+
+        return leaf_histogram_segment(bins, grad, hess, mask, num_bins)
     ghc = jnp.stack([grad * mask, hess * mask, mask], axis=1)  # [N, 3]
-    tr = min(_TILE_ROWS, max(256, 1 << (n - 1).bit_length()))
+    bpad = _round_up(max(num_bins, 1), 128)
+    group = max(1, _TARGET_LANES // bpad)
+    group = min(group, f)
+    tr = min(_TILE_ROWS, max(256, 1 << (n - 1).bit_length() if n > 1 else 256))
     pad = (-n) % tr
     if pad:
         bins = jnp.pad(bins, ((0, pad), (0, 0)))
         ghc = jnp.pad(ghc, ((0, pad), (0, 0)))
     tiles = (n + pad) // tr
 
-    kernel = functools.partial(_hist_kernel, num_features=f, num_bins=num_bins)
-    return pl.pallas_call(
+    kernel = functools.partial(
+        _hist_kernel, num_features=f, bpad=bpad, group=group
+    )
+    out = pl.pallas_call(
         kernel,
         grid=(tiles,),
         in_specs=[
             pl.BlockSpec((tr, f), lambda i: (i, 0)),
             pl.BlockSpec((tr, 3), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((f, num_bins, 3), lambda i: (0, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((f, num_bins, 3), jnp.float32),
+        out_specs=pl.BlockSpec((3, f * bpad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, f * bpad), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((tr, group * bpad), jnp.bfloat16)],
         interpret=interpret,
         compiler_params=(
             pltpu.CompilerParams(dimension_semantics=("arbitrary",))
-            if (pltpu is not None and not interpret)
+            if not interpret
             else None
         ),
-    )(bins.astype(jnp.int32), ghc)
+    )(bins, ghc)
+    # [3, F*bpad] -> [F, B, 3]
+    return out.reshape(3, f, bpad)[:, :, :num_bins].transpose(1, 2, 0)

@@ -36,17 +36,21 @@ def problem():
     return bins, label
 
 
-def _single_device_tree(bins, label, params):
-    grad = jnp.asarray(label) * 0 + (0.0 - jnp.asarray(label))
-    hess = jnp.ones(N, jnp.float32)
+def _single_device_tree(bins, label, params, device):
+    # Pin the reference run to the SAME platform as the mesh (CPU): the tree
+    # must be identical to the sharded run, and cross-backend f32 reduction
+    # order differences can legitimately flip near-tied splits.
+    put = lambda x: jax.device_put(x, device)  # noqa: E731
+    grad = put(0.0 - np.asarray(label))
+    hess = put(np.ones(N, np.float32))
     tree, leaf_id = grow_tree(
-        jnp.asarray(bins),
+        put(np.asarray(bins)),
         grad,
         hess,
-        jnp.ones(N, jnp.float32),
-        jnp.full((F,), MAX_BIN, jnp.int32),
-        jnp.full((F,), -1, jnp.int32),
-        jnp.ones((F,), bool),
+        put(np.ones(N, np.float32)),
+        put(np.full((F,), MAX_BIN, np.int32)),
+        put(np.full((F,), -1, np.int32)),
+        put(np.ones((F,), bool)),
         params,
     )
     return tree, leaf_id
@@ -55,7 +59,7 @@ def _single_device_tree(bins, label, params):
 def test_sharded_tree_equals_single_device(problem, cpu_mesh_devices):
     bins, label = problem
     params_local = GrowerParams(num_leaves=15, max_bin=MAX_BIN, min_data_in_leaf=5)
-    tree_ref, _ = _single_device_tree(bins, label, params_local)
+    tree_ref, _ = _single_device_tree(bins, label, params_local, cpu_mesh_devices[0])
 
     mesh = Mesh(np.array(cpu_mesh_devices[:8]), (DATA_AXIS,))
     params_mesh = GrowerParams(
