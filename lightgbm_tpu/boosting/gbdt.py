@@ -28,7 +28,13 @@ from ..config import Config
 from ..dataset import Dataset
 from ..metrics import Metric, create_metric
 from ..objectives import ObjectiveFunction, create_objective
-from ..ops.grower import GrowerParams, fetch_tree_arrays, grow_tree
+from ..ops.grower import (
+    GrowerParams,
+    fetch_tree_arrays,
+    grow_tree,
+    pack_tree_arrays,
+    unpack_tree_arrays,
+)
 from ..predict import (
     BinTreeBatch,
     add_tree_to_score,
@@ -72,6 +78,8 @@ class Booster:
         self.params: Dict[str, Any] = dict(params or {})
         self.best_iteration = -1
         self.best_score: Dict[str, Dict[str, float]] = {}
+        self._pending: Optional[dict] = None  # async tree fetch in flight
+        self._finished = False  # no-more-splits latch (pipelined path)
         self.models_: List[Tree] = []
         self._bin_records: List[Optional[dict]] = []  # bin-space mirror per tree
         self.train_set: Optional[Dataset] = None
@@ -104,6 +112,165 @@ class Booster:
         if train_set is None:
             raise ValueError("Booster needs train_set, model_file, or model_str")
         self._init_train(train_set)
+
+    # ------------------------------------------------------------- pipelining
+    # Under a remote-attached TPU every host fetch is a full tunnel round
+    # trip (~100ms measured), where the reference pays nothing (in-process
+    # C++).  The pipelined update path therefore copies the packed tree
+    # arrays back ASYNCHRONOUSLY and materializes host Trees one iteration
+    # late, overlapping the transfer with the next iteration's device
+    # compute.  models_/_bin_records are properties so ANY reader first
+    # drains the in-flight fetch — host state is always consistent.
+
+    @property
+    def models_(self) -> List[Tree]:
+        self._drain_pending()
+        return self._models_store
+
+    @models_.setter
+    def models_(self, value: List[Tree]) -> None:
+        self._models_store = value
+
+    @property
+    def _bin_records(self) -> List[Optional[dict]]:
+        self._drain_pending()
+        return self._bin_records_store
+
+    @_bin_records.setter
+    def _bin_records(self, value: List[Optional[dict]]) -> None:
+        self._bin_records_store = value
+
+    def _drain_pending(self) -> None:
+        pend = getattr(self, "_pending", None)
+        if pend is None:
+            return
+        self._pending = None
+        self._process_pending(pend)
+
+    def _process_pending(self, pend: dict) -> None:
+        decoded = []
+        should_continue = False
+        for kk, ints_d, floats_d, nn, L in pend["classes"]:
+            if ints_d is None:
+                decoded.append((kk, None))
+                continue
+            ta_host = unpack_tree_arrays(
+                np.asarray(ints_d), np.asarray(floats_d), nn, L
+            )
+            if int(ta_host.num_leaves) > 1:
+                should_continue = True
+            decoded.append((kk, ta_host))
+        if not should_continue:
+            # no class found a positive-gain split: the iteration left no
+            # trace (leaf values were zeroed on device), undo its counter and
+            # latch finished — reference returns is_finished without
+            # appending (gbdt.cpp:428)
+            self._iter -= 1
+            self._finished = True
+            return
+        for kk, ta_host in decoded:
+            if ta_host is not None and int(ta_host.num_leaves) > 1:
+                tree = Tree.from_device_arrays(
+                    ta_host,
+                    self.train_set.bin_mappers,
+                    self.train_set.used_features,
+                )
+                tree.apply_shrinkage(pend["rate"])
+                nn = int(ta_host.num_leaves) - 1
+                rec = {
+                    "split_feature": np.asarray(ta_host.split_feature)[:nn],
+                    "split_bin": np.asarray(ta_host.split_bin)[:nn],
+                    "default_left": np.asarray(ta_host.default_left)[:nn],
+                    "left_child": np.asarray(ta_host.left_child)[:nn],
+                    "right_child": np.asarray(ta_host.right_child)[:nn],
+                    "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
+                }
+            else:
+                tree = Tree.constant_tree(0.0)
+                rec = {
+                    "split_feature": np.zeros(0, np.int32),
+                    "split_bin": np.zeros(0, np.int32),
+                    "default_left": np.zeros(0, bool),
+                    "left_child": np.zeros(0, np.int32),
+                    "right_child": np.zeros(0, np.int32),
+                    "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
+                }
+            self._models_store.append(tree)
+            self._bin_records_store.append(rec)
+            self._bump_model_version()
+
+    def _update_pipelined(self, grad, hess, mask, feature_mask, k: int) -> bool:
+        """Dispatch one iteration's device work; defer host bookkeeping.
+
+        The PREVIOUS iteration's pending fetch is processed AFTER this
+        iteration's device work is queued, so the tunnel transfer and host
+        bookkeeping overlap device compute (steady-state wall time per iter
+        = max(device tree time, fetch latency))."""
+        prev = self._pending
+        self._pending = None
+        score_snapshot = self._score
+        valid_snapshots = [e.score for e in self._valid]
+        pend = []
+        for kk in range(k):
+            if self._class_need_train[kk] and self._bins.shape[1] > 0:
+                ta, leaf_id = grow_tree(
+                    self._bins,
+                    grad[kk],
+                    hess[kk],
+                    mask,
+                    self._num_bins,
+                    self._nan_bins,
+                    feature_mask,
+                    self._grower_params,
+                    monotone=self._monotone,
+                    interaction_sets=self._interaction_sets,
+                    rng=(
+                        self._next_rng()
+                        if self.config.feature_fraction_bynode < 1.0
+                        else None
+                    ),
+                )
+                shrunk = ta.leaf_value * self._shrinkage_rate
+                self._score = self._score.at[kk].add(shrunk[leaf_id])
+                for entry in self._valid:
+                    entry.score = entry.score.at[kk].set(
+                        add_tree_to_score(
+                            entry.score[kk],
+                            entry.dataset.device_bins(),
+                            self._nan_bins,
+                            ta.split_feature,
+                            ta.split_bin,
+                            ta.default_left,
+                            ta.left_child,
+                            ta.right_child,
+                            shrunk,
+                        )
+                    )
+                ints_d, floats_d = pack_tree_arrays(ta)
+                ints_d.copy_to_host_async()
+                floats_d.copy_to_host_async()
+                pend.append(
+                    (kk, ints_d, floats_d, ta.split_feature.shape[0], ta.leaf_value.shape[0])
+                )
+            else:
+                pend.append((kk, None, None, 0, 0))
+        self._pending = {"classes": pend, "rate": self._shrinkage_rate}
+        self._iter += 1
+        if prev is not None:
+            self._process_pending(prev)
+            if self._finished:
+                # the previous iteration found no split: training stopped
+                # THERE, so the iteration just dispatched must leave no trace
+                # — restore the score snapshots and drop it (its gradients
+                # could differ under bagging, so zero-contribution is not
+                # guaranteed otherwise)
+                self._score = score_snapshot
+                for e, s in zip(self._valid, valid_snapshots):
+                    e.score = s
+                self._pending = None
+                self._iter -= 1
+                return True
+        return False
 
     # ================================================================ training
     def _init_train(self, train_set: Dataset) -> None:
@@ -369,6 +536,34 @@ class Booster:
         k = self.num_tree_per_iteration
         n = self.train_set.num_data
 
+        if self._finished:
+            return True
+        # pipeline gate BEFORE any drain: reading models_ would block on the
+        # in-flight fetch and serialize host bookkeeping with device compute
+        eff_len = len(self._models_store) + (
+            k if getattr(self, "_pending", None) is not None else 0
+        )
+        if (
+            fobj is None
+            and self.objective is not None
+            and not self.objective.is_renew_tree_output
+            and not cfg.linear_tree
+            and type(self) is Booster
+            and eff_len >= k  # init/boost-from-avg settled
+        ):
+            grad, hess = self.objective.get_gradients(
+                self._score, self._next_rng()
+            )
+            mask, grad, hess = self._sampler.sample(
+                self._iter, grad, hess, self._next_rng()
+            )
+            feature_mask = self._feature_mask_for_iter()
+            return self._update_pipelined(grad, hess, mask, feature_mask, k)
+
+        self._drain_pending()
+        if self._finished:
+            return True
+
         init_scores = [0.0] * k
         if fobj is None:
             if (
@@ -621,6 +816,7 @@ class Booster:
             self._bin_records.pop()
         self._bump_model_version()
         self._iter -= 1
+        self._finished = False
         return self
 
     # ================================================================== eval
@@ -1002,6 +1198,7 @@ class Booster:
         self.params.update(params)
         self.config = Config.from_params(self.params)
         self._shrinkage_rate = self.config.learning_rate
+        self._finished = False
         if self.train_set is not None:
             self._setup_constraints()
             self._grower_params = self._make_grower_params()

@@ -51,11 +51,12 @@ class GrowerParams:
     max_delta_step: float = 0.0
     hist_method: str = "auto"
     axis_name: Optional[str] = None
-    # "gather": compact the smaller child's rows into a static-capacity
-    # buffer before the histogram pass (rows touched ~ N*log L per tree,
-    # the reference's ordered_gradients complexity); "full": masked pass
-    # over all rows per split (rows touched ~ N*L).
-    hist_mode: str = "gather"
+    # "ordered": maintain a leaf-contiguous row permutation (the reference's
+    # DataPartition index array, data_partition.hpp) so every per-split op —
+    # partition, gather, histogram — costs O(parent segment), never O(N);
+    # "gather": leaf-id vector + per-split jnp.nonzero compaction (O(N) per
+    # split for the nonzero); "full": masked pass over all rows per split.
+    hist_mode: str = "ordered"
     path_smooth: float = 0.0
     use_monotone: bool = False  # monotone_constraints (basic method)
     use_interaction: bool = False  # interaction_constraints
@@ -76,9 +77,24 @@ def _hist_caps(n: int, full_range: bool = False) -> list:
     floor_cap = min(4096, cap)
     while cap > floor_cap:
         caps.append(cap)
-        cap //= 4
+        cap //= 2
     caps.append(cap)
     return caps  # descending
+
+
+def _part_caps(n: int) -> list:
+    """Static capacity ladder for PARENT segments in ordered mode: the root
+    holds all n rows, so the top is pow2ceil(n); pow-2 steps down to 8192
+    bound both the wasted work (<2x the true segment size) and the number of
+    compiled partition branches."""
+    caps = []
+    cap = 1 << max(0, (max(n, 1) - 1).bit_length())
+    floor_cap = min(8192, cap)
+    while cap > floor_cap:
+        caps.append(cap)
+        cap //= 2
+    caps.append(cap)
+    return sorted(caps)  # ascending
 
 
 class TreeArrays(NamedTuple):
@@ -107,7 +123,10 @@ class TreeArrays(NamedTuple):
 
 
 class _State(NamedTuple):
-    leaf_id: jnp.ndarray
+    leaf_id: jnp.ndarray  # [N] (gather/full modes; empty in ordered mode)
+    order: jnp.ndarray  # [N + maxcap] row permutation (ordered mode; else empty)
+    leaf_begin: jnp.ndarray  # [L] segment start per leaf (ordered mode)
+    leaf_nrows: jnp.ndarray  # [L] RAW row count per leaf (ordered mode)
     hist_buf: jnp.ndarray  # [L, F, B, 3]
     leaf_g: jnp.ndarray
     leaf_h: jnp.ndarray
@@ -200,15 +219,8 @@ def pack_tree_arrays(ta: "TreeArrays"):
     return ints, floats
 
 
-def fetch_tree_arrays(ta: "TreeArrays") -> "TreeArrays":
-    """Pull a device TreeArrays to host as numpy with two transfers."""
-    import numpy as np
-
-    ints_d, floats_d = pack_tree_arrays(ta)
-    ints = np.asarray(ints_d)
-    floats = np.asarray(floats_d)
-    nn = ta.split_feature.shape[0]  # L - 1
-    L = ta.leaf_value.shape[0]
+def unpack_tree_arrays(ints, floats, nn: int, L: int) -> "TreeArrays":
+    """Decode host (ints, floats) from pack_tree_arrays into a TreeArrays."""
     io = [ints[i * nn : (i + 1) * nn] for i in range(4)]
     off = 4 * nn
     default_left = ints[off : off + nn].astype(bool)
@@ -233,6 +245,16 @@ def fetch_tree_arrays(ta: "TreeArrays") -> "TreeArrays":
         leaf_depth=leaf_depth,
         num_leaves=num_leaves,
     )
+
+
+def fetch_tree_arrays(ta: "TreeArrays") -> "TreeArrays":
+    """Pull a device TreeArrays to host as numpy with two transfers."""
+    import numpy as np
+
+    ints_d, floats_d = pack_tree_arrays(ta)
+    nn = ta.split_feature.shape[0]  # L - 1
+    L = ta.leaf_value.shape[0]
+    return unpack_tree_arrays(np.asarray(ints_d), np.asarray(floats_d), nn, L)
 
 
 @functools.partial(jax.jit, static_argnames=("params",))
@@ -270,9 +292,12 @@ def grow_tree(
             m = m & (jax.random.uniform(key, (f,)) < p.feature_fraction_bynode)
         return m
 
+    use_ordered = p.hist_mode == "ordered" and f > 0 and n > 1
     use_gather = p.hist_mode == "gather" and f > 0 and n > 1
-    if use_gather:
-        caps = sorted(_hist_caps(n, full_range=p.axis_name is not None))  # ascending
+    if use_ordered or use_gather:
+        caps = sorted(
+            _hist_caps(n, full_range=p.axis_name is not None)
+        )  # ascending child-histogram capacities
         caps_arr = jnp.asarray(caps, dtype=jnp.int32)
         # one zero padding row so fill indices contribute nothing
         bins_pad = jnp.concatenate([bins, jnp.zeros((1, f), bins.dtype)], axis=0)
@@ -280,6 +305,7 @@ def grow_tree(
         hess_pad = jnp.concatenate([hess, jnp.zeros((1,), hess.dtype)])
         mask_pad = jnp.concatenate([count_mask, jnp.zeros((1,), count_mask.dtype)])
 
+    if use_gather:
         def _make_hist_branch(cap: int):
             # nonzero lives INSIDE the branch so its scatter is sized to the
             # branch capacity — deep (small) leaves compact into small buffers
@@ -302,6 +328,68 @@ def grow_tree(
     # transposed copy for contiguous per-feature column reads in the
     # partition step (bins is row-major; a column gather is strided)
     bins_t_cols = bins.T if f > 0 else bins.reshape(f, n)
+
+    if use_ordered:
+        # ---- ordered-partition machinery (reference DataPartition,
+        # data_partition.hpp: one index array, leaves occupy contiguous
+        # segments).  All per-split work is sized by a static capacity
+        # bucket of the PARENT segment, never by N.
+        pcaps = _part_caps(n)
+        pcaps_arr = jnp.asarray(pcaps, dtype=jnp.int32)
+        order_len = n + pcaps[-1]
+        bins_t_pad = jnp.concatenate(
+            [bins_t_cols, jnp.zeros((f, 1), bins.dtype)], axis=1
+        )  # [F, n+1] — sentinel column for padded order entries
+
+        def _make_part_branch(P: int):
+            def branch(op):
+                order, begin_l, cnt_l, feat, tbin, dl = op
+                idx = lax.dynamic_slice(order, (begin_l,), (P,))
+                valid = jnp.arange(P, dtype=jnp.int32) < cnt_l
+                featrow = lax.dynamic_slice_in_dim(bins_t_pad, feat, 1, axis=0)[0]
+                colv = featrow[idx]
+                nb = nan_bins[feat]
+                gl = ((colv <= tbin) | (dl & (nb >= 0) & (colv == nb))) & valid
+                gr = valid & ~gl
+                nleft = jnp.sum(gl).astype(jnp.int32)
+                # stable partition: left rows -> [0, nleft), right rows ->
+                # [nleft, cnt_l), rows beyond the segment stay untouched
+                pos_l = jnp.cumsum(gl) - 1
+                pos_r = nleft + jnp.cumsum(gr) - 1
+                pos = jnp.where(gl, pos_l, jnp.where(gr, pos_r, P)).astype(
+                    jnp.int32
+                )
+                new_seg = (
+                    jnp.full((P,), n, order.dtype).at[pos].set(idx, mode="drop")
+                )
+                new_seg = jnp.where(valid, new_seg, idx)
+                order = lax.dynamic_update_slice(order, new_seg, (begin_l,))
+                return order, nleft
+
+            return branch
+
+        part_branches = [_make_part_branch(c) for c in pcaps]
+
+        def _make_hist_branch_ordered(C: int):
+            def branch(op):
+                order, start, child_cnt = op
+                cidx = lax.dynamic_slice(order, (start,), (C,))
+                vmask = (
+                    jnp.arange(C, dtype=jnp.int32) < child_cnt
+                ).astype(count_mask.dtype)
+                return leaf_histogram(
+                    bins_pad[cidx],
+                    grad_pad[cidx],
+                    hess_pad[cidx],
+                    mask_pad[cidx] * vmask,
+                    B,
+                    method=p.hist_method,
+                    axis_name=p.axis_name,
+                )
+
+            return branch
+
+        hist_branches_ordered = [_make_hist_branch_ordered(c) for c in caps]
 
     hist0 = leaf_histogram(
         bins, grad, hess, count_mask, B, method=p.hist_method, axis_name=p.axis_name
@@ -334,8 +422,27 @@ def grow_tree(
     )
     cand = _set_cand(cand, 0, cand0)
 
+    if use_ordered:
+        order0 = jnp.concatenate(
+            [
+                jnp.arange(n, dtype=jnp.int32),
+                jnp.full((order_len - n,), n, jnp.int32),
+            ]
+        )
+        leaf_begin0 = jnp.zeros((L,), jnp.int32)
+        leaf_nrows0 = jnp.zeros((L,), jnp.int32).at[0].set(n)
+        leaf_id0 = jnp.zeros((0,), jnp.int32)
+    else:
+        order0 = jnp.zeros((0,), jnp.int32)
+        leaf_begin0 = jnp.zeros((0,), jnp.int32)
+        leaf_nrows0 = jnp.zeros((0,), jnp.int32)
+        leaf_id0 = jnp.zeros((n,), jnp.int32)
+
     state = _State(
-        leaf_id=jnp.zeros((n,), jnp.int32),
+        leaf_id=leaf_id0,
+        order=order0,
+        leaf_begin=leaf_begin0,
+        leaf_nrows=leaf_nrows0,
         hist_buf=jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(hist0),
         leaf_g=jnp.zeros((L,), jnp.float32).at[0].set(totals[0]),
         leaf_h=jnp.zeros((L,), jnp.float32).at[0].set(totals[1]),
@@ -351,8 +458,10 @@ def grow_tree(
         split_bin=jnp.zeros((L - 1,), jnp.int32),
         split_gain=jnp.zeros((L - 1,), jnp.float32),
         default_left=jnp.zeros((L - 1,), bool),
-        left_child=jnp.zeros((L - 1,), jnp.int32),
-        right_child=jnp.zeros((L - 1,), jnp.int32),
+        # unused nodes point at leaf 0 (~0 = -1) so walking a trivial tree
+        # (no splits recorded) terminates instead of spinning on node 0
+        left_child=jnp.full((L - 1,), -1, jnp.int32),
+        right_child=jnp.full((L - 1,), -1, jnp.int32),
         internal_value=jnp.zeros((L - 1,), jnp.float32),
         internal_weight=jnp.zeros((L - 1,), jnp.float32),
         internal_count=jnp.zeros((L - 1,), jnp.float32),
@@ -375,11 +484,30 @@ def grow_tree(
             dl = st.cand.default_left[l]
 
             # ---- partition rows of leaf l (reference DataPartition::Split)
-            col = lax.dynamic_slice_in_dim(bins_t_cols, feat, 1, axis=0)[0]
-            nb = nan_bins[feat]
-            go_left = (col <= tbin) | (dl & (nb >= 0) & (col == nb))
-            in_leaf = st.leaf_id == l
-            leaf_id = jnp.where(in_leaf & ~go_left, nl, st.leaf_id)
+            if use_ordered:
+                # stable in-place partition of the parent's contiguous
+                # segment, sized by its capacity bucket — O(parent), not O(N)
+                begin_l = st.leaf_begin[l]
+                cnt_l = st.leaf_nrows[l]
+                pbucket = jnp.clip(
+                    jnp.searchsorted(pcaps_arr, cnt_l, side="left"),
+                    0,
+                    len(pcaps) - 1,
+                ).astype(jnp.int32)
+                order, nleft = lax.switch(
+                    pbucket,
+                    part_branches,
+                    (st.order, begin_l, cnt_l, feat, tbin, dl),
+                )
+                nright = cnt_l - nleft
+                leaf_id = st.leaf_id
+            else:
+                order = st.order
+                col = lax.dynamic_slice_in_dim(bins_t_cols, feat, 1, axis=0)[0]
+                nb = nan_bins[feat]
+                go_left = (col <= tbin) | (dl & (nb >= 0) & (col == nb))
+                in_leaf = st.leaf_id == l
+                leaf_id = jnp.where(in_leaf & ~go_left, nl, st.leaf_id)
 
             # ---- record node t (reference Tree::Split, src/io/tree.cpp:65)
             pg, ph, pc = st.leaf_g[l], st.leaf_h[l], st.leaf_cnt[l]
@@ -419,7 +547,31 @@ def grow_tree(
             # over that buffer — the TPU formulation of the reference's
             # ordered_gradients gather (rows touched per tree ~ N log L).
             parent_hist = st.hist_buf[l]
-            if use_gather:
+            if use_ordered:
+                if p.axis_name is not None:
+                    # global smaller-child choice + pmax'd capacity bucket so
+                    # every shard histograms the SAME child (see gather-mode
+                    # comment below)
+                    nleft_g = lax.psum(nleft, p.axis_name)
+                    nright_g = lax.psum(nright, p.axis_name)
+                    left_smaller = nleft_g <= nright_g
+                    tc = lax.pmax(
+                        jnp.where(left_smaller, nleft, nright), p.axis_name
+                    )
+                else:
+                    left_smaller = nleft <= nright
+                    tc = jnp.minimum(nleft, nright)
+                child_start = begin_l + jnp.where(left_smaller, 0, nleft)
+                child_cnt = jnp.where(left_smaller, nleft, nright)
+                cbucket = jnp.clip(
+                    jnp.searchsorted(caps_arr, tc, side="left"), 0, len(caps) - 1
+                ).astype(jnp.int32)
+                sm = lax.switch(
+                    cbucket,
+                    hist_branches_ordered,
+                    (order, child_start, child_cnt),
+                )
+            elif use_gather:
                 # choose the smaller child by RAW row count (capacity bound);
                 # masked (bagging) stats still flow through lc/rc above
                 rows_l = jnp.sum(in_leaf & go_left).astype(jnp.int32)
@@ -523,8 +675,17 @@ def grow_tree(
                 cand, nl, cand_r, jnp.where(depth_ok, cand_r.gain, -jnp.inf)
             )
 
+            if use_ordered:
+                leaf_begin = st.leaf_begin.at[nl].set(begin_l + nleft)
+                leaf_nrows = st.leaf_nrows.at[l].set(nleft).at[nl].set(nright)
+            else:
+                leaf_begin, leaf_nrows = st.leaf_begin, st.leaf_nrows
+
             return _State(
                 leaf_id=leaf_id,
+                order=order,
+                leaf_begin=leaf_begin,
+                leaf_nrows=leaf_nrows,
                 hist_buf=hist_buf,
                 leaf_g=leaf_g,
                 leaf_h=leaf_h,
@@ -569,7 +730,10 @@ def grow_tree(
         out = out * ratio / (ratio + 1.0) + parent_out / (ratio + 1.0)
     if use_mono:
         out = jnp.clip(out, state.leaf_lb, state.leaf_ub)
-    leaf_value = jnp.where(active, out, 0.0)
+    # a tree with no splits contributes NOTHING (reference outputs a const-0
+    # tree and stops, gbdt.cpp:428) — zeroing here lets the booster dispatch
+    # the score update before knowing num_leaves on host (async pipeline)
+    leaf_value = jnp.where(active & (state.num_leaves > 1), out, 0.0)
 
     tree = TreeArrays(
         split_feature=state.split_feature,
@@ -587,4 +751,30 @@ def grow_tree(
         leaf_depth=state.leaf_depth,
         num_leaves=state.num_leaves,
     )
+
+    if use_ordered:
+        # reconstruct the per-row leaf-id vector from the segment layout in
+        # ONE O(N) pass: mark each active leaf's segment start, turn starts
+        # into segment ordinals via cumsum, map ordinals to leaf indices via
+        # a begin-sorted permutation, scatter through the row permutation.
+        # Zero-row leaves sort BEFORE the non-empty leaf sharing their begin
+        # (key = 2*begin + (nrows>0)) so the cumsum lands on the real owner.
+        begin_marks = jnp.where(active, state.leaf_begin, n)
+        marker = (
+            jnp.zeros((n,), jnp.int32).at[begin_marks].add(1, mode="drop")
+        )
+        sort_key = jnp.where(
+            active,
+            2 * state.leaf_begin + (state.leaf_nrows > 0).astype(jnp.int32),
+            2 * n + 2,
+        )
+        sorted_leaf = jnp.argsort(sort_key, stable=True).astype(jnp.int32)
+        seg_ord = jnp.clip(jnp.cumsum(marker) - 1, 0, L - 1)
+        leaf_of_pos = sorted_leaf[seg_ord]
+        leaf_id = (
+            jnp.zeros((n,), jnp.int32)
+            .at[state.order[:n]]
+            .set(leaf_of_pos, mode="drop")
+        )
+        return tree, leaf_id
     return tree, state.leaf_id
