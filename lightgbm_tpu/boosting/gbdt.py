@@ -62,7 +62,15 @@ class _EvalEntry:
         self.name = name
         self.dataset = dataset
         self.metrics = metrics
-        self.score: Optional[jnp.ndarray] = None  # [K, N]
+        self.score: Optional[jnp.ndarray] = None  # [K, N(+pad)]
+        self.dev_bins = None  # row-sharded over the booster mesh when set
+        self.pad = 0  # mesh row padding of score/dev_bins
+
+    @property
+    def bins(self) -> jnp.ndarray:
+        if self.dev_bins is None:
+            return self.dataset.device_bins()
+        return self.dev_bins
 
 
 class Booster:
@@ -213,18 +221,12 @@ class Booster:
         pend = []
         for kk in range(k):
             if self._class_need_train[kk] and self._bins.shape[1] > 0:
-                ta, leaf_id = grow_tree(
-                    self._bins,
+                ta, leaf_id = self._grow_one(
                     grad[kk],
                     hess[kk],
                     mask,
-                    self._num_bins,
-                    self._nan_bins,
                     feature_mask,
-                    self._grower_params,
-                    monotone=self._monotone,
-                    interaction_sets=self._interaction_sets,
-                    rng=(
+                    (
                         self._next_rng()
                         if self.config.feature_fraction_bynode < 1.0
                         else None
@@ -236,7 +238,7 @@ class Booster:
                     entry.score = entry.score.at[kk].set(
                         add_tree_to_score(
                             entry.score[kk],
-                            entry.dataset.device_bins(),
+                            entry.bins,
                             self._nan_bins,
                             ta.split_feature,
                             ta.split_bin,
@@ -280,6 +282,35 @@ class Booster:
         cfg = self.config
         self.objective = create_objective(cfg)
         md = train_set.metadata
+        n = train_set.num_data
+
+        # ---- distributed: tree_learner data/feature/voting over a device
+        # mesh (reference parallel learners, src/treelearner/
+        # data_parallel_tree_learner.cpp — parallel/__init__.py documents the
+        # psum mapping). Rows are padded to a multiple of the mesh size with
+        # weight-0 rows so shards stay equal-sized (static shapes).
+        self._mesh = None
+        self._pad_rows = 0
+        if cfg.tree_learner in ("data", "feature", "voting"):
+            from jax.sharding import Mesh
+
+            from ..parallel import DATA_AXIS, choose_devices
+
+            devices = choose_devices()
+            if devices is not None and self.objective is not None and self.objective.need_query:
+                dn = len(devices)
+                while dn > 1 and n % dn != 0:
+                    dn -= 1  # ranking rows can't be weight-0 padded
+                devices = devices[:dn] if dn > 1 else None
+            if devices is not None:
+                self._mesh = Mesh(np.array(devices), (DATA_AXIS,))
+                self._pad_rows = (-n) % len(devices)
+        pad = self._pad_rows
+        n_dev = n + pad  # device-side row count (>= n)
+
+        # the objective is initialized on the UNPADDED data so its host-side
+        # statistics (class priors, is_unbalance weights, percentiles) are
+        # exact; only its per-row DEVICE arrays get padded + mesh-placed below
         if self.objective is not None:
             self.objective.init(
                 md.label, md.weight, md.query_boundaries, md.position
@@ -295,19 +326,46 @@ class Booster:
         self.max_feature_idx = train_set.num_total_features - 1
         self.average_output = cfg.boosting == "rf"
 
-        n = train_set.num_data
         k = self.num_tree_per_iteration
-        init = np.zeros((k, n), dtype=np.float32)
+        init = np.zeros((k, n_dev), dtype=np.float32)
         if md.init_score is not None:
             isc = np.asarray(md.init_score, dtype=np.float32)
-            init += isc.reshape(k, n) if isc.size == k * n else isc.reshape(1, n)
+            init[:, :n] += isc.reshape(k, n) if isc.size == k * n else isc.reshape(1, n)
             self._has_init_score = True
         else:
             self._has_init_score = False
-        self._score = jnp.asarray(init)
 
         # device data
-        self._bins = train_set.device_bins()
+        if self._mesh is not None:
+            from ..parallel import pad_rows_np, shard_cols, shard_rows
+
+            self._score = shard_cols(init, self._mesh)
+            self._bins = shard_rows(pad_rows_np(train_set.bins, pad), self._mesh)
+            # the objective's per-row device arrays ride the same sharding as
+            # the score (zero-padded; padded rows' gradients are zeroed
+            # explicitly in _sample — NOT via synthetic weights, which would
+            # change semantics for objectives with non-multiplicative weights
+            # like cross_entropy_lambda, xentropy_objective.hpp:184)
+            if self.objective is not None:
+                for holder, name, axis in self.objective.per_row_device_arrays():
+                    arr = getattr(holder, name, None)
+                    if arr is None:
+                        continue
+                    a = np.asarray(arr, dtype=np.float32)
+                    if pad:
+                        widths = [(0, 0)] * a.ndim
+                        widths[axis] = (0, pad)
+                        a = np.pad(a, widths)
+                    setattr(
+                        holder,
+                        name,
+                        shard_rows(a, self._mesh)
+                        if axis == 0
+                        else shard_cols(a, self._mesh),
+                    )
+        else:
+            self._score = jnp.asarray(init)
+            self._bins = train_set.device_bins()
         nb = train_set.num_bins_per_feature()
         self._num_bins = jnp.asarray(nb, dtype=jnp.int32)
         nan_bins = np.array(
@@ -320,8 +378,29 @@ class Booster:
         self._max_bin_padded = _ceil_pow2(int(nb.max()) if len(nb) else 2)
         self._setup_constraints()
         self._grower_params = self._make_grower_params()
-        self._ones_mask = jnp.ones((n,), jnp.float32)
-        self._full_feature_mask = jnp.ones((self._bins.shape[1],), bool)
+        f_used = self._bins.shape[1]
+        if self._mesh is not None:
+            from ..parallel import make_sharded_grow, shard_rows
+
+            base = np.ones(n_dev, np.float32)
+            base[n:] = 0.0
+            self._ones_mask = shard_rows(base, self._mesh)
+            self._sharded_grow = make_sharded_grow(self._mesh, self._grower_params)
+            # shard_map needs concrete arrays for every operand: dummies for
+            # the optional ones (statically gated off inside grow_tree)
+            self._mono_arg = (
+                self._monotone
+                if self._monotone is not None
+                else jnp.zeros((f_used,), jnp.int8)
+            )
+            self._inter_arg = (
+                self._interaction_sets
+                if self._interaction_sets is not None
+                else jnp.ones((1, f_used), bool)
+            )
+        else:
+            self._ones_mask = jnp.ones((n,), jnp.float32)
+        self._full_feature_mask = jnp.ones((f_used,), bool)
         self._rng = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
         self._shrinkage_rate = cfg.learning_rate
 
@@ -329,8 +408,11 @@ class Booster:
 
         is_pos = None
         if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
-            is_pos = jnp.asarray(np.asarray(md.label) > 0)
-        self._sampler = create_sample_strategy(cfg, n, is_pos)
+            ip = np.asarray(md.label) > 0
+            if pad:
+                ip = np.concatenate([ip, np.zeros(pad, bool)])
+            is_pos = jnp.asarray(ip)
+        self._sampler = create_sample_strategy(cfg, n_dev, is_pos)
 
         # metrics for the training set
         self._train_entry = _EvalEntry(
@@ -373,6 +455,37 @@ class Booster:
                     if j in orig_to_used:
                         mat[si, orig_to_used[j]] = True
             self._interaction_sets = jnp.asarray(mat)
+
+    def _grow_one(self, grad_k, hess_k, mask, feature_mask, rng):
+        """Grow one tree: serial grow_tree or the mesh-sharded shard_map path
+        (reference: SerialTreeLearner vs DataParallelTreeLearner dispatch,
+        src/boosting/gbdt.cpp:59 tree_learner selection)."""
+        if self._mesh is not None:
+            return self._sharded_grow(
+                self._bins,
+                grad_k,
+                hess_k,
+                mask,
+                self._num_bins,
+                self._nan_bins,
+                feature_mask,
+                self._mono_arg,
+                self._inter_arg,
+                rng if rng is not None else jax.random.PRNGKey(0),
+            )
+        return grow_tree(
+            self._bins,
+            grad_k,
+            hess_k,
+            mask,
+            self._num_bins,
+            self._nan_bins,
+            feature_mask,
+            self._grower_params,
+            monotone=self._monotone,
+            interaction_sets=self._interaction_sets,
+            rng=rng,
+        )
 
     def _make_grower_params(self) -> GrowerParams:
         cfg = self.config
@@ -481,13 +594,25 @@ class Booster:
             m.init(md.label, md.weight, md.query_boundaries)
         k = self.num_tree_per_iteration
         nv = data.num_data
-        init = np.zeros((k, nv), dtype=np.float32)
+        if self._mesh is not None:
+            entry.pad = (-nv) % self._mesh.size
+        init = np.zeros((k, nv + entry.pad), dtype=np.float32)
         if md.init_score is not None:
             isc = np.asarray(md.init_score, dtype=np.float32)
-            init += isc.reshape(k, nv) if isc.size == k * nv else isc.reshape(1, nv)
-        entry.score = jnp.asarray(init)
+            init[:, :nv] += (
+                isc.reshape(k, nv) if isc.size == k * nv else isc.reshape(1, nv)
+            )
+        if self._mesh is not None:
+            from ..parallel import pad_rows_np, shard_cols, shard_rows
+
+            entry.score = shard_cols(init, self._mesh)
+            entry.dev_bins = shard_rows(
+                pad_rows_np(data.bins, entry.pad), self._mesh
+            )
+        else:
+            entry.score = jnp.asarray(init)
         # replay existing trees onto the valid score
-        vbins = data.device_bins()
+        vbins = entry.bins
         vraw = None
         for idx, rec in enumerate(self._bin_records):
             k_id = idx % k
@@ -495,9 +620,7 @@ class Booster:
                 if vraw is None:
                     vraw = self._raw_for_replay(data)
                 entry.score = entry.score.at[k_id].add(
-                    jnp.asarray(
-                        self.models_[idx].predict(vraw), dtype=jnp.float32
-                    )
+                    self._pad_delta(self.models_[idx].predict(vraw), entry.pad)
                 )
                 continue
             if rec is None or len(rec["split_feature"]) == 0:
@@ -523,6 +646,30 @@ class Booster:
     def _next_rng(self) -> jax.Array:
         self._rng, sub = jax.random.split(self._rng)
         return sub
+
+    @staticmethod
+    def _pad_delta(delta, pad: int) -> jnp.ndarray:
+        """Pad a real-space [N] per-row score delta to the mesh row width."""
+        from ..parallel import pad_rows_np
+
+        return jnp.asarray(pad_rows_np(np.asarray(delta, dtype=np.float32), pad))
+
+    def _sample(self, grad, hess):
+        """Bagging/GOSS row sampling; padded (mesh-fill) rows never count.
+
+        Padded rows' gradients are forced to exact zeros FIRST — objectives
+        compute unspecified (finite or NaN) values on the zero-filled padding
+        labels, and a NaN would poison the masked histogram (nan*0=nan)."""
+        if self._pad_rows:
+            live = self._ones_mask[None] > 0
+            grad = jnp.where(live, grad, 0.0)
+            hess = jnp.where(live, hess, 0.0)
+        mask, grad, hess = self._sampler.sample(
+            self._iter, grad, hess, self._next_rng()
+        )
+        if self._pad_rows:
+            mask = mask * self._ones_mask
+        return mask, grad, hess
 
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         """One boosting iteration (reference GBDT::TrainOneIter gbdt.cpp:352).
@@ -554,9 +701,7 @@ class Booster:
             grad, hess = self.objective.get_gradients(
                 self._score, self._next_rng()
             )
-            mask, grad, hess = self._sampler.sample(
-                self._iter, grad, hess, self._next_rng()
-            )
+            mask, grad, hess = self._sample(grad, hess)
             feature_mask = self._feature_mask_for_iter()
             return self._update_pipelined(grad, hess, mask, feature_mask, k)
 
@@ -582,36 +727,34 @@ class Booster:
             grad, hess = self.objective.get_gradients(self._score, self._next_rng())
         else:
             g, h = fobj(
-                np.asarray(self._score).reshape(-1)
+                np.asarray(self._score)[:, :n].reshape(-1)
                 if k > 1
-                else np.asarray(self._score[0]),
+                else np.asarray(self._score[0])[:n],
                 self.train_set,
             )
-            grad = jnp.asarray(np.asarray(g, dtype=np.float32).reshape(k, n))
-            hess = jnp.asarray(np.asarray(h, dtype=np.float32).reshape(k, n))
+            g = np.asarray(g, dtype=np.float32).reshape(k, n)
+            h = np.asarray(h, dtype=np.float32).reshape(k, n)
+            if self._pad_rows:
+                zeros = np.zeros((k, self._pad_rows), np.float32)
+                g = np.concatenate([g, zeros], axis=1)
+                h = np.concatenate([h, zeros], axis=1)
+            grad = jnp.asarray(g)
+            hess = jnp.asarray(h)
 
         # bagging / GOSS (reference: SampleStrategy::Bagging gbdt.cpp:384)
-        mask, grad, hess = self._sampler.sample(
-            self._iter, grad, hess, self._next_rng()
-        )
+        mask, grad, hess = self._sample(grad, hess)
         feature_mask = self._feature_mask_for_iter()
 
         should_continue = False
         for kk in range(k):
             tree_idx = len(self.models_)
             if self._class_need_train[kk] and self._bins.shape[1] > 0:
-                ta, leaf_id = grow_tree(
-                    self._bins,
+                ta, leaf_id = self._grow_one(
                     grad[kk],
                     hess[kk],
                     mask,
-                    self._num_bins,
-                    self._nan_bins,
                     feature_mask,
-                    self._grower_params,
-                    monotone=self._monotone,
-                    interaction_sets=self._interaction_sets,
-                    rng=(
+                    (
                         self._next_rng()
                         if self.config.feature_fraction_bynode < 1.0
                         else None
@@ -629,10 +772,10 @@ class Booster:
                 leaf_value = ta.leaf_value
                 if self.objective is not None and self.objective.is_renew_tree_output:
                     lv = self.objective.renew_tree_output(
-                        np.asarray(self._score[kk], dtype=np.float64),
-                        np.asarray(leaf_id),
+                        np.asarray(self._score[kk], dtype=np.float64)[:n],
+                        np.asarray(leaf_id)[:n],
                         np.asarray(ta_host.leaf_value, dtype=np.float64),
-                        np.asarray(mask),
+                        np.asarray(mask)[:n],
                     )
                     leaf_value = jnp.asarray(lv, dtype=jnp.float32)
                     ta = ta._replace(leaf_value=leaf_value)
@@ -646,10 +789,10 @@ class Booster:
                 if is_linear:
                     self._fit_linear_leaves(
                         tree,
-                        np.asarray(leaf_id),
-                        np.asarray(grad[kk], dtype=np.float64),
-                        np.asarray(hess[kk], dtype=np.float64),
-                        np.asarray(mask),
+                        np.asarray(leaf_id)[:n],
+                        np.asarray(grad[kk], dtype=np.float64)[:n],
+                        np.asarray(hess[kk], dtype=np.float64)[:n],
+                        np.asarray(mask)[:n],
                     )
                 tree.apply_shrinkage(self._shrinkage_rate)
 
@@ -659,12 +802,12 @@ class Booster:
                     # LinearTreeLearner AddPredictionToScore equivalent)
                     delta = tree.predict(self._raw_for_replay(self.train_set))
                     self._score = self._score.at[kk].add(
-                        jnp.asarray(delta, dtype=jnp.float32)
+                        self._pad_delta(delta, self._pad_rows)
                     )
                     for entry in self._valid:
                         vdelta = tree.predict(self._raw_for_replay(entry.dataset))
                         entry.score = entry.score.at[kk].add(
-                            jnp.asarray(vdelta, dtype=jnp.float32)
+                            self._pad_delta(vdelta, entry.pad)
                         )
                 else:
                     shrunk = leaf_value * self._shrinkage_rate
@@ -675,7 +818,7 @@ class Booster:
                         entry.score = entry.score.at[kk].set(
                             add_tree_to_score(
                                 entry.score[kk],
-                                entry.dataset.device_bins(),
+                                entry.bins,
                                 self._nan_bins,
                                 ta.split_feature,
                                 ta.split_bin,
@@ -767,16 +910,15 @@ class Booster:
                 # coefficients — un-apply with the same real-valued predict
                 # the forward path used
                 self._score = self._score.at[kk].add(
-                    -jnp.asarray(
-                        tree.predict(self._train_raw_for_replay()),
-                        dtype=jnp.float32,
+                    -self._pad_delta(
+                        tree.predict(self._train_raw_for_replay()), self._pad_rows
                     )
                 )
                 for entry in self._valid:
                     entry.score = entry.score.at[kk].add(
-                        -jnp.asarray(
+                        -self._pad_delta(
                             tree.predict(self._raw_for_replay(entry.dataset)),
-                            dtype=jnp.float32,
+                            entry.pad,
                         )
                     )
             elif len(rec["split_feature"]):
@@ -797,7 +939,7 @@ class Booster:
                     entry.score = entry.score.at[kk].set(
                         add_tree_to_score(
                             entry.score[kk],
-                            entry.dataset.device_bins(),
+                            entry.bins,
                             self._nan_bins,
                             jnp.asarray(rec["split_feature"]),
                             jnp.asarray(rec["split_bin"]),
@@ -823,6 +965,8 @@ class Booster:
     def _eval_entry(self, entry: _EvalEntry, feval=None) -> List[Tuple[str, str, float, bool]]:
         dev_score = self._score if entry is self._train_entry else entry.score
         score = np.asarray(dev_score, dtype=np.float64)
+        # drop mesh padding rows so metrics see the real dataset width
+        score = score[:, : entry.dataset.num_data]
         out = []
         for m in entry.metrics:
             for name, val in m.eval(score, self.objective):
@@ -1202,6 +1346,24 @@ class Booster:
         if self.train_set is not None:
             self._setup_constraints()
             self._grower_params = self._make_grower_params()
+            if self._mesh is not None:
+                # the shard_map'd grower closed over the OLD params
+                from ..parallel import make_sharded_grow
+
+                f_used = self._bins.shape[1]
+                self._sharded_grow = make_sharded_grow(
+                    self._mesh, self._grower_params
+                )
+                self._mono_arg = (
+                    self._monotone
+                    if self._monotone is not None
+                    else jnp.zeros((f_used,), jnp.int8)
+                )
+                self._inter_arg = (
+                    self._interaction_sets
+                    if self._interaction_sets is not None
+                    else jnp.ones((1, f_used), bool)
+                )
         return self
 
     def merge_from(self, other: "Booster") -> "Booster":
@@ -1218,8 +1380,8 @@ class Booster:
             kk = idx % k
             # replay onto the train score
             self._score = self._score.at[kk].add(
-                jnp.asarray(
-                    tree.predict(self._train_raw_for_replay()), dtype=jnp.float32
+                self._pad_delta(
+                    tree.predict(self._train_raw_for_replay()), self._pad_rows
                 )
             )
         self._iter += len(other.models_) // k

@@ -91,6 +91,17 @@ class ObjectiveFunction:
         self.label = jnp.asarray(self._label_np, dtype=jnp.float32)
         self.weight = None if weight is None else jnp.asarray(self._weight_np, dtype=jnp.float32)
 
+    def per_row_device_arrays(self):
+        """Per-row DEVICE arrays consumed by ``get_gradients``, as
+        (holder, attr_name, row_axis) triples.
+
+        The distributed Booster pads these with zero rows and re-places them
+        sharded over the data mesh; host-side statistics (``_label_np`` /
+        ``_weight_np``, class priors, percentiles) stay UNPADDED so
+        boost_from_score / renew_tree_output remain exact.  Padded rows carry
+        zero weight, which zeroes their gradients in every objective."""
+        return [(self, "label", 0), (self, "weight", 0)]
+
     # ------------------------------------------------------------- gradients
     def get_gradients(self, score: jnp.ndarray, rng: Optional[jax.Array] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """score: [num_class, N] raw scores -> (grad, hess) of the same shape."""
@@ -361,6 +372,9 @@ class RegressionMAPE(RegressionL1):
         self._label_weight = jnp.asarray(lw, dtype=jnp.float32)
         self.is_constant_hessian = True
 
+    def per_row_device_arrays(self):
+        return super().per_row_device_arrays() + [(self, "_label_weight", 0)]
+
     def get_gradients(self, score, rng=None):
         diff = score[0] - self.label
         grad = jnp.sign(diff) * self._label_weight
@@ -442,6 +456,12 @@ class BinaryLogloss(ObjectiveFunction):
         self._y = jnp.where(pos_dev, 1.0, -1.0)  # label in {-1, +1}
         self._lw = jnp.where(pos_dev, label_weights[1], label_weights[0])
 
+    def per_row_device_arrays(self):
+        return super().per_row_device_arrays() + [
+            (self, "_y", 0),
+            (self, "_lw", 0),
+        ]
+
     def get_gradients(self, score, rng=None):
         if not self.need_train:
             z = jnp.zeros_like(score)
@@ -503,6 +523,9 @@ class MulticlassSoftmax(ObjectiveFunction):
         label_int = jnp.asarray(li, dtype=jnp.int32)
         self._onehot = jax.nn.one_hot(label_int, self.num_class, dtype=jnp.float32).T  # [K, N]
 
+    def per_row_device_arrays(self):
+        return super().per_row_device_arrays() + [(self, "_onehot", 1)]
+
     def get_gradients(self, score, rng=None):
         p = jax.nn.softmax(score, axis=0)  # [K, N]
         grad = p - self._onehot
@@ -543,6 +566,12 @@ class MulticlassOVA(ObjectiveFunction):
         for k, b in enumerate(self._binary):
             b._is_pos = (lambda kk: (lambda y: y == kk))(k)
             b.init(label, weight)
+
+    def per_row_device_arrays(self):
+        out = super().per_row_device_arrays()
+        for b in self._binary:
+            out.extend(b.per_row_device_arrays())
+        return out
 
     def get_gradients(self, score, rng=None):
         gs, hs = [], []

@@ -25,6 +25,7 @@ results are identical regardless of tree_learner).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Callable, Optional, Tuple
 
@@ -36,6 +37,67 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.grower import GrowerParams, TreeArrays, grow_tree
 
 DATA_AXIS = "data"
+
+
+def choose_devices(min_devices: int = 2):
+    """Devices for distributed training: the default backend's devices, or —
+    when it has a single chip (e.g. tests on a 1-chip host with a virtual CPU
+    mesh) — the CPU backend's. Returns None when no multi-device backend
+    exists, signalling serial training (the reference likewise degrades
+    ``tree_learner=data`` to serial when num_machines==1, config.cpp)."""
+    devices = jax.devices()
+    if len(devices) >= min_devices:
+        return devices
+    try:
+        cpu = jax.devices("cpu")
+    except RuntimeError:
+        cpu = []
+    if len(cpu) >= min_devices:
+        return cpu
+    return None
+
+
+def pad_rows_np(arr: np.ndarray, pad: int, fill=0):
+    """Pad axis 0 of a host array with ``fill`` so rows divide the mesh."""
+    if pad == 0:
+        return arr
+    widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, widths, constant_values=fill)
+
+
+def make_sharded_grow(mesh: Mesh, params: GrowerParams, axis_name: str = DATA_AXIS):
+    """shard_map'd grow_tree over the mesh's data axis.
+
+    Every shard runs the identical leaf loop on its local rows; histograms and
+    root totals are psummed inside (ops/grower.py) so all shards compute the
+    IDENTICAL tree — the reference's histogram ReduceScatter + SplitInfo
+    Allreduce (src/treelearner/data_parallel_tree_learner.cpp:225-302) as XLA
+    collectives. Inputs: row-sharded (bins, grad, hess, mask), replicated
+    (num_bins, nan_bins, feature_mask, monotone, interaction_sets, rng).
+    Returns (TreeArrays replicated, leaf_id row-sharded)."""
+    p = dataclasses.replace(params, axis_name=axis_name)
+
+    def local(bins, grad, hess, mask, num_bins, nan_bins, feature_mask,
+              monotone, interaction_sets, rng):
+        return grow_tree(
+            bins, grad, hess, mask, num_bins, nan_bins, feature_mask, p,
+            monotone=monotone, interaction_sets=interaction_sets, rng=rng,
+        )
+
+    sh = P(axis_name)
+    sh2 = P(axis_name, None)
+    rep = P()
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(sh2, sh, sh, sh, rep, rep, rep, rep, rep, rep),
+        out_specs=(
+            jax.tree.map(lambda _: rep, TreeArrays(*([0] * len(TreeArrays._fields)))),
+            sh,
+        ),
+        check_vma=False,
+    )
+    return jax.jit(fn)
 
 
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = DATA_AXIS) -> Mesh:
@@ -50,6 +112,11 @@ def shard_rows(arr, mesh: Mesh, axis_name: str = DATA_AXIS):
     """Place a host array with rows sharded over the mesh axis."""
     spec = P(axis_name, *([None] * (np.ndim(arr) - 1)))
     return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
+
+
+def shard_cols(arr, mesh: Mesh, axis_name: str = DATA_AXIS):
+    """Place a host [K, N] array with COLUMNS (rows of the data) sharded."""
+    return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, P(None, axis_name)))
 
 
 def replicate(arr, mesh: Mesh):
@@ -87,14 +154,12 @@ def make_data_parallel_train_step(
     sharded = P(axis_name)
     sharded2 = P(axis_name, None)
     rep = P()
-    from jax.experimental.shard_map import shard_map
-
-    fn = shard_map(
+    fn = jax.shard_map(
         step,
         mesh=mesh,
         in_specs=(sharded2, sharded, sharded, rep, rep, rep),
         out_specs=(sharded, rep),
-        check_rep=False,
+        check_vma=False,
     )
     return jax.jit(fn)
 

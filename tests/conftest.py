@@ -15,6 +15,10 @@ import os
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Run the whole suite on the virtual CPU mesh: correctness tests don't need
+# the (remote-tunneled, slow-compile) TPU, and serial-vs-sharded comparisons
+# must run on ONE platform so reduction-order diffs don't flip tied splits.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import pytest  # noqa: E402
 
