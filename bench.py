@@ -60,6 +60,17 @@ def main() -> None:
 
     iters_per_sec = timed_iters / dt
     baseline = 3.8  # reference CPU iters/sec on Higgs (BASELINE.md)
+
+    # batch-inference throughput (fork's tree_avx512 target: 84k preds/s on
+    # 100 trees — BASELINE.md); same trained model, full matrix
+    pred_rows = min(n_rows, 500_000)
+    Xp = X[:pred_rows]
+    booster.predict(Xp)  # warmup/compile
+    t0 = time.perf_counter()
+    booster.predict(Xp)
+    pred_dt = time.perf_counter() - t0
+    preds_per_sec = pred_rows / pred_dt
+
     print(
         json.dumps(
             {
@@ -67,6 +78,8 @@ def main() -> None:
                 "value": round(iters_per_sec, 4),
                 "unit": "iters/sec",
                 "vs_baseline": round(iters_per_sec / baseline, 4),
+                "preds_per_sec": round(preds_per_sec),
+                "preds_vs_fork_84k": round(preds_per_sec / 84000.0, 2),
             }
         )
     )

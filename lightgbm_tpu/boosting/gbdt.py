@@ -192,6 +192,8 @@ class Booster:
                     "left_child": np.asarray(ta_host.left_child)[:nn],
                     "right_child": np.asarray(ta_host.right_child)[:nn],
                     "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
+                    "split_is_cat": np.asarray(ta_host.split_is_cat)[:nn],
+                    "cat_mask": np.asarray(ta_host.cat_mask)[:nn],
                 }
             else:
                 tree = Tree.constant_tree(0.0)
@@ -246,6 +248,8 @@ class Booster:
                             ta.left_child,
                             ta.right_child,
                             shrunk,
+                            ta.split_is_cat,
+                            ta.cat_mask,
                         )
                     )
                 ints_d, floats_d = pack_tree_arrays(ta)
@@ -375,29 +379,25 @@ class Booster:
         if len(nan_bins) == 0:
             nan_bins = np.array([-1], dtype=np.int32)  # pairs with the dummy column
         self._nan_bins = jnp.asarray(nan_bins)
+        isc = np.array(
+            [train_set.bin_mappers[j].is_categorical for j in train_set.used_features],
+            dtype=bool,
+        )
+        if len(isc) == 0:
+            isc = np.array([False])
+        self._has_cat = bool(isc.any())
+        self._is_cat = jnp.asarray(isc) if self._has_cat else None
         self._max_bin_padded = _ceil_pow2(int(nb.max()) if len(nb) else 2)
         self._setup_constraints()
         self._grower_params = self._make_grower_params()
         f_used = self._bins.shape[1]
         if self._mesh is not None:
-            from ..parallel import make_sharded_grow, shard_rows
+            from ..parallel import shard_rows
 
             base = np.ones(n_dev, np.float32)
             base[n:] = 0.0
             self._ones_mask = shard_rows(base, self._mesh)
-            self._sharded_grow = make_sharded_grow(self._mesh, self._grower_params)
-            # shard_map needs concrete arrays for every operand: dummies for
-            # the optional ones (statically gated off inside grow_tree)
-            self._mono_arg = (
-                self._monotone
-                if self._monotone is not None
-                else jnp.zeros((f_used,), jnp.int8)
-            )
-            self._inter_arg = (
-                self._interaction_sets
-                if self._interaction_sets is not None
-                else jnp.ones((1, f_used), bool)
-            )
+            self._setup_sharded_grower()
         else:
             self._ones_mask = jnp.ones((n,), jnp.float32)
         self._full_feature_mask = jnp.ones((f_used,), bool)
@@ -456,6 +456,30 @@ class Booster:
                         mat[si, orig_to_used[j]] = True
             self._interaction_sets = jnp.asarray(mat)
 
+    def _setup_sharded_grower(self) -> None:
+        """(Re)build the shard_map'd grower for the current GrowerParams.
+        shard_map needs concrete arrays for every operand: dummies stand in
+        for the optional ones (statically gated off inside grow_tree)."""
+        from ..parallel import make_sharded_grow
+
+        f_used = self._bins.shape[1]
+        self._sharded_grow = make_sharded_grow(self._mesh, self._grower_params)
+        self._mono_arg = (
+            self._monotone
+            if self._monotone is not None
+            else jnp.zeros((f_used,), jnp.int8)
+        )
+        self._inter_arg = (
+            self._interaction_sets
+            if self._interaction_sets is not None
+            else jnp.ones((1, f_used), bool)
+        )
+        self._iscat_arg = (
+            self._is_cat
+            if self._is_cat is not None
+            else jnp.zeros((f_used,), bool)
+        )
+
     def _grow_one(self, grad_k, hess_k, mask, feature_mask, rng):
         """Grow one tree: serial grow_tree or the mesh-sharded shard_map path
         (reference: SerialTreeLearner vs DataParallelTreeLearner dispatch,
@@ -472,6 +496,7 @@ class Booster:
                 self._mono_arg,
                 self._inter_arg,
                 rng if rng is not None else jax.random.PRNGKey(0),
+                self._iscat_arg,
             )
         return grow_tree(
             self._bins,
@@ -485,9 +510,12 @@ class Booster:
             monotone=self._monotone,
             interaction_sets=self._interaction_sets,
             rng=rng,
+            is_cat=self._is_cat,
         )
 
     def _make_grower_params(self) -> GrowerParams:
+        from ..ops.split import CatParams
+
         cfg = self.config
         return GrowerParams(
             num_leaves=cfg.num_leaves,
@@ -503,6 +531,16 @@ class Booster:
             use_monotone=self._monotone is not None,
             use_interaction=self._interaction_sets is not None,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
+            use_cat=self._has_cat,
+            cat_params=CatParams(
+                max_cat_to_onehot=cfg.max_cat_to_onehot,
+                max_cat_threshold=cfg.max_cat_threshold,
+                cat_l2=cfg.cat_l2,
+                cat_smooth=cfg.cat_smooth,
+                min_data_per_group=cfg.min_data_per_group,
+            )
+            if self._has_cat
+            else None,
         )
 
     def _fit_linear_leaves(
@@ -638,6 +676,7 @@ class Booster:
                     jnp.asarray(rec["left_child"]),
                     jnp.asarray(rec["right_child"]),
                     jnp.asarray(np.asarray(self.models_[idx].leaf_value, dtype=np.float32)),
+                    *self._rec_cat_args(rec),
                 )
             )
         self._valid.append(entry)
@@ -646,6 +685,17 @@ class Booster:
     def _next_rng(self) -> jax.Array:
         self._rng, sub = jax.random.split(self._rng)
         return sub
+
+    @staticmethod
+    def _rec_cat_args(rec):
+        """(split_is_cat, cat_mask) device args for a bin record; records
+        from older model loads may lack them (numeric-only trees)."""
+        sic = rec.get("split_is_cat")
+        cm = rec.get("cat_mask")
+        nn = len(rec["split_feature"])
+        if sic is None or cm is None or np.size(cm) == 0:
+            return jnp.zeros((nn,), bool), jnp.zeros((nn, 1), bool)
+        return jnp.asarray(sic), jnp.asarray(cm)
 
     @staticmethod
     def _pad_delta(delta, pad: int) -> jnp.ndarray:
@@ -826,6 +876,8 @@ class Booster:
                                 ta.left_child,
                                 ta.right_child,
                                 shrunk,
+                                ta.split_is_cat,
+                                ta.cat_mask,
                             )
                         )
                 if abs(init_scores[kk]) > _EPS:
@@ -838,6 +890,8 @@ class Booster:
                     "left_child": np.asarray(ta_host.left_child)[:nn],
                     "right_child": np.asarray(ta_host.right_child)[:nn],
                     "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
+                    "split_is_cat": np.asarray(ta_host.split_is_cat)[:nn],
+                    "cat_mask": np.asarray(ta_host.cat_mask)[:nn],
                 }
                 if is_linear:
                     rec["no_bin_form"] = True  # device walker can't see coeffs
@@ -933,6 +987,7 @@ class Booster:
                         jnp.asarray(rec["left_child"]),
                         jnp.asarray(rec["right_child"]),
                         neg,
+                        *self._rec_cat_args(rec),
                     )
                 )
                 for entry in self._valid:
@@ -947,6 +1002,7 @@ class Booster:
                             jnp.asarray(rec["left_child"]),
                             jnp.asarray(rec["right_child"]),
                             neg,
+                            *self._rec_cat_args(rec),
                         )
                     )
             else:
@@ -1085,21 +1141,13 @@ class Booster:
                 return np.asarray(leaves, dtype=np.int32)
             per_tree = np.asarray(predict_bins_raw(batch, bins, self._nan_bins), dtype=np.float64)
         else:
-            has_cat = any(t.num_cat > 0 for t in self.models_[t0:t1])
-            if has_cat:
+            # linear trees carry per-leaf coefficients the device walker
+            # doesn't model — host walk (Tree.predict applies them)
+            has_linear = any(t.is_linear for t in self.models_[t0:t1])
+            if has_linear and not pred_leaf:
                 per_tree = np.stack(
                     [t.predict(X) for t in self.models_[t0:t1]], axis=1
                 )
-                if pred_leaf:
-                    return np.stack(
-                        [
-                            np.fromiter(
-                                (t.predict_leaf(row) for row in X), dtype=np.int32
-                            )
-                            for t in self.models_[t0:t1]
-                        ],
-                        axis=1,
-                    )
             else:
                 batch = stack_real_trees(self.models_[t0:t1])
                 Xd = jnp.asarray(X, dtype=jnp.float32)
@@ -1108,7 +1156,11 @@ class Booster:
                 per_tree = np.asarray(predict_real_raw(batch, Xd), dtype=np.float64)
 
         n = X.shape[0]
-        raw = per_tree.reshape(n, -1, k).sum(axis=1)  # [N, K]
+        es_on = bool(kwargs.get("pred_early_stop", self.config.pred_early_stop))
+        if es_on and self._early_stop_type(k) != "none":
+            raw = self._apply_pred_early_stop(per_tree, k, kwargs)
+        else:
+            raw = per_tree.reshape(n, -1, k).sum(axis=1)  # [N, K]
         if self.average_output:
             raw /= (t1 - t0) // k
         if k == 1:
@@ -1116,6 +1168,43 @@ class Booster:
         if raw_score or self.objective is None:
             return raw
         return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+
+    def _early_stop_type(self, k: int) -> str:
+        """Reference c_api chooses the margin rule from the objective
+        (src/c_api.cpp: binary/multiclassova objectives -> 'binary'/'multiclass')."""
+        if k > 1:
+            return "multiclass"
+        name = self.objective.name if self.objective is not None else ""
+        if name in ("binary", "cross_entropy", "cross_entropy_lambda"):
+            return "binary"
+        return "none"
+
+    def _apply_pred_early_stop(
+        self, per_tree: np.ndarray, k: int, kwargs: Dict[str, Any]
+    ) -> np.ndarray:
+        """Margin-based prediction early stopping, vectorized over rows
+        (reference: prediction_early_stop.cpp:26-75 + the per-iteration
+        counter loop in gbdt_prediction.cpp:18-36).  Each row's accumulation
+        freezes at the FIRST checkpoint (every pred_early_stop_freq
+        iterations) whose margin exceeds pred_early_stop_margin — identical
+        outputs to the reference's sequential loop, computed as one cumsum."""
+        freq = max(1, int(kwargs.get("pred_early_stop_freq",
+                                     self.config.pred_early_stop_freq)))
+        margin_thr = float(kwargs.get("pred_early_stop_margin",
+                                      self.config.pred_early_stop_margin))
+        n, total = per_tree.shape
+        iters = total // k
+        cum = np.cumsum(per_tree.reshape(n, iters, k), axis=1)  # [N, I, K]
+        if k == 1:
+            margin = 2.0 * np.abs(cum[:, :, 0])
+        else:
+            s = np.sort(cum, axis=2)
+            margin = s[:, :, -1] - s[:, :, -2]
+        checkpoint = (np.arange(1, iters + 1) % freq) == 0
+        stop = (margin > margin_thr) & checkpoint[None, :]
+        any_stop = stop.any(axis=1)
+        first = np.where(any_stop, stop.argmax(axis=1), iters - 1)
+        return cum[np.arange(n), first]
 
     def _coerce_predict_input(self, data) -> np.ndarray:
         try:
@@ -1348,23 +1437,98 @@ class Booster:
             self._grower_params = self._make_grower_params()
             if self._mesh is not None:
                 # the shard_map'd grower closed over the OLD params
-                from ..parallel import make_sharded_grow
-
-                f_used = self._bins.shape[1]
-                self._sharded_grow = make_sharded_grow(
-                    self._mesh, self._grower_params
-                )
-                self._mono_arg = (
-                    self._monotone
-                    if self._monotone is not None
-                    else jnp.zeros((f_used,), jnp.int8)
-                )
-                self._inter_arg = (
-                    self._interaction_sets
-                    if self._interaction_sets is not None
-                    else jnp.ones((1, f_used), bool)
-                )
+                self._setup_sharded_grower()
         return self
+
+    def refit(
+        self,
+        data,
+        label,
+        decay_rate: float = 0.9,
+        reference: Optional[Dataset] = None,
+        weight=None,
+        group=None,
+        init_score=None,
+        feature_name="auto",
+        categorical_feature="auto",
+        dataset_params: Optional[Dict[str, Any]] = None,
+        free_raw_data: bool = True,
+        validate_features: bool = False,
+        **kwargs,
+    ) -> "Booster":
+        """Refit leaf values on new data, keeping every tree's structure
+        (reference: GBDT::RefitTree src/boosting/gbdt.cpp:266 +
+        SerialTreeLearner::FitByExistingTree serial_tree_learner.cpp:250 +
+        python Booster.refit basic.py:4746).
+
+        leaf_output = decay_rate * old + (1 - decay_rate) * new, where new is
+        the regularized optimal output of the leaf's gradient/hessian sums on
+        the new data, times shrinkage."""
+        if self.objective is None:
+            raise ValueError("Cannot refit: no objective (custom-objective model)")
+        from ..ops.split import leaf_output as _leaf_out
+
+        leaf_preds = np.asarray(
+            self.predict(data, pred_leaf=True, **kwargs), dtype=np.int64
+        )  # [N, T]
+        new_params = dict(self.params)
+        new_params.update(dataset_params or {})
+        new_params["refit_decay_rate"] = decay_rate
+        train_set = Dataset(
+            data,
+            label,
+            reference=reference,
+            weight=weight,
+            group=group,
+            init_score=init_score,
+            feature_name=feature_name,
+            categorical_feature=categorical_feature,
+            params=new_params,
+            free_raw_data=free_raw_data,
+        )
+        nb = Booster(new_params, train_set)
+        import copy as _copy
+
+        nb.models_ = [_copy.deepcopy(t) for t in self.models_]
+        k = nb.num_tree_per_iteration
+        n = train_set.num_data
+        cfg = nb.config
+        n_iters = len(nb.models_) // k
+        for it in range(n_iters):
+            grad, hess = nb.objective.get_gradients(nb._score, nb._next_rng())
+            g = np.asarray(grad, dtype=np.float64)[:, :n]
+            h = np.asarray(hess, dtype=np.float64)[:, :n]
+            for kk in range(k):
+                mi = it * k + kk
+                tree = nb.models_[mi]
+                lp = leaf_preds[:, mi]
+                nl = tree.num_leaves
+                sum_g = np.bincount(lp, weights=g[kk], minlength=nl)[:nl]
+                sum_h = np.bincount(lp, weights=h[kk], minlength=nl)[:nl] + 1e-15
+                out = np.asarray(
+                    _leaf_out(
+                        jnp.asarray(sum_g),
+                        jnp.asarray(sum_h),
+                        cfg.lambda_l1,
+                        cfg.lambda_l2,
+                        cfg.max_delta_step,
+                    )
+                )
+                new_out = out * (tree.shrinkage if tree.shrinkage else 1.0)
+                tree.leaf_value = (
+                    decay_rate * np.asarray(tree.leaf_value, dtype=np.float64)
+                    + (1.0 - decay_rate) * new_out
+                )
+                # advance the new-data score with the refitted outputs
+                delta = tree.leaf_value[np.minimum(lp, nl - 1)]
+                nb._score = nb._score.at[kk].add(
+                    self._pad_delta(delta, nb._pad_rows)
+                )
+        # bin-space mirrors against the NEW dataset's binning
+        nb._bin_records = [nb._bin_record_from_tree(t) for t in nb.models_]
+        nb._bump_model_version()
+        nb._iter = n_iters
+        return nb
 
     def merge_from(self, other: "Booster") -> "Booster":
         """Continued training from an init model (reference: GBDT
@@ -1415,8 +1579,11 @@ class Booster:
         nn = tree.num_leaves - 1
         sf_used = np.zeros(nn, dtype=np.int32)
         sbin = np.zeros(nn, dtype=np.int32)
+        sic = np.zeros(nn, dtype=bool)
+        cmask = np.zeros((nn, self._max_bin_padded), dtype=bool)
         orig_to_used = {j: ci for ci, j in enumerate(ds.used_features)}
         ok = True
+        has_cat = False
         for t in range(nn):
             orig = int(tree.split_feature[t])
             if orig not in orig_to_used:
@@ -1424,11 +1591,45 @@ class Booster:
                 break
             mapper = ds.bin_mappers[orig]
             sf_used[t] = orig_to_used[orig]
-            if tree.decision_type[t] & 1:  # categorical: bins are freq-ordered
-                ok = False
-                break
-            ub = np.asarray(mapper.bin_upper_bound)
-            sbin[t] = int(np.searchsorted(ub, tree.threshold[t], side="left"))
+            if tree.decision_type[t] & 1:
+                # categorical: map the cat_threshold value-bitset back onto
+                # this dataset's bins (cat value -> bin via cat_to_bin)
+                if tree.cat_boundaries is None or mapper.cat_to_bin is None:
+                    ok = False
+                    break
+                has_cat = True
+                sic[t] = True
+                ci = int(tree.threshold[t])
+                b0, b1 = int(tree.cat_boundaries[ci]), int(tree.cat_boundaries[ci + 1])
+                for w in range(b0, b1):
+                    word = int(tree.cat_threshold[w])
+                    base = (w - b0) * 32
+                    for bit in range(32):
+                        if word >> bit & 1:
+                            bn = mapper.cat_to_bin.get(base + bit)
+                            if bn is None or bn >= cmask.shape[1]:
+                                # category in the bitset but absent from this
+                                # dataset's bins: bin space would send it
+                                # right while real space sends it left
+                                ok = False
+                                break
+                            cmask[t, bn] = True
+                    if not ok:
+                        break
+                if not ok:
+                    break
+            else:
+                ub = np.asarray(mapper.bin_upper_bound)
+                thr = float(tree.threshold[t])
+                sbin[t] = int(np.searchsorted(ub, thr, side="left"))
+                # bin space is exact only when the threshold coincides with a
+                # bin boundary of THIS dataset's mapper — foreign thresholds
+                # (refit / continued training on re-binned data) would be
+                # silently requantized otherwise
+                bval = ub[sbin[t]] if sbin[t] < len(ub) else np.inf
+                if not (bval == thr or abs(bval - thr) <= 1e-10 * max(1.0, abs(thr))):
+                    ok = False
+                    break
         if not ok:
             return {
                 "split_feature": np.zeros(0, np.int32),
@@ -1446,6 +1647,8 @@ class Booster:
             "left_child": np.asarray(tree.left_child),
             "right_child": np.asarray(tree.right_child),
             "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
+            "split_is_cat": sic,
+            "cat_mask": cmask if has_cat else np.zeros((nn, 1), bool),
         }
 
     def __copy__(self):

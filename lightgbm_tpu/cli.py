@@ -100,6 +100,30 @@ def run_predict(params: Dict[str, Any], cfg: Config) -> None:
     print(f"Finished prediction; results written to {out}")
 
 
+def run_refit(params: Dict[str, Any], cfg: Config) -> None:
+    """task=refit: re-fit leaf values of input_model on new data
+    (reference: application.cpp task=refit -> GBDT::RefitTree)."""
+    model_path = params.get("input_model")
+    if not model_path:
+        raise SystemExit("task=refit requires input_model=<model file>")
+    if not cfg.data:
+        raise SystemExit("task=refit requires data=<training file>")
+    booster = Booster(model_file=model_path)
+    booster.params.update(params)
+    loaded = _load_text_file(cfg.data, cfg)
+    new_booster = booster.refit(
+        loaded["data"],
+        loaded["label"],
+        decay_rate=cfg.refit_decay_rate,
+        weight=loaded.get("weight"),
+        group=loaded.get("group"),
+        init_score=loaded.get("init_score"),
+    )
+    out = params.get("output_model", "LightGBM_model.txt")
+    new_booster.save_model(out)
+    print(f"Finished refit; model written to {out}")
+
+
 def run_convert_model(params: Dict[str, Any], cfg: Config) -> None:
     model_path = params.get("input_model")
     if not model_path:
@@ -128,7 +152,7 @@ def main(argv=None) -> None:
     elif task == "convert_model":
         run_convert_model(params, cfg)
     elif task == "refit":
-        raise SystemExit("task=refit: use Booster.refit via the python API")
+        run_refit(params, cfg)
     else:
         raise SystemExit(f"unknown task: {task!r}")
 

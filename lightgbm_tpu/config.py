@@ -327,6 +327,9 @@ class Config:
     feature_contri: List[float] = dataclasses.field(default_factory=list)
     forcedsplits_filename: str = ""
     refit_decay_rate: float = 0.9
+    # IO (reference config.h:611/:623)
+    output_model: str = "LightGBM_model.txt"
+    snapshot_freq: int = -1
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
     cegb_penalty_feature_lazy: List[float] = dataclasses.field(default_factory=list)

@@ -115,6 +115,7 @@ class Dataset:
         categorical_feature: Union[str, Sequence] = "auto",
         params: Optional[Dict[str, Any]] = None,
         free_raw_data: bool = True,
+        position: Optional[np.ndarray] = None,
     ) -> None:
         self.params: Dict[str, Any] = dict(params or {})
         self.config = Config.from_params(self.params)
@@ -123,6 +124,7 @@ class Dataset:
         self._weight = weight
         self._group = group
         self._init_score = init_score
+        self._position = position
         self._feature_name = feature_name
         self._categorical_feature = categorical_feature
         self.reference = reference
@@ -243,6 +245,15 @@ class Dataset:
         self.metadata = Metadata(label=label, weight=weight, init_score=init_score)
         if self._group is not None:
             self.metadata.set_query(np.asarray(self._group))
+        if self._position is not None:
+            # per-row result position for unbiased lambdarank
+            # (reference Metadata::SetPosition, src/io/metadata.cpp:360)
+            pos = np.asarray(self._position)
+            if len(pos) != len(label):
+                raise ValueError(
+                    f"position length {len(pos)} != num_data {len(label)}"
+                )
+            self.metadata.position = pos
 
         self._constructed = True
         if self.free_raw_data and not self.config.linear_tree:
@@ -322,6 +333,19 @@ class Dataset:
                 self.metadata.set_query(np.asarray(group))
         else:
             self._group = group
+        return self
+
+    def set_position(self, position: Optional[np.ndarray]) -> "Dataset":
+        if position is not None and self._constructed:
+            position = np.asarray(position)
+            if len(position) != self.num_data:
+                raise ValueError(
+                    f"position length {len(position)} != num_data {self.num_data}"
+                )
+        if self._constructed:
+            self.metadata.position = position
+        else:
+            self._position = position
         return self
 
     def set_init_score(self, init_score: Optional[np.ndarray]) -> "Dataset":

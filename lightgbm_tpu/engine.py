@@ -94,6 +94,13 @@ def train(
                 )
             is_finished = booster.update(fobj=fobj)
 
+            # periodic model snapshot (reference GBDT::Train gbdt.cpp:258)
+            sf = booster.config.snapshot_freq
+            if sf > 0 and (it + 1) % sf == 0:
+                booster.save_model(
+                    f"{booster.config.output_model}.snapshot_iter_{it + 1}"
+                )
+
             evaluation_result_list = []
             if (it + 1) % max(1, booster.config.metric_freq) == 0 or it + 1 == end_iteration:
                 if is_valid_contain_train:

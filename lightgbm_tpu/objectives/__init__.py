@@ -755,6 +755,26 @@ class LambdarankNDCG(RankingObjective):
         super().init(label, weight, query_boundaries)
         if self._label_np.max() >= len(self.label_gain):
             raise ValueError("label exceeds label_gain size")
+        # position debias (reference: positions_/pos_biases_,
+        # rank_objective.hpp:44-56; Newton update :302-341): scores are
+        # adjusted by a learned per-position bias before the lambda
+        # computation, and the biases update each iteration from the
+        # accumulated lambdas/hessians per position.
+        self._pos_inv = None
+        if position is not None:
+            pos = np.asarray(position)
+            uniq, inv = np.unique(pos, return_inverse=True)
+            self.position_ids = uniq
+            self.num_position_ids = len(uniq)
+            self._pos_inv = jnp.asarray(inv.astype(np.int32))
+            self._pos_counts = jnp.asarray(
+                np.bincount(inv, minlength=len(uniq)).astype(np.float32)
+            )
+            self.pos_biases = jnp.zeros((len(uniq),), jnp.float32)
+            self._pos_reg = float(
+                self.config.lambdarank_position_bias_regularization
+            )
+            self._pos_lr = float(self.config.learning_rate)
         # per-query inverse max DCG at truncation level (host, setup-time)
         inv = np.zeros(self.num_queries)
         disc = 1.0 / np.log2(np.arange(2, self.q_pad + 2))
@@ -766,6 +786,18 @@ class LambdarankNDCG(RankingObjective):
         self._inv_max_dcg = jnp.asarray(inv, dtype=jnp.float32)
         self._gain_table = jnp.asarray(self.label_gain, dtype=jnp.float32)
         self._discount = jnp.asarray(disc, dtype=jnp.float32)
+
+    def _update_position_bias(self, grad_row, hess_row) -> None:
+        """Newton-Raphson step on the per-position bias factors
+        (UpdatePositionBiasFactors, rank_objective.hpp:302)."""
+        p = self.num_position_ids
+        fd = -jax.ops.segment_sum(grad_row, self._pos_inv, num_segments=p)
+        sd = -jax.ops.segment_sum(hess_row, self._pos_inv, num_segments=p)
+        fd = fd - self.pos_biases * self._pos_reg * self._pos_counts
+        sd = sd - self._pos_reg * self._pos_counts
+        self.pos_biases = self.pos_biases + self._pos_lr * fd / (
+            jnp.abs(sd) + 0.001
+        )
 
     def _one_query(self, s, lab, valid, inv_max_dcg):
         """Lambdas/hessians for one padded query. s/lab/valid: [Q]."""
@@ -822,6 +854,10 @@ class LambdarankNDCG(RankingObjective):
         return lam_sorted[inv_order], hess_sorted[inv_order]
 
     def get_gradients(self, score, rng=None):
+        if self._pos_inv is not None:
+            # bias-adjusted scores feed the lambda computation
+            # (rank_objective.hpp:68-73)
+            score = (score[0] + self.pos_biases[self._pos_inv])[None]
         qs = self._gather_scores(score)  # [num_q, Q]
         qq = self.q_pad
         # chunk queries so the [chunk, Q, Q] intermediate stays ~16M elements
@@ -851,6 +887,8 @@ class LambdarankNDCG(RankingObjective):
         if self.weight is not None:
             grad = grad * self.weight
             hess = hess * self.weight
+        if self._pos_inv is not None:
+            self._update_position_bias(grad, hess)
         return grad[None], hess[None]
 
     def to_string(self):

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .histogram import leaf_histogram
-from .split import SplitCandidate, best_split, leaf_output
+from .split import CatParams, SplitCandidate, best_split, leaf_output
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,6 +51,10 @@ class GrowerParams:
     max_delta_step: float = 0.0
     hist_method: str = "auto"
     axis_name: Optional[str] = None
+    # categorical split search (sorted-subset scan, feature_histogram.cpp:147);
+    # False keeps every cat-related array at width 1 (static no-op)
+    use_cat: bool = False
+    cat_params: Optional[CatParams] = None
     # "ordered": maintain a leaf-contiguous row permutation (the reference's
     # DataPartition index array, data_partition.hpp) so every per-split op —
     # partition, gather, histogram — costs O(parent segment), never O(N);
@@ -120,6 +124,8 @@ class TreeArrays(NamedTuple):
     leaf_count: jnp.ndarray  # [L] f32
     leaf_depth: jnp.ndarray  # [L] int32
     num_leaves: jnp.ndarray  # scalar int32
+    split_is_cat: jnp.ndarray  # [L-1] bool
+    cat_mask: jnp.ndarray  # [L-1, Bm] bool — bin goes left (Bm=1 if no cat)
 
 
 class _State(NamedTuple):
@@ -142,6 +148,8 @@ class _State(NamedTuple):
     split_bin: jnp.ndarray
     split_gain: jnp.ndarray
     default_left: jnp.ndarray
+    split_is_cat: jnp.ndarray  # [L-1]
+    node_cat_mask: jnp.ndarray  # [L-1, Bm]
     left_child: jnp.ndarray
     right_child: jnp.ndarray
     internal_value: jnp.ndarray
@@ -153,7 +161,7 @@ class _State(NamedTuple):
 
 def _candidate_for_leaf(
     hist, g, h, c, num_bins, nan_bins, feature_mask, p: GrowerParams,
-    monotone=None, lb=None, ub=None, parent_output=0.0,
+    monotone=None, lb=None, ub=None, parent_output=0.0, is_cat=None,
 ):
     return best_split(
         hist,
@@ -174,6 +182,8 @@ def _candidate_for_leaf(
         leaf_lb=lb,
         leaf_ub=ub,
         parent_output=parent_output,
+        is_cat=is_cat if p.use_cat else None,
+        cat_params=p.cat_params,
     )
 
 
@@ -184,7 +194,8 @@ def _set_cand(cand: SplitCandidate, idx, new: SplitCandidate, gain_override=None
         for arr, val in zip(
             cand,
             (gain, new.feature, new.bin, new.default_left, new.left_g, new.left_h,
-             new.left_cnt, new.right_g, new.right_h, new.right_cnt),
+             new.left_cnt, new.right_g, new.right_h, new.right_cnt,
+             new.is_cat, new.cat_mask),
         )
     ])
 
@@ -203,6 +214,8 @@ def pack_tree_arrays(ta: "TreeArrays"):
             ta.default_left.astype(jnp.int32),
             ta.leaf_depth,
             ta.num_leaves[None],
+            ta.split_is_cat.astype(jnp.int32),
+            ta.cat_mask.astype(jnp.int32).reshape(-1),
         ]
     )
     floats = jnp.concatenate(
@@ -226,6 +239,11 @@ def unpack_tree_arrays(ints, floats, nn: int, L: int) -> "TreeArrays":
     default_left = ints[off : off + nn].astype(bool)
     leaf_depth = ints[off + nn : off + nn + L]
     num_leaves = ints[off + nn + L]
+    off = off + nn + L + 1
+    split_is_cat = ints[off : off + nn].astype(bool)
+    off += nn
+    bm = max(1, (len(ints) - off) // max(nn, 1))
+    cat_mask = ints[off : off + nn * bm].astype(bool).reshape(nn, bm)
     fo = [floats[i * nn : (i + 1) * nn] for i in range(4)]
     off = 4 * nn
     fl = [floats[off + i * L : off + (i + 1) * L] for i in range(3)]
@@ -244,6 +262,8 @@ def unpack_tree_arrays(ints, floats, nn: int, L: int) -> "TreeArrays":
         leaf_count=fl[2],
         leaf_depth=leaf_depth,
         num_leaves=num_leaves,
+        split_is_cat=split_is_cat,
+        cat_mask=cat_mask,
     )
 
 
@@ -270,6 +290,7 @@ def grow_tree(
     monotone: Optional[jnp.ndarray] = None,  # [F] int8 (use_monotone)
     interaction_sets: Optional[jnp.ndarray] = None,  # [S, F] bool
     rng: Optional[jax.Array] = None,  # for feature_fraction_bynode
+    is_cat: Optional[jnp.ndarray] = None,  # [F] bool (use_cat)
 ):
     """Grow one tree. Returns (TreeArrays, leaf_id[N])."""
     p = params
@@ -277,6 +298,9 @@ def grow_tree(
     L, B = p.num_leaves, p.max_bin
     use_mono = p.use_monotone and monotone is not None
     mono_arr = monotone if use_mono else None
+    use_cat = p.use_cat and is_cat is not None
+    Bm = B if use_cat else 1  # cat-mask width (1 = static no-op)
+    is_cat_arr = is_cat if use_cat else None
 
     def node_feature_mask(node_seed, used_row):
         """Per-node usable features: feature_fraction_bynode sampling
@@ -343,13 +367,16 @@ def grow_tree(
 
         def _make_part_branch(P: int):
             def branch(op):
-                order, begin_l, cnt_l, feat, tbin, dl = op
+                order, begin_l, cnt_l, feat, tbin, dl, cis, cmask = op
                 idx = lax.dynamic_slice(order, (begin_l,), (P,))
                 valid = jnp.arange(P, dtype=jnp.int32) < cnt_l
                 featrow = lax.dynamic_slice_in_dim(bins_t_pad, feat, 1, axis=0)[0]
                 colv = featrow[idx]
                 nb = nan_bins[feat]
-                gl = ((colv <= tbin) | (dl & (nb >= 0) & (colv == nb))) & valid
+                gl = (colv <= tbin) | (dl & (nb >= 0) & (colv == nb))
+                if use_cat:
+                    gl = jnp.where(cis, cmask[jnp.minimum(colv, Bm - 1)], gl)
+                gl = gl & valid
                 gr = valid & ~gl
                 nleft = jnp.sum(gl).astype(jnp.int32)
                 # stable partition: left rows -> [0, nleft), right rows ->
@@ -405,6 +432,7 @@ def grow_tree(
         lb=neg_inf_s if use_mono else None,
         ub=pos_inf_s if use_mono else None,
         parent_output=leaf_output(totals[0], totals[1], p.lambda_l1, p.lambda_l2, p.max_delta_step),
+        is_cat=is_cat_arr,
     )
 
     neg_inf = jnp.full((L,), -jnp.inf, dtype=jnp.float32)
@@ -419,6 +447,8 @@ def grow_tree(
         right_g=jnp.zeros((L,), jnp.float32),
         right_h=jnp.zeros((L,), jnp.float32),
         right_cnt=jnp.zeros((L,), jnp.float32),
+        is_cat=jnp.zeros((L,), bool),
+        cat_mask=jnp.zeros((L, Bm), bool),
     )
     cand = _set_cand(cand, 0, cand0)
 
@@ -458,6 +488,8 @@ def grow_tree(
         split_bin=jnp.zeros((L - 1,), jnp.int32),
         split_gain=jnp.zeros((L - 1,), jnp.float32),
         default_left=jnp.zeros((L - 1,), bool),
+        split_is_cat=jnp.zeros((L - 1,), bool),
+        node_cat_mask=jnp.zeros((L - 1, Bm), bool),
         # unused nodes point at leaf 0 (~0 = -1) so walking a trivial tree
         # (no splits recorded) terminates instead of spinning on node 0
         left_child=jnp.full((L - 1,), -1, jnp.int32),
@@ -482,6 +514,8 @@ def grow_tree(
             feat = st.cand.feature[l]
             tbin = st.cand.bin[l]
             dl = st.cand.default_left[l]
+            cis = st.cand.is_cat[l]
+            cmask = st.cand.cat_mask[l]
 
             # ---- partition rows of leaf l (reference DataPartition::Split)
             if use_ordered:
@@ -497,7 +531,7 @@ def grow_tree(
                 order, nleft = lax.switch(
                     pbucket,
                     part_branches,
-                    (st.order, begin_l, cnt_l, feat, tbin, dl),
+                    (st.order, begin_l, cnt_l, feat, tbin, dl, cis, cmask),
                 )
                 nright = cnt_l - nleft
                 leaf_id = st.leaf_id
@@ -506,6 +540,10 @@ def grow_tree(
                 col = lax.dynamic_slice_in_dim(bins_t_cols, feat, 1, axis=0)[0]
                 nb = nan_bins[feat]
                 go_left = (col <= tbin) | (dl & (nb >= 0) & (col == nb))
+                if use_cat:
+                    go_left = jnp.where(
+                        cis, cmask[jnp.minimum(col, Bm - 1)], go_left
+                    )
                 in_leaf = st.leaf_id == l
                 leaf_id = jnp.where(in_leaf & ~go_left, nl, st.leaf_id)
 
@@ -523,6 +561,8 @@ def grow_tree(
             split_bin = st.split_bin.at[t].set(tbin)
             split_gain = st.split_gain.at[t].set(st.cand.gain[l] + p.min_gain_to_split)
             default_left = st.default_left.at[t].set(dl)
+            split_is_cat = st.split_is_cat.at[t].set(cis)
+            node_cat_mask = st.node_cat_mask.at[t].set(cmask)
             internal_value = st.internal_value.at[t].set(
                 leaf_output(pg, ph, p.lambda_l1, p.lambda_l2, p.max_delta_step)
             )
@@ -658,6 +698,7 @@ def grow_tree(
                 lb=lb_l if use_mono else None,
                 ub=ub_l if use_mono else None,
                 parent_output=leaf_output(lg, lh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
+                is_cat=is_cat_arr,
             )
             cand_r = _candidate_for_leaf(
                 right_hist, rg, rh, rc, num_bins, nan_bins,
@@ -666,6 +707,7 @@ def grow_tree(
                 lb=lb_r if use_mono else None,
                 ub=ub_r if use_mono else None,
                 parent_output=leaf_output(rg, rh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
+                is_cat=is_cat_arr,
             )
             depth_ok = (p.max_depth <= 0) | (d_new < p.max_depth)
             cand = _set_cand(
@@ -701,6 +743,8 @@ def grow_tree(
                 split_bin=split_bin,
                 split_gain=split_gain,
                 default_left=default_left,
+                split_is_cat=split_is_cat,
+                node_cat_mask=node_cat_mask,
                 left_child=left_child,
                 right_child=right_child,
                 internal_value=internal_value,
@@ -750,6 +794,8 @@ def grow_tree(
         leaf_count=state.leaf_cnt,
         leaf_depth=state.leaf_depth,
         num_leaves=state.num_leaves,
+        split_is_cat=state.split_is_cat,
+        cat_mask=state.node_cat_mask,
     )
 
     if use_ordered:

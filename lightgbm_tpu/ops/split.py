@@ -40,7 +40,14 @@ def leaf_output(g, h, l1: float, l2: float, max_delta_step: float = 0.0):
 
 
 class SplitCandidate(NamedTuple):
-    """Best split for one leaf (reference: SplitInfo, split_info.hpp:22)."""
+    """Best split for one leaf (reference: SplitInfo, split_info.hpp:22).
+
+    For categorical splits ``is_cat`` is True and ``cat_mask`` is a bin-space
+    bitmask ([B] bool, True = bin goes LEFT) — the TPU formulation of the
+    reference's ``cat_threshold`` uint32 vector (bitset of categories); the
+    mapping back to category values happens at host Tree materialization.
+    ``cat_mask`` has width 1 when the grower runs without categorical
+    features (static no-op)."""
 
     gain: jnp.ndarray  # improvement over parent minus min_gain; <=0 means no split
     feature: jnp.ndarray  # used-feature index (int32)
@@ -52,6 +59,8 @@ class SplitCandidate(NamedTuple):
     right_g: jnp.ndarray
     right_h: jnp.ndarray
     right_cnt: jnp.ndarray
+    is_cat: jnp.ndarray  # bool
+    cat_mask: jnp.ndarray  # [B] bool (or [1] when categorical is disabled)
 
 
 def constrained_output(
@@ -85,6 +94,17 @@ def gain_given_output(g, h, l1: float, l2: float, out):
     return -(2.0 * t * out + (h + l2 + _EPS) * out * out)
 
 
+class CatParams(NamedTuple):
+    """Static categorical-split config (reference: Config fields consumed by
+    FindBestThresholdCategoricalInner, src/treelearner/feature_histogram.cpp:147)."""
+
+    max_cat_to_onehot: int = 4
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    min_data_per_group: int = 100
+
+
 def best_split(
     hist: jnp.ndarray,  # [F, B, 3] (sum_grad, sum_hess, count)
     parent_g: jnp.ndarray,
@@ -105,9 +125,12 @@ def best_split(
     leaf_lb=None,  # scalar lower bound on child outputs (monotone)
     leaf_ub=None,
     parent_output=0.0,  # current output of the leaf (path smoothing)
+    is_cat: Optional[jnp.ndarray] = None,  # [F] bool — categorical features
+    cat_params: Optional[CatParams] = None,  # static; required with is_cat
 ) -> SplitCandidate:
     f, b, _ = hist.shape
     use_full_gain = monotone is not None or path_smooth > 0.0
+    use_cat = is_cat is not None
 
     has_nan = nan_bins >= 0
     nan_idx = jnp.where(has_nan, nan_bins, 0)
@@ -127,55 +150,163 @@ def best_split(
     # candidate threshold at bin t is valid for t in [0, num_ordered_bins-2]
     num_ordered = num_bins - has_nan.astype(jnp.int32)
     valid_bin = bin_ids < (num_ordered[:, None] - 1)
+    num_feature_mask = feature_mask & ~is_cat if use_cat else feature_mask
 
-    def eval_case(left):  # left: [F, B, 3]
-        lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
+    def eval_gain(lg, lh, lc, l2v, ok):
+        """Masked split gain for [F, B] left-stat candidates (reference:
+        GetSplitGains, feature_histogram.hpp:759-828)."""
         rg, rh, rc = parent[0] - lg, parent[1] - lh, parent[2] - lc
         ok = (
-            valid_bin
+            ok
             & (lc >= min_data_in_leaf)
             & (rc >= min_data_in_leaf)
             & (lh >= min_sum_hessian_in_leaf)
             & (rh >= min_sum_hessian_in_leaf)
-            & feature_mask[:, None]
         )
         if not use_full_gain:
-            gain = leaf_gain(lg, lh, lambda_l1, lambda_l2) + leaf_gain(
-                rg, rh, lambda_l1, lambda_l2
+            gain = leaf_gain(lg, lh, lambda_l1, l2v) + leaf_gain(
+                rg, rh, lambda_l1, l2v
             )
         else:
             # full path: constrained outputs + GetLeafGainGivenOutput
-            # (GetSplitGains with USE_MC, feature_histogram.hpp:759-828)
             out_l = constrained_output(
-                lg, lh, lambda_l1, lambda_l2, max_delta_step,
+                lg, lh, lambda_l1, l2v, max_delta_step,
                 path_smooth, lc, parent_output, leaf_lb, leaf_ub,
             )
             out_r = constrained_output(
-                rg, rh, lambda_l1, lambda_l2, max_delta_step,
+                rg, rh, lambda_l1, l2v, max_delta_step,
                 path_smooth, rc, parent_output, leaf_lb, leaf_ub,
             )
-            gain = gain_given_output(lg, lh, lambda_l1, lambda_l2, out_l) + \
-                gain_given_output(rg, rh, lambda_l1, lambda_l2, out_r)
+            gain = gain_given_output(lg, lh, lambda_l1, l2v, out_l) + \
+                gain_given_output(rg, rh, lambda_l1, l2v, out_r)
             if monotone is not None:
                 mc = monotone[:, None]
                 violated = ((mc > 0) & (out_l > out_r)) | ((mc < 0) & (out_l < out_r))
                 ok = ok & ~violated
         return jnp.where(ok, gain, -jnp.inf)
 
+    def eval_case(left):  # left: [F, B, 3] — numeric cumsum candidates
+        return eval_gain(
+            left[..., 0],
+            left[..., 1],
+            left[..., 2],
+            lambda_l2,
+            valid_bin & num_feature_mask[:, None],
+        )
+
     gain_right = eval_case(cum)  # missing -> right (default_left = False)
     gain_left = jnp.where(
         has_nan[:, None], eval_case(cum + nan_stats[:, None, :]), -jnp.inf
     )  # missing -> left; only distinct when a NaN bin exists
 
-    gains = jnp.stack([gain_right, gain_left])  # [2, F, B]
+    cases = [gain_right, gain_left]
+    if use_cat:
+        # ---- categorical splits (FindBestThresholdCategoricalInner,
+        # src/treelearner/feature_histogram.cpp:147-343).  TPU formulation:
+        # the per-feature sequential sorted-subset scan becomes one argsort
+        # over the bin axis + prefix sums evaluated for ALL (feature, k)
+        # candidates at once; the winning subset is reconstructed as a
+        # bin-space bitmask from the sort ranks.
+        cp = cat_params if cat_params is not None else CatParams()
+        g_, h_, c_ = hist[..., 0], hist[..., 1], hist[..., 2]
+        # the NaN bin never moves LEFT: prediction sends categorical NaN to
+        # the right child (reference CategoricalDecision, tree.h:346), so
+        # keeping its rows right during training makes train == predict
+        in_range = (bin_ids < num_bins[:, None]) & ~is_nan_bin
+        catf = (is_cat & feature_mask)[:, None]
+        use_onehot_f = (num_bins <= cp.max_cat_to_onehot)[:, None]
+        # case 2 — one-hot: left = the single category bin (:188-241)
+        gain_oh = eval_gain(
+            g_, h_, c_, lambda_l2, in_range & catf & use_onehot_f
+        )
+        # cases 3/4 — sorted subset scan, both directions (:243-342)
+        l2c = lambda_l2 + cp.cat_l2
+        validb = in_range & (c_ >= cp.cat_smooth)
+        ctr = g_ / (h_ + cp.cat_smooth)
+        key = jnp.where(validb, ctr, jnp.inf)
+        order = jnp.argsort(key, axis=1, stable=True)  # [F, B] bin ids
+        rank = jnp.argsort(order, axis=1)  # [F, B] sorted position per bin
+
+        def _sorted(x):
+            return jnp.take_along_axis(jnp.where(validb, x, 0.0), order, axis=1)
+
+        pre_g = jnp.cumsum(_sorted(g_), axis=1)
+        pre_h = jnp.cumsum(_sorted(h_), axis=1)
+        pre_c = jnp.cumsum(_sorted(c_), axis=1)
+        used = validb.sum(axis=1).astype(jnp.int32)  # [F]
+        tot_g, tot_h, tot_c = pre_g[:, -1:], pre_h[:, -1:], pre_c[:, -1:]
+        max_num_cat = jnp.minimum(cp.max_cat_threshold, (used + 1) // 2)
+        pos_ok = bin_ids < jnp.minimum(used, max_num_cat)[:, None]
+        ok_sorted = catf & ~use_onehot_f & pos_ok
+
+        bidx = used[:, None] - 2 - bin_ids  # bwd prefix end (may be < 0)
+        has_pre = bidx >= 0
+        bidxc = jnp.clip(bidx, 0, b - 1)
+
+        def _bwd(pre, tot):
+            return tot - jnp.where(
+                has_pre, jnp.take_along_axis(pre, bidxc, axis=1), 0.0
+            )
+
+        def _group_ok(lc):
+            # min_data_per_group: the reference evaluates a candidate only
+            # after >= min_data_per_group rows accumulated since the last
+            # evaluated candidate (:278-312). Vectorized approximation:
+            # evaluate where the cumulative count crosses a multiple of
+            # min_data_per_group (exact when min_data_per_group <= 1).
+            if cp.min_data_per_group <= 1:
+                return jnp.ones(lc.shape, bool)
+            prev = jnp.concatenate(
+                [jnp.zeros((f, 1), lc.dtype), lc[:, :-1]], axis=1
+            )
+            m = float(cp.min_data_per_group)
+            return jnp.floor(lc / m) > jnp.floor(prev / m)
+
+        mdpg_ok_fwd = parent[2] - pre_c >= cp.min_data_per_group
+        gain_fwd = eval_gain(
+            pre_g, pre_h, pre_c, l2c,
+            ok_sorted & _group_ok(pre_c) & mdpg_ok_fwd,
+        )
+        bg, bh, bc = _bwd(pre_g, tot_g), _bwd(pre_h, tot_h), _bwd(pre_c, tot_c)
+        gain_bwd = eval_gain(
+            bg, bh, bc, l2c,
+            ok_sorted & _group_ok(bc) & (parent[2] - bc >= cp.min_data_per_group),
+        )
+        cases += [gain_oh, gain_fwd, gain_bwd]
+
+    gains = jnp.stack(cases)  # [C, F, B]
     flat = jnp.argmax(gains)
-    dl = (flat // (f * b)).astype(jnp.int32)
+    case = (flat // (f * b)).astype(jnp.int32)
+    dl = (case == 1).astype(jnp.int32)
     rem = flat % (f * b)
     feat = (rem // b).astype(jnp.int32)
     tbin = (rem % b).astype(jnp.int32)
     best_gain_raw = gains.reshape(-1)[flat]
 
     left = cum[feat, tbin] + jnp.where(dl == 1, nan_stats[feat], 0.0)
+    if use_cat:
+        left_oh = hist[feat, tbin]
+        left_fwd = jnp.stack([pre_g[feat, tbin], pre_h[feat, tbin], pre_c[feat, tbin]])
+        left_bwd = jnp.stack([bg[feat, tbin], bh[feat, tbin], bc[feat, tbin]])
+        left = jnp.select(
+            [case == 2, case == 3, case == 4],
+            [left_oh, left_fwd, left_bwd],
+            left,
+        )
+        sel_rank = rank[feat]
+        sel_valid = validb[feat]
+        oh_mask = jnp.arange(b, dtype=jnp.int32) == tbin
+        fwd_mask = sel_valid & (sel_rank <= tbin)
+        bwd_mask = sel_valid & (sel_rank >= used[feat] - 1 - tbin)
+        is_cat_win = case >= 2
+        cat_mask = jnp.select(
+            [case == 2, case == 3, case == 4],
+            [oh_mask, fwd_mask, bwd_mask],
+            jnp.zeros((b,), bool),
+        )
+    else:
+        is_cat_win = jnp.asarray(False)
+        cat_mask = jnp.zeros((1,), bool)
     if not use_full_gain:
         parent_gain = leaf_gain(parent[0], parent[1], lambda_l1, lambda_l2)
     else:
@@ -200,4 +331,6 @@ def best_split(
         right_g=parent[0] - left[0],
         right_h=parent[1] - left[1],
         right_cnt=parent[2] - left[2],
+        is_cat=is_cat_win,
+        cat_mask=cat_mask,
     )

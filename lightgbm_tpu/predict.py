@@ -47,10 +47,12 @@ class BinTreeBatch(NamedTuple):
     left_child: jnp.ndarray  # [T, M] int32 (neg = ~leaf)
     right_child: jnp.ndarray  # [T, M] int32
     leaf_value: jnp.ndarray  # [T, L] f32
+    split_is_cat: jnp.ndarray  # [T, M] bool
+    cat_mask: jnp.ndarray  # [T, M, Bm] bool — bin goes left (Bm=1 if no cat)
 
 
 class RealTreeBatch(NamedTuple):
-    """Stacked real-value trees (numeric splits only)."""
+    """Stacked real-value trees (categoricals as per-node value bitsets)."""
 
     split_feature: jnp.ndarray  # [T, M] original feature index
     threshold: jnp.ndarray  # [T, M] f32
@@ -58,6 +60,8 @@ class RealTreeBatch(NamedTuple):
     left_child: jnp.ndarray  # [T, M] int32
     right_child: jnp.ndarray  # [T, M] int32
     leaf_value: jnp.ndarray  # [T, L] f32
+    cat_words: jnp.ndarray  # [T, M, W] uint32 bitset over category VALUES
+    cat_nwords: jnp.ndarray  # [T, M] int32 valid word count per node
 
 
 def stack_bin_trees(records: List[dict], num_leaves_cap: int) -> BinTreeBatch:
@@ -83,6 +87,25 @@ def stack_bin_trees(records: List[dict], num_leaves_cap: int) -> BinTreeBatch:
     for i, r in enumerate(records):
         if len(r["split_feature"]) == 0:
             left[i, 0] = -1
+    # categorical masks: width = max over trees (1 when no tree has any)
+    bm = max(
+        [1]
+        + [
+            np.asarray(r["cat_mask"]).shape[1]
+            for r in records
+            if r.get("cat_mask") is not None and np.size(r.get("cat_mask"))
+        ]
+    )
+    is_cat = np.zeros((t, m), dtype=bool)
+    cmask = np.zeros((t, m, bm), dtype=bool)
+    for i, r in enumerate(records):
+        sic = r.get("split_is_cat")
+        cm = r.get("cat_mask")
+        if sic is not None and len(sic):
+            is_cat[i, : len(sic)] = sic
+        if cm is not None and np.size(cm):
+            cm = np.asarray(cm)
+            cmask[i, : cm.shape[0], : cm.shape[1]] = cm
     return BinTreeBatch(
         split_feature=jnp.asarray(padded("split_feature", 0, np.int32)),
         split_bin=jnp.asarray(padded("split_bin", 0, np.int32)),
@@ -90,6 +113,8 @@ def stack_bin_trees(records: List[dict], num_leaves_cap: int) -> BinTreeBatch:
         left_child=jnp.asarray(left),
         right_child=jnp.asarray(padded("right_child", -1, np.int32)),
         leaf_value=jnp.asarray(leaf),
+        split_is_cat=jnp.asarray(is_cat),
+        cat_mask=jnp.asarray(cmask),
     )
 
 
@@ -103,6 +128,15 @@ def stack_real_trees(trees: List[Tree]) -> RealTreeBatch:
     lc = np.full((t, m), -1, dtype=np.int32)
     rc = np.full((t, m), -1, dtype=np.int32)
     lv = np.zeros((t, L), dtype=np.float32)
+    # per-node category-value bitsets (reference cat_threshold_ words,
+    # tree.h:283): W = widest bitset across all cat nodes, 1 if none
+    w = 1
+    for tr in trees:
+        if tr.cat_boundaries is not None:
+            for ci in range(len(tr.cat_boundaries) - 1):
+                w = max(w, int(tr.cat_boundaries[ci + 1] - tr.cat_boundaries[ci]))
+    cw = np.zeros((t, m, w), dtype=np.uint32)
+    cn = np.zeros((t, m), dtype=np.int32)
     for i, tr in enumerate(trees):
         nn = tr.num_leaves - 1
         sf[i, :nn] = tr.split_feature
@@ -111,6 +145,14 @@ def stack_real_trees(trees: List[Tree]) -> RealTreeBatch:
         lc[i, :nn] = tr.left_child
         rc[i, :nn] = tr.right_child
         lv[i, : tr.num_leaves] = tr.leaf_value
+        if tr.cat_boundaries is not None:
+            for node in range(nn):
+                if tr.decision_type[node] & 1:
+                    ci = int(tr.threshold[node])
+                    b0 = int(tr.cat_boundaries[ci])
+                    b1 = int(tr.cat_boundaries[ci + 1])
+                    cw[i, node, : b1 - b0] = tr.cat_threshold[b0:b1]
+                    cn[i, node] = b1 - b0
     return RealTreeBatch(
         split_feature=jnp.asarray(sf),
         threshold=jnp.asarray(th),
@@ -118,6 +160,8 @@ def stack_real_trees(trees: List[Tree]) -> RealTreeBatch:
         left_child=jnp.asarray(lc),
         right_child=jnp.asarray(rc),
         leaf_value=jnp.asarray(lv),
+        cat_words=jnp.asarray(cw),
+        cat_nwords=jnp.asarray(cn),
     )
 
 
@@ -152,7 +196,17 @@ def predict_bins_leaves(batch: BinTreeBatch, bins: jnp.ndarray, nan_bins: jnp.nd
         dl = batch.default_left[tree_ids, cur]
         fval = jnp.take_along_axis(bins, feat, axis=1)
         nb = nan_bins[feat]
-        return (fval <= tbin) | (dl & (nb >= 0) & (fval == nb))
+        gl = (fval <= tbin) | (dl & (nb >= 0) & (fval == nb))
+        bm = batch.cat_mask.shape[-1]
+        if bm > 1:
+            # one joint gather to [N, T] — a two-step index would materialize
+            # an [N, T, Bm] intermediate inside every walk iteration
+            gl_cat = batch.cat_mask[tree_ids, cur, jnp.minimum(fval, bm - 1)]
+            # out-of-range bins (unseen-category sentinel) are never in the
+            # left subset (reference CategoricalDecision, tree.h:382)
+            gl_cat = gl_cat & (fval < bm)
+            gl = jnp.where(batch.split_is_cat[tree_ids, cur], gl_cat, gl)
+        return gl
 
     nodes = _walk(decide, batch.left_child, batch.right_child, n, t)
     return ~nodes  # [N, T] leaf indices
@@ -185,7 +239,18 @@ def predict_real_leaves(batch: RealTreeBatch, X: jnp.ndarray) -> jnp.ndarray:
             (missing == MISSING_NAN) & jnp.isnan(fv)
         )
         dl = (dt & K_DEFAULT_LEFT_MASK) != 0
-        return jnp.where(is_missing, dl, fv <= thr)
+        gl = jnp.where(is_missing, dl, fv <= thr)
+        # categorical: bit test in the node's value bitset; NaN/negative/
+        # out-of-range values go right (CategoricalDecision, tree.h:346)
+        wmax = batch.cat_words.shape[-1]
+        is_cat = (dt & 1) != 0
+        iv = jnp.where(is_nan | (fval < 0), -1, fval).astype(jnp.int32)
+        word_idx = jnp.clip(iv // 32, 0, wmax - 1)
+        words = batch.cat_words[tree_ids, cur]  # [N, T, W]
+        word = jnp.take_along_axis(words, word_idx[..., None], axis=2)[..., 0]
+        in_range = (iv >= 0) & ((iv // 32) < batch.cat_nwords[tree_ids, cur])
+        bit = (word >> (iv % 32).astype(jnp.uint32)) & 1
+        return jnp.where(is_cat, in_range & (bit == 1), gl)
 
     nodes = _walk(decide, batch.left_child, batch.right_child, n, t)
     return ~nodes
@@ -210,10 +275,13 @@ def add_tree_to_score(
     left_child: jnp.ndarray,
     right_child: jnp.ndarray,
     leaf_value: jnp.ndarray,  # [L] ALREADY shrunk
+    split_is_cat: Optional[jnp.ndarray] = None,  # [L-1] bool
+    cat_mask: Optional[jnp.ndarray] = None,  # [L-1, Bm] bool
 ) -> jnp.ndarray:
     """Walk one bin-space tree over a dataset and add leaf outputs to score —
     the valid-set ScoreUpdater::AddScore (src/boosting/score_updater.hpp:54)."""
     n = bins.shape[0]
+    use_cat = cat_mask is not None and cat_mask.shape[-1] > 1
 
     def cond(nodes):
         return jnp.any(nodes >= 0)
@@ -226,6 +294,10 @@ def add_tree_to_score(
         fval = jnp.take_along_axis(bins, feat[:, None], axis=1)[:, 0]
         nb = nan_bins[feat]
         go_left = (fval <= tbin) | (dl & (nb >= 0) & (fval == nb))
+        if use_cat:
+            bm = cat_mask.shape[-1]
+            gl_cat = cat_mask[cur, jnp.minimum(fval, bm - 1)] & (fval < bm)
+            go_left = jnp.where(split_is_cat[cur], gl_cat, go_left)
         nxt = jnp.where(go_left, left_child[cur], right_child[cur])
         return jnp.where(nodes >= 0, nxt, nodes)
 

@@ -109,6 +109,8 @@ class Tree:
         split_feature_used = np.asarray(ta.split_feature)[:nn]
         split_bin = np.asarray(ta.split_bin)[:nn]
         default_left = np.asarray(ta.default_left)[:nn]
+        split_is_cat = np.asarray(ta.split_is_cat)[:nn]
+        node_cat_mask = np.asarray(ta.cat_mask)[:nn]
 
         split_feature = np.zeros(nn, dtype=np.int32)
         threshold = np.zeros(nn, dtype=np.float64)
@@ -121,8 +123,16 @@ class Tree:
             split_feature[t] = orig
             mapper = bin_mappers[orig]
             if mapper.is_categorical:
-                # left = categories whose frequency-ordered bin index <= split_bin
-                cats = mapper.bin_to_cat[: int(split_bin[t]) + 1]
+                # left = category values of the bins the split search chose
+                # (SplitCandidate.cat_mask -> reference cat_threshold_ bitset;
+                # the NaN bin is never in the mask, matching prediction's
+                # NaN-goes-right rule, tree.h:346)
+                if split_is_cat[t]:
+                    bins_left = np.nonzero(node_cat_mask[t])[0]
+                else:  # freq-rank prefix fallback (legacy records)
+                    bins_left = np.arange(int(split_bin[t]) + 1)
+                bins_left = bins_left[bins_left < len(mapper.bin_to_cat)]
+                cats = mapper.bin_to_cat[bins_left]
                 max_cat = int(cats.max()) if len(cats) else 0
                 words = [0] * (max_cat // 32 + 1)
                 for c in cats:

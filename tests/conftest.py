@@ -18,7 +18,19 @@ if "xla_force_host_platform_device_count" not in _flags:
 # Run the whole suite on the virtual CPU mesh: correctness tests don't need
 # the (remote-tunneled, slow-compile) TPU, and serial-vs-sharded comparisons
 # must run on ONE platform so reduction-order diffs don't flip tied splits.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The env var alone is NOT enough: a TPU-tunnel shim (sitecustomize) may have
+# already set the jax_platforms CONFIG to prefer its backend, which overrides
+# the env and routes every default-placed op through the tunnel (and hangs the
+# whole suite if the tunnel is down). Force the config before any backend
+# initializes — jax may be imported, but its backends are still lazy here.
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import pytest  # noqa: E402
 

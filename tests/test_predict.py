@@ -1,0 +1,131 @@
+"""Prediction paths: early stopping, linear-tree coefficients, loaded-model
+categorical device walker.
+
+Reference analogs: prediction_early_stop.cpp (margin rules) +
+gbdt_prediction.cpp:18 (per-iteration counter loop); CategoricalDecision
+(tree.h:346) for the real-space bitset walker.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+
+def test_pred_early_stop_matches_sequential_reference():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 6))
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(float)
+    b = lgb.train(
+        {"objective": "binary", "verbosity": -1, "num_leaves": 15},
+        lgb.Dataset(X, y),
+        40,
+    )
+    freq, margin = 5, 4.0
+    raw_pt = np.asarray([t.predict(X) for t in b.models_]).T  # [N, T]
+    want = np.zeros(len(X))
+    for i in range(len(X)):
+        acc, cnt = 0.0, 0
+        for t in range(raw_pt.shape[1]):
+            acc += raw_pt[i, t]
+            cnt += 1
+            if cnt == freq:
+                if 2 * abs(acc) > margin:
+                    break
+                cnt = 0
+        want[i] = acc
+    got = b.predict(
+        X,
+        raw_score=True,
+        pred_early_stop=True,
+        pred_early_stop_freq=freq,
+        pred_early_stop_margin=margin,
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # with an infinite margin the output is the full model exactly
+    full = b.predict(X, raw_score=True)
+    es_inf = b.predict(
+        X, raw_score=True, pred_early_stop=True, pred_early_stop_margin=1e30
+    )
+    np.testing.assert_allclose(es_inf, full, rtol=1e-6)
+
+
+def test_pred_early_stop_multiclass_margin():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(400, 5))
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.3).astype(int)
+    b = lgb.train(
+        {
+            "objective": "multiclass",
+            "num_class": 3,
+            "verbosity": -1,
+            "num_leaves": 7,
+        },
+        lgb.Dataset(X, y),
+        20,
+    )
+    p = b.predict(
+        X, pred_early_stop=True, pred_early_stop_freq=3,
+        pred_early_stop_margin=2.0,
+    )
+    assert p.shape == (400, 3)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-5)
+    # tight margin must diverge from the full model somewhere
+    full = b.predict(X)
+    loose = b.predict(
+        X, pred_early_stop=True, pred_early_stop_margin=1e30
+    )
+    np.testing.assert_allclose(loose, full, rtol=1e-6)
+
+
+def test_linear_tree_predict_uses_coefficients():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 4))
+    y = 2 * X[:, 0] + X[:, 1] + rng.normal(scale=0.05, size=500)
+    b = lgb.train(
+        {
+            "objective": "regression",
+            "linear_tree": True,
+            "verbosity": -1,
+            "num_leaves": 7,
+        },
+        lgb.Dataset(X, y),
+        5,
+    )
+    p = b.predict(X)
+    want = np.zeros(len(X))
+    for t in b.models_:
+        want += t.predict(X)
+    np.testing.assert_allclose(p, want, rtol=1e-5, atol=1e-6)
+
+
+def test_loaded_categorical_model_device_walker():
+    """A model loaded from text (no train_set / bin mappers) with categorical
+    splits predicts through the jitted real-space bitset walker — and agrees
+    with both the training booster and the host per-row walk."""
+    rng = np.random.default_rng(7)
+    catv = rng.integers(0, 15, size=800).astype(float)
+    y = np.where(catv % 3 == 0, 1.0, -1.0) + rng.normal(scale=0.05, size=800)
+    X = catv.reshape(-1, 1)
+    b = lgb.train(
+        {
+            "objective": "regression",
+            "num_leaves": 8,
+            "min_data_per_group": 1,
+            "max_cat_to_onehot": 1,
+            "verbosity": -1,
+        },
+        lgb.Dataset(X, y, categorical_feature=[0]),
+        5,
+    )
+    p_train = b.predict(X)
+    loaded = lgb.Booster(model_str=b.model_to_string())
+    p_loaded = loaded.predict(X)
+    np.testing.assert_allclose(p_loaded, p_train, rtol=1e-6, atol=1e-7)
+    # unseen category and NaN go right (never crash, never go left wrongly)
+    Xu = np.array([[99.0], [np.nan]])
+    pu = loaded.predict(Xu)
+    assert np.isfinite(pu).all()
+    np.testing.assert_allclose(pu, b.predict(Xu), rtol=1e-6)

@@ -1,0 +1,78 @@
+"""Refit + snapshot_freq (reference: GBDT::RefitTree gbdt.cpp:266,
+SerialTreeLearner::FitByExistingTree serial_tree_learner.cpp:250,
+GBDT::Train snapshot loop gbdt.cpp:258)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(600, 5))
+    y = X[:, 0] * 2 + rng.normal(scale=0.1, size=600)
+    b = lgb.train(
+        {"objective": "regression", "verbosity": -1, "num_leaves": 7},
+        lgb.Dataset(X, y),
+        7,
+    )
+    return b, X, y
+
+
+def test_refit_improves_on_shifted_data(trained):
+    b, X, y = trained
+    rng = np.random.default_rng(1)
+    X2 = rng.normal(size=(600, 5))
+    y2 = X2[:, 0] * 2 + 1.0 + rng.normal(scale=0.1, size=600)
+    b2 = b.refit(X2, y2, decay_rate=0.5)
+    assert np.mean((b2.predict(X2) - y2) ** 2) < np.mean(
+        (b.predict(X2) - y2) ** 2
+    )
+    # structure is preserved: same leaves, same split features
+    assert [t.num_leaves for t in b2.models_] == [t.num_leaves for t in b.models_]
+    for t1, t2 in zip(b.models_, b2.models_):
+        np.testing.assert_array_equal(t1.split_feature, t2.split_feature)
+        np.testing.assert_allclose(t1.threshold, t2.threshold)
+
+
+def test_refit_decay_one_is_identity(trained):
+    b, X, y = trained
+    rng = np.random.default_rng(2)
+    X2 = rng.normal(size=(600, 5))
+    y2 = X2[:, 0] + rng.normal(size=600)
+    b2 = b.refit(X2, y2, decay_rate=1.0)
+    np.testing.assert_allclose(b2.predict(X2), b.predict(X2), atol=1e-7)
+
+
+def test_snapshot_freq(tmp_path):
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 4))
+    y = X[:, 0] + rng.normal(scale=0.1, size=400)
+    out = str(tmp_path / "m.txt")
+    b = lgb.train(
+        {
+            "objective": "regression",
+            "verbosity": -1,
+            "num_leaves": 7,
+            "snapshot_freq": 2,
+            "output_model": out,
+        },
+        lgb.Dataset(X, y),
+        5,
+    )
+    snaps = sorted(glob.glob(out + ".snapshot_iter_*"))
+    assert [os.path.basename(s) for s in snaps] == [
+        "m.txt.snapshot_iter_2",
+        "m.txt.snapshot_iter_4",
+    ]
+    # a snapshot is a loadable model with fewer trees
+    snap = lgb.Booster(model_file=snaps[0])
+    assert snap.num_trees() == 2
+    assert np.isfinite(snap.predict(X)).all()
