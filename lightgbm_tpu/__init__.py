@@ -23,6 +23,8 @@ from .callback import (
 from .config import Config
 from .dataset import Dataset
 from .engine import CVBooster, cv, train
+from .utils.log import register_logger
+from .utils.timer import global_timer
 
 try:
     from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
@@ -43,6 +45,8 @@ __all__ = [
     "record_evaluation",
     "reset_parameter",
     "EarlyStopException",
+    "register_logger",
+    "global_timer",
     "Config",
     "LGBMModel",
     "LGBMClassifier",

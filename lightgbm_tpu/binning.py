@@ -225,7 +225,12 @@ class BinMapper:
                 dv, cnt = np.unique(nonzero, return_counts=True)
                 bounds = _greedy_find_bin(dv, cnt, max_bin - 1, len(nonzero), min_data_in_bin)
         else:
-            bounds = _find_bin_zero_as_one(finite, len(finite), max_bin, min_data_in_bin)
+            # total_cnt may exceed len(values) for sparse inputs: the
+            # difference is an implied count of zeros (sparse_bin.hpp loaders
+            # never materialize them)
+            bounds = _find_bin_zero_as_one(
+                finite, total_cnt - int(nan_mask.sum()), max_bin, min_data_in_bin
+            )
 
         num_numeric = len(bounds)
         nan_bin = -1

@@ -389,6 +389,7 @@ class Booster:
         self._is_cat = jnp.asarray(isc) if self._has_cat else None
         self._max_bin_padded = _ceil_pow2(int(nb.max()) if len(nb) else 2)
         self._setup_constraints()
+        self._forced = self._build_forced_splits()
         self._grower_params = self._make_grower_params()
         f_used = self._bins.shape[1]
         if self._mesh is not None:
@@ -484,6 +485,12 @@ class Booster:
         """Grow one tree: serial grow_tree or the mesh-sharded shard_map path
         (reference: SerialTreeLearner vs DataParallelTreeLearner dispatch,
         src/boosting/gbdt.cpp:59 tree_learner selection)."""
+        from ..utils.timer import global_timer
+
+        with global_timer.timed("tree/grow"):
+            return self._grow_one_inner(grad_k, hess_k, mask, feature_mask, rng)
+
+    def _grow_one_inner(self, grad_k, hess_k, mask, feature_mask, rng):
         if self._mesh is not None:
             return self._sharded_grow(
                 self._bins,
@@ -497,6 +504,7 @@ class Booster:
                 self._inter_arg,
                 rng if rng is not None else jax.random.PRNGKey(0),
                 self._iscat_arg,
+                self._forced,
             )
         return grow_tree(
             self._bins,
@@ -511,6 +519,63 @@ class Booster:
             interaction_sets=self._interaction_sets,
             rng=rng,
             is_cat=self._is_cat,
+            forced=self._forced,
+        )
+
+    def _build_forced_splits(self):
+        """forcedsplits_filename JSON -> BFS step arrays in the grower's
+        leaf-id convention (step t splits `leaf`; left keeps the id, right
+        becomes t+1).  Reference: SerialTreeLearner::ForceSplits
+        (serial_tree_learner.cpp:627) — queue-ordered, thresholds quantized
+        through the BinMapper like BinThreshold."""
+        fn = self.config.forcedsplits_filename
+        if not fn:
+            return None
+        import json as _json
+        from collections import deque
+
+        with open(fn) as fp:
+            root = _json.load(fp)
+        ds = self.train_set
+        orig_to_used = {j: ci for ci, j in enumerate(ds.used_features)}
+        steps = []  # (leaf, used_feat, bin, is_cat)
+        q = deque([(root, 0)])
+        max_steps = self.config.num_leaves - 1
+        while q and len(steps) < max_steps:
+            node, leaf = q.popleft()
+            if (
+                not isinstance(node, dict)
+                or "feature" not in node
+                or "threshold" not in node
+            ):
+                continue
+            orig = int(node["feature"])
+            if orig not in orig_to_used:
+                break  # unused feature: abort remaining (reference warns)
+            ci = orig_to_used[orig]
+            mapper = ds.bin_mappers[orig]
+            if mapper.is_categorical:
+                bn = (mapper.cat_to_bin or {}).get(int(node["threshold"]))
+                if bn is None:
+                    break
+                steps.append((leaf, ci, int(bn), True))
+            else:
+                ub = np.asarray(mapper.bin_upper_bound)
+                bn = int(np.searchsorted(ub, float(node["threshold"]), side="left"))
+                steps.append((leaf, ci, min(bn, mapper.num_bins - 1), False))
+            t = len(steps) - 1
+            if "left" in node:
+                q.append((node["left"], leaf))
+            if "right" in node:
+                q.append((node["right"], t + 1))
+        if not steps:
+            return None
+        arr = np.asarray(steps, dtype=np.int64)
+        return (
+            jnp.asarray(arr[:, 0].astype(np.int32)),
+            jnp.asarray(arr[:, 1].astype(np.int32)),
+            jnp.asarray(arr[:, 2].astype(np.int32)),
+            jnp.asarray(arr[:, 3].astype(bool)),
         )
 
     def _make_grower_params(self) -> GrowerParams:
@@ -541,6 +606,7 @@ class Booster:
             )
             if self._has_cat
             else None,
+            n_forced=0 if self._forced is None else len(self._forced[0]),
         )
 
     def _fit_linear_leaves(
@@ -1115,6 +1181,8 @@ class Booster:
         X = self._coerce_predict_input(data)
         t0, t1 = self._tree_range(start_iteration, num_iteration)
         if pred_contrib:
+            if hasattr(X, "toarray"):
+                X = np.asarray(X.toarray(), dtype=np.float64)
             return self._predict_contrib(X, t0, t1)
         k = self.num_tree_per_iteration
         if t1 <= t0 or not self.models_:
@@ -1141,6 +1209,8 @@ class Booster:
                 return np.asarray(leaves, dtype=np.int32)
             per_tree = np.asarray(predict_bins_raw(batch, bins, self._nan_bins), dtype=np.float64)
         else:
+            if hasattr(X, "toarray"):  # real-space walkers need dense values
+                X = np.asarray(X.toarray(), dtype=np.float64)
             # linear trees carry per-leaf coefficients the device walker
             # doesn't model — host walk (Tree.predict applies them)
             has_linear = any(t.is_linear for t in self.models_[t0:t1])
@@ -1206,7 +1276,7 @@ class Booster:
         first = np.where(any_stop, stop.argmax(axis=1), iters - 1)
         return cum[np.arange(n), first]
 
-    def _coerce_predict_input(self, data) -> np.ndarray:
+    def _coerce_predict_input(self, data):
         try:
             import pandas as pd  # type: ignore
 
@@ -1214,24 +1284,35 @@ class Booster:
                 data = data.to_numpy(dtype=np.float64, na_value=np.nan)
         except Exception:
             pass
-        if hasattr(data, "toarray"):  # scipy sparse
-            data = data.toarray()
+        if hasattr(data, "tocsc") and hasattr(data, "nnz"):
+            # scipy sparse stays sparse: the bin path bins per-column from
+            # CSC; paths that need dense values densify themselves
+            return data
         X = np.asarray(data, dtype=np.float64)
         if X.ndim == 1:
             X = X[None, :]
         return X
 
-    def _bin_input(self, X: np.ndarray) -> jnp.ndarray:
+    def _bin_input(self, X) -> jnp.ndarray:
         ds = self.train_set
+        csc = X.tocsc() if hasattr(X, "tocsc") else None
+        if csc is not None and csc.shape[1] < ds.num_total_features:
+            csc.resize(csc.shape[0], ds.num_total_features)
         cols = []
         for j in ds.used_features:
             mapper = ds.bin_mappers[j]
-            b = mapper.values_to_bins(X[:, j])
+            if csc is not None:
+                sl = slice(csc.indptr[j], csc.indptr[j + 1])
+                col = np.zeros(csc.shape[0], np.float64)
+                col[csc.indices[sl]] = csc.data[sl]
+            else:
+                col = X[:, j]
+            b = mapper.values_to_bins(col)
             if mapper.is_categorical:
                 # unseen categories must fall through to the right child
                 # (reference CategoricalDecision, tree.h:382): bin 0 would
                 # wrongly send them left, so route them to a sentinel bin
-                vals = X[:, j]
+                vals = np.asarray(col)
                 nan_mask = np.isnan(vals)
                 iv = np.where(nan_mask, -1, vals).astype(np.int64)
                 known = np.isin(iv, mapper.bin_to_cat) & (iv >= 0)
@@ -1434,6 +1515,7 @@ class Booster:
         self._finished = False
         if self.train_set is not None:
             self._setup_constraints()
+            self._forced = self._build_forced_splits()
             self._grower_params = self._make_grower_params()
             if self._mesh is not None:
                 # the shard_map'd grower closed over the OLD params
@@ -1556,6 +1638,8 @@ class Booster:
 
     def _raw_for_replay(self, ds: Dataset) -> np.ndarray:
         if ds.raw is not None:
+            if hasattr(ds.raw, "toarray"):  # sparse kept via free_raw_data=False
+                return np.asarray(ds.raw.toarray(), dtype=np.float64)
             return ds.raw
         # reconstruct representative values from bins (inverse binning):
         # exact for the tree decisions because thresholds are bin bounds

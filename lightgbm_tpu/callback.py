@@ -8,6 +8,7 @@ the boosting update; ``EarlyStopException`` unwinds the training loop.
 from __future__ import annotations
 
 import collections
+from .utils.log import log_info
 from typing import Any, Callable, Dict, List
 
 
@@ -43,7 +44,7 @@ def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
                         parts.append(f"{data_name}'s {eval_name}: {result:g} + {stdv:g}")
                     else:
                         parts.append(f"{data_name}'s {eval_name}: {result:g}")
-            print(f"[{env.iteration + 1}]\t" + "\t".join(parts))
+            log_info(f"[{env.iteration + 1}]\t" + "\t".join(parts))
 
     _callback.order = 10
     return _callback
@@ -162,7 +163,7 @@ def early_stopping(
             if env.iteration - best_iter[i] >= stopping_rounds:
                 env.model.best_iteration = best_iter[i] + 1
                 if verbose:
-                    print(
+                    log_info(
                         f"Early stopping, best iteration is:\n[{best_iter[i] + 1}]\t"
                         + "\t".join(
                             f"{it[0]}'s {it[1]}: {it[2]:g}" for it in best_score_list[i]
@@ -172,7 +173,7 @@ def early_stopping(
             if env.iteration == env.end_iteration - 1:
                 env.model.best_iteration = best_iter[i] + 1
                 if verbose:
-                    print(
+                    log_info(
                         "Did not meet early stopping. Best iteration is:\n"
                         f"[{best_iter[i] + 1}]\t"
                         + "\t".join(

@@ -63,17 +63,67 @@ class Metadata:
         self.query_boundaries = boundaries
 
 
-def _load_text_file(path: str, config: Config) -> Dict[str, Any]:
-    """Parse a CSV/TSV/LibSVM-style training file (reference src/io/parser.cpp).
+def _parse_libsvm(lines, path: str) -> Dict[str, Any]:
+    """LibSVM text parser (reference: LibSVMParser, src/io/parser.hpp:136):
+    ``label [qid:q] idx:val idx:val ...`` -> CSR matrix, never densified."""
+    import scipy.sparse as sp
 
-    Only dense CSV/TSV with an optional header is supported for now; label
-    column defaults to 0 as in the reference CLI examples.
-    """
+    labels: List[float] = []
+    rows: List[int] = []
+    cols: List[int] = []
+    vals: List[float] = []
+    qids: List[int] = []
+    r = 0
+    for ln in lines:
+        parts = ln.split()
+        if not parts:
+            continue
+        labels.append(float(parts[0]))
+        for tok in parts[1:]:
+            k, v = tok.split(":", 1)
+            if k == "qid":
+                qids.append(int(v))
+                continue
+            rows.append(r)
+            cols.append(int(k))
+            vals.append(float(v))
+        r += 1
+    ncol = max(cols) + 1 if cols else 1
+    csr = sp.csr_matrix(
+        (np.asarray(vals), (np.asarray(rows), np.asarray(cols))),
+        shape=(r, ncol),
+    )
+    out: Dict[str, Any] = {"data": csr, "label": np.asarray(labels)}
+    if len(qids) == r and r > 0:
+        # consecutive qid runs -> group sizes (reference parses qid the same
+        # way its query file does)
+        q = np.asarray(qids)
+        change = np.nonzero(np.diff(q))[0] + 1
+        bounds = np.concatenate([[0], change, [r]])
+        out["group"] = np.diff(bounds)
+    qpath = Path(str(path) + ".query")
+    if qpath.exists():
+        out["group"] = np.loadtxt(qpath, dtype=np.int64, ndmin=1)
+    wpath = Path(str(path) + ".weight")
+    if wpath.exists():
+        out["weight"] = np.loadtxt(wpath, dtype=np.float64, ndmin=1)
+    return out
+
+
+def _load_text_file(path: str, config: Config) -> Dict[str, Any]:
+    """Parse a CSV/TSV/LibSVM training file (reference src/io/parser.cpp);
+    LibSVM rows load into a CSR matrix (sparse path), dense CSV/TSV into a
+    float matrix. Label column defaults to 0 as in the reference CLI."""
     p = Path(path)
     text = p.read_text()
-    first = text.splitlines()[0] if text else ""
-    delim = "\t" if "\t" in first else ("," if "," in first else None)
+    lines = text.splitlines()
     skip = 1 if config.header else 0
+    first_data = next((ln for ln in lines[skip:] if ln.strip()), "")
+    toks = first_data.replace(",", " ").split()
+    if len(toks) > 1 and ":" in toks[1]:
+        return _parse_libsvm(lines[skip:], path)
+    first = lines[0] if lines else ""
+    delim = "\t" if "\t" in first else ("," if "," in first else None)
     arr = np.loadtxt(path, delimiter=delim, skiprows=skip, dtype=np.float64, ndmin=2)
     label_col = 0
     if config.label_column not in ("", None):
@@ -166,6 +216,12 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._constructed:
             return self
+        from .utils.timer import global_timer
+
+        with global_timer.timed("dataset/construct"):
+            return self._construct_inner()
+
+    def _construct_inner(self) -> "Dataset":
         data = self._raw_data
         label = self._label
         if isinstance(data, (str, Path)):
@@ -192,14 +248,20 @@ class Dataset:
             data = data.to_numpy(dtype=np.float64, na_value=np.nan)
         if data is None:
             raise ValueError("Dataset has no data")
-        if hasattr(data, "toarray"):  # scipy CSR/CSC (reference: CreateFromCSR)
-            # the dense uint8 bin matrix is the storage format either way;
-            # sparse inputs densify once at construction
-            data = data.toarray()
-        data = np.asarray(data, dtype=np.float64)
-        if data.ndim != 2:
-            raise ValueError(f"data must be 2-D, got shape {data.shape}")
-        n, num_features = data.shape
+        sparse_csc = None
+        if hasattr(data, "tocsc") and hasattr(data, "nnz"):
+            # scipy CSR/CSC (reference: Dataset::CreateFromCSR, c_api.cpp +
+            # SparseBin construction, src/io/sparse_bin.hpp): bin directly
+            # from the sparse columns — the dense FLOAT matrix is never
+            # materialized; only the uint8/16 bin matrix is (zeros fill each
+            # feature's zero bin, nonzeros scatter their bins)
+            sparse_csc = data.tocsc()
+            n, num_features = sparse_csc.shape
+        else:
+            data = np.asarray(data, dtype=np.float64)
+            if data.ndim != 2:
+                raise ValueError(f"data must be 2-D, got shape {data.shape}")
+            n, num_features = data.shape
         self.num_total_features = num_features
 
         if label is None:
@@ -221,20 +283,49 @@ class Dataset:
             self.used_features = ref.used_features
             self.feature_names = ref.feature_names
             self.num_total_features = ref.num_total_features
+            if sparse_csc is not None and sparse_csc.shape[1] < self.num_total_features:
+                # a sparse file may simply lack the highest-index features
+                # (LibSVM row widths vary); missing columns are all-zero
+                sparse_csc.resize(n, self.num_total_features)
+        elif sparse_csc is not None:
+            self._build_bin_mappers_sparse(sparse_csc, cat_idx)
         else:
             self._build_bin_mappers(data, cat_idx)
 
-        cols = []
-        for j in self.used_features:
-            cols.append(self.bin_mappers[j].values_to_bins(data[:, j]))
-        if cols:
-            binned = np.stack(cols, axis=1)
-        else:
-            binned = np.zeros((n, 0), dtype=np.int32)
         max_bins = max((m.num_bins for m in self.bin_mappers), default=1)
         dtype = np.uint8 if max_bins <= 256 else np.uint16
-        self.bins = binned.astype(dtype)
-        self.raw = data if (self.config.linear_tree or not self.free_raw_data) else None
+        if sparse_csc is not None:
+            binned = np.zeros((n, len(self.used_features)), dtype=dtype)
+            for ci, j in enumerate(self.used_features):
+                mapper = self.bin_mappers[j]
+                sl = slice(sparse_csc.indptr[j], sparse_csc.indptr[j + 1])
+                zb = mapper.values_to_bins(np.zeros(1))[0]
+                if zb:
+                    binned[:, ci] = zb
+                binned[sparse_csc.indices[sl], ci] = mapper.values_to_bins(
+                    sparse_csc.data[sl]
+                ).astype(dtype)
+            self.bins = binned
+            if self.config.linear_tree:
+                raise ValueError("linear_tree is not supported for sparse input")
+            # free_raw_data=False keeps the (row-sliceable) sparse matrix so
+            # cv()'s fold slicing works; the dense float is still never built
+            self.raw = None if self.free_raw_data else sparse_csc.tocsr()
+        else:
+            cols = []
+            for j in self.used_features:
+                cols.append(self.bin_mappers[j].values_to_bins(data[:, j]))
+            if cols:
+                binned = np.stack(cols, axis=1)
+            else:
+                binned = np.zeros((n, 0), dtype=np.int32)
+            self.bins = binned.astype(dtype)
+        if sparse_csc is None:
+            self.raw = (
+                data
+                if (self.config.linear_tree or not self.free_raw_data)
+                else None
+            )
 
         weight = self._weight
         if weight is not None:
@@ -277,6 +368,29 @@ class Dataset:
                 out.append(int(str(c).replace("name:", "")) if str(c).isdigit() else -1)
         return [c for c in out if 0 <= c < num_features]
 
+    def _add_mapper(self, j: int, values: np.ndarray, cat_idx: List[int],
+                    total_cnt: Optional[int] = None) -> None:
+        """Shared per-feature mapper construction for the dense and sparse
+        builders (max_bin_by_feature lookup + trivial-feature pruning)."""
+        cfg = self.config
+        mb = (
+            cfg.max_bin_by_feature[j]
+            if j < len(cfg.max_bin_by_feature)
+            else cfg.max_bin
+        )
+        mapper = BinMapper.from_sample(
+            values,
+            mb,
+            is_categorical=j in cat_idx,
+            min_data_in_bin=cfg.min_data_in_bin,
+            use_missing=cfg.use_missing,
+            zero_as_missing=cfg.zero_as_missing,
+            total_cnt=total_cnt,
+        )
+        self.bin_mappers.append(mapper)
+        if not mapper.is_trivial:
+            self.used_features.append(j)
+
     def _build_bin_mappers(self, data: np.ndarray, cat_idx: List[int]) -> None:
         cfg = self.config
         n = data.shape[0]
@@ -287,26 +401,36 @@ class Dataset:
             sample = data[np.sort(sample_rows)]
         else:
             sample = data
-        max_bin_by_feature = cfg.max_bin_by_feature
         self.bin_mappers = []
         self.used_features = []
         for j in range(data.shape[1]):
-            mb = (
-                max_bin_by_feature[j]
-                if j < len(max_bin_by_feature)
-                else cfg.max_bin
-            )
-            mapper = BinMapper.from_sample(
-                sample[:, j],
-                mb,
-                is_categorical=j in cat_idx,
-                min_data_in_bin=cfg.min_data_in_bin,
-                use_missing=cfg.use_missing,
-                zero_as_missing=cfg.zero_as_missing,
-            )
-            self.bin_mappers.append(mapper)
-            if not mapper.is_trivial:
-                self.used_features.append(j)
+            self._add_mapper(j, sample[:, j], cat_idx)
+
+    def _build_bin_mappers_sparse(self, csc, cat_idx: List[int]) -> None:
+        """Per-column binning from CSC nonzeros; zeros enter as an implied
+        count (reference: BinMapper::FindBin's zero_cnt handling,
+        src/io/bin.cpp — the sparse loader never expands columns)."""
+        cfg = self.config
+        n = csc.shape[0]
+        self.bin_mappers = []
+        self.used_features = []
+        # sampling: cap the per-column nonzeros considered, like
+        # bin_construct_sample_cnt caps rows for the dense path
+        sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+        frac = sample_cnt / n
+        rng = np.random.default_rng(cfg.data_random_seed)
+        for j in range(csc.shape[1]):
+            sl = slice(csc.indptr[j], csc.indptr[j + 1])
+            vals = np.asarray(csc.data[sl], dtype=np.float64)
+            total = n
+            if frac < 1.0 and len(vals) > 0:
+                keep = rng.random(len(vals)) < frac
+                vals = vals[keep]
+                total = sample_cnt
+            if j in cat_idx and total > len(vals):
+                # categorical zeros are a real category, not an implied bin
+                vals = np.concatenate([vals, np.zeros(total - len(vals))])
+            self._add_mapper(j, vals, cat_idx, total_cnt=total)
 
     # ----------------------------------------------------------- field API
     def set_label(self, label: np.ndarray) -> "Dataset":

@@ -17,6 +17,8 @@ from .boosting.gbdt import Booster
 from .callback import CallbackEnv, EarlyStopException, early_stopping, log_evaluation
 from .config import Config
 from .dataset import Dataset
+from .utils.log import log_info
+from .utils.timer import global_timer
 
 
 def train(
@@ -32,6 +34,8 @@ def train(
     fobj: Optional[Callable] = None,
 ) -> Booster:
     """Train a GBDT model (reference: engine.py:109)."""
+    # fresh per-run phase report (repeated fits would double-count otherwise)
+    global_timer.reset()
     params = dict(params or {})
     cfg = Config.from_params(params)
     if "num_iterations" in cfg.raw:
@@ -92,7 +96,8 @@ def train(
                         evaluation_result_list=None,
                     )
                 )
-            is_finished = booster.update(fobj=fobj)
+            with global_timer.timed("boosting/update"):
+                is_finished = booster.update(fobj=fobj)
 
             # periodic model snapshot (reference GBDT::Train gbdt.cpp:258)
             sf = booster.config.snapshot_freq
@@ -103,12 +108,13 @@ def train(
 
             evaluation_result_list = []
             if (it + 1) % max(1, booster.config.metric_freq) == 0 or it + 1 == end_iteration:
-                if is_valid_contain_train:
-                    res = booster.eval_train(feval)
-                    evaluation_result_list.extend(
-                        [(train_data_name, n, v, hib) for (_, n, v, hib) in res]
-                    )
-                evaluation_result_list.extend(booster.eval_valid(feval))
+                with global_timer.timed("boosting/eval"):
+                    if is_valid_contain_train:
+                        res = booster.eval_train(feval)
+                        evaluation_result_list.extend(
+                            [(train_data_name, n, v, hib) for (_, n, v, hib) in res]
+                        )
+                    evaluation_result_list.extend(booster.eval_valid(feval))
             for cb in callbacks_after:
                 cb(
                     CallbackEnv(
@@ -129,6 +135,10 @@ def train(
     for item in evaluation_result_list or []:
         data_name, eval_name, val = item[0], item[1], item[2]
         booster.best_score.setdefault(data_name, {})[eval_name] = val
+    if booster.config.verbosity >= 1:
+        # per-phase wall summary (reference global_timer at shutdown,
+        # utils/common.h:979)
+        log_info(global_timer.summary())
     return booster
 
 

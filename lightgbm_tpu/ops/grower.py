@@ -26,14 +26,14 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from .histogram import leaf_histogram
-from .split import CatParams, SplitCandidate, best_split, leaf_output
+from .split import CatParams, SplitCandidate, best_split, leaf_gain, leaf_output
 
 
 @dataclasses.dataclass(frozen=True)
@@ -55,6 +55,12 @@ class GrowerParams:
     # False keeps every cat-related array at width 1 (static no-op)
     use_cat: bool = False
     cat_params: Optional[CatParams] = None
+    # forced splits (forcedsplits_filename JSON BFS,
+    # serial_tree_learner.cpp:627): the first n_forced loop steps apply the
+    # host-precomputed (leaf, feature, bin) splits instead of the best-gain
+    # argmax; a negative-gain forced split aborts the remaining forced steps
+    # (reference abort_last_forced_split) and normal growth resumes
+    n_forced: int = 0
     # "ordered": maintain a leaf-contiguous row permutation (the reference's
     # DataPartition index array, data_partition.hpp) so every per-split op —
     # partition, gather, histogram — costs O(parent segment), never O(N);
@@ -157,6 +163,7 @@ class _State(NamedTuple):
     internal_count: jnp.ndarray
     num_leaves: jnp.ndarray
     done: jnp.ndarray
+    forced_ok: jnp.ndarray  # still applying forced splits (n_forced > 0)
 
 
 def _candidate_for_leaf(
@@ -291,6 +298,7 @@ def grow_tree(
     interaction_sets: Optional[jnp.ndarray] = None,  # [S, F] bool
     rng: Optional[jax.Array] = None,  # for feature_fraction_bynode
     is_cat: Optional[jnp.ndarray] = None,  # [F] bool (use_cat)
+    forced: Optional[Tuple] = None,  # (leaf, feat, bin, is_cat) arrays [n_forced]
 ):
     """Grow one tree. Returns (TreeArrays, leaf_id[N])."""
     p = params
@@ -418,9 +426,10 @@ def grow_tree(
 
         hist_branches_ordered = [_make_hist_branch_ordered(c) for c in caps]
 
-    hist0 = leaf_histogram(
-        bins, grad, hess, count_mask, B, method=p.hist_method, axis_name=p.axis_name
-    )
+    with jax.named_scope("root_histogram"):  # jax.profiler trace labels
+        hist0 = leaf_histogram(
+            bins, grad, hess, count_mask, B, method=p.hist_method, axis_name=p.axis_name
+        )
     totals = hist0[0].sum(axis=0)  # every row lands in exactly one bin of feature 0
     root_used = jnp.zeros((f,), bool)
     neg_inf_s = jnp.float32(-jnp.inf)
@@ -499,23 +508,109 @@ def grow_tree(
         internal_count=jnp.zeros((L - 1,), jnp.float32),
         num_leaves=jnp.asarray(1, jnp.int32),
         done=jnp.asarray(False),
+        forced_ok=jnp.asarray(p.n_forced > 0),
     )
 
     node_ids = jnp.arange(L - 1, dtype=jnp.int32)
+    use_forced_splits = p.n_forced > 0 and forced is not None
 
     def body(t, st: _State) -> _State:
-        best_leaf = jnp.argmax(st.cand.gain).astype(jnp.int32)
-        can_split = st.cand.gain[best_leaf] > 0.0
+        norm_leaf = jnp.argmax(st.cand.gain).astype(jnp.int32)
+
+        # ---- local candidate for this step: the per-leaf best, or — for the
+        # first n_forced steps — the host-provided forced split evaluated on
+        # the leaf's histogram (reference ForceSplits,
+        # serial_tree_learner.cpp:627 + GatherInfoForThreshold,
+        # feature_histogram.hpp:475-595)
+        if use_forced_splits:
+            f_leaf_a, f_feat_a, f_bin_a, f_iscat_a = forced
+            tf = jnp.minimum(t, p.n_forced - 1)
+            is_f_step = (t < p.n_forced) & st.forced_ok
+            f_leaf = f_leaf_a[tf]
+            f_feat = f_feat_a[tf]
+            f_bin = f_bin_a[tf]
+            f_iscat = f_iscat_a[tf]
+            hrow = st.hist_buf[f_leaf, f_feat]  # [B, 3]
+            nbv = nan_bins[f_feat]
+            has_nb = nbv >= 0
+            nan_s = jnp.where(has_nb, hrow[jnp.maximum(nbv, 0)], 0.0)
+            brow_ids = jnp.arange(B, dtype=jnp.int32)
+            hrow_o = jnp.where(
+                ((brow_ids == nbv) & has_nb)[:, None], 0.0, hrow
+            )
+            cumr = jnp.cumsum(hrow_o, axis=0)
+            fpg, fph, fpc = (
+                st.leaf_g[f_leaf],
+                st.leaf_h[f_leaf],
+                st.leaf_cnt[f_leaf],
+            )
+            # numeric: missing goes LEFT (GatherInfoForThresholdNumerical
+            # sets default_left=true); categorical: one-hot on the bin
+            f_left = jnp.where(f_iscat, hrow[f_bin], cumr[f_bin] + nan_s)
+            f_lg, f_lh, f_lc = f_left[0], f_left[1], f_left[2]
+            f_rg, f_rh, f_rc = fpg - f_lg, fph - f_lh, fpc - f_lc
+            f_raw = leaf_gain(f_lg, f_lh, p.lambda_l1, p.lambda_l2) + leaf_gain(
+                f_rg, f_rh, p.lambda_l1, p.lambda_l2
+            )
+            f_gain = (
+                f_raw
+                - leaf_gain(fpg, fph, p.lambda_l1, p.lambda_l2)
+                - p.min_gain_to_split
+            )
+            use_forced = is_f_step & (f_gain > 0)
+            # a failed forced split aborts the REMAINING forced steps
+            # (abort_last_forced_split) and normal growth resumes
+            forced_ok_next = st.forced_ok & (~is_f_step | use_forced)
+            best_leaf = jnp.where(use_forced, f_leaf, norm_leaf)
+        else:
+            use_forced = None
+            forced_ok_next = st.forced_ok
+            best_leaf = norm_leaf
+
+        l = best_leaf
+        c_gain = st.cand.gain[l]
+        c_feat = st.cand.feature[l]
+        c_bin = st.cand.bin[l]
+        c_dl = st.cand.default_left[l]
+        c_cis = st.cand.is_cat[l]
+        c_cmask = st.cand.cat_mask[l]
+        c_lg, c_lh, c_lc = (
+            st.cand.left_g[l],
+            st.cand.left_h[l],
+            st.cand.left_cnt[l],
+        )
+        c_rg, c_rh, c_rc = (
+            st.cand.right_g[l],
+            st.cand.right_h[l],
+            st.cand.right_cnt[l],
+        )
+        if use_forced_splits:
+            c_gain = jnp.where(use_forced, f_gain, c_gain)
+            c_feat = jnp.where(use_forced, f_feat, c_feat)
+            c_bin = jnp.where(use_forced, f_bin, c_bin)
+            c_dl = jnp.where(use_forced, ~f_iscat, c_dl)
+            c_cis = jnp.where(use_forced, f_iscat, c_cis)
+            if use_cat:
+                oh = jnp.arange(Bm, dtype=jnp.int32) == f_bin
+                c_cmask = jnp.where(use_forced, oh, c_cmask)
+            c_lg = jnp.where(use_forced, f_lg, c_lg)
+            c_lh = jnp.where(use_forced, f_lh, c_lh)
+            c_lc = jnp.where(use_forced, f_lc, c_lc)
+            c_rg = jnp.where(use_forced, f_rg, c_rg)
+            c_rh = jnp.where(use_forced, f_rh, c_rh)
+            c_rc = jnp.where(use_forced, f_rc, c_rc)
+
+        can_split = c_gain > 0.0
         done = st.done | ~can_split
 
         def apply(st: _State) -> _State:
             l = best_leaf
             nl = (t + 1).astype(jnp.int32)
-            feat = st.cand.feature[l]
-            tbin = st.cand.bin[l]
-            dl = st.cand.default_left[l]
-            cis = st.cand.is_cat[l]
-            cmask = st.cand.cat_mask[l]
+            feat = c_feat
+            tbin = c_bin
+            dl = c_dl
+            cis = c_cis
+            cmask = c_cmask
 
             # ---- partition rows of leaf l (reference DataPartition::Split)
             if use_ordered:
@@ -559,7 +654,7 @@ def grow_tree(
 
             split_feature = st.split_feature.at[t].set(feat)
             split_bin = st.split_bin.at[t].set(tbin)
-            split_gain = st.split_gain.at[t].set(st.cand.gain[l] + p.min_gain_to_split)
+            split_gain = st.split_gain.at[t].set(c_gain + p.min_gain_to_split)
             default_left = st.default_left.at[t].set(dl)
             split_is_cat = st.split_is_cat.at[t].set(cis)
             node_cat_mask = st.node_cat_mask.at[t].set(cmask)
@@ -570,8 +665,8 @@ def grow_tree(
             internal_count = st.internal_count.at[t].set(pc)
 
             # ---- leaf bookkeeping
-            lg, lh, lc = st.cand.left_g[l], st.cand.left_h[l], st.cand.left_cnt[l]
-            rg, rh, rc = st.cand.right_g[l], st.cand.right_h[l], st.cand.right_cnt[l]
+            lg, lh, lc = c_lg, c_lh, c_lc
+            rg, rh, rc = c_rg, c_rh, c_rc
             leaf_g = st.leaf_g.at[l].set(lg).at[nl].set(rg)
             leaf_h = st.leaf_h.at[l].set(lh).at[nl].set(rh)
             leaf_cnt = st.leaf_cnt.at[l].set(lc).at[nl].set(rc)
@@ -752,12 +847,14 @@ def grow_tree(
                 internal_count=internal_count,
                 num_leaves=st.num_leaves + 1,
                 done=done,
+                forced_ok=st.forced_ok,
             )
 
         st = lax.cond(done, lambda s: s._replace(done=done), apply, st)
-        return st
+        return st._replace(forced_ok=forced_ok_next)
 
-    state = lax.fori_loop(0, L - 1, body, state)
+    with jax.named_scope("leaf_loop"):
+        state = lax.fori_loop(0, L - 1, body, state)
 
     leaf_idx = jnp.arange(L, dtype=jnp.int32)
     active = leaf_idx < state.num_leaves

@@ -1,0 +1,58 @@
+"""Logging bridge (reference: include/LightGBM/utils/log.h — Log::Info/
+Warning/Debug with a redirectable callback, LGBM_RegisterLogCallback) and the
+python-package ``register_logger`` (basic.py:160).
+
+Default output is print-to-stdout like the reference CLI; ``register_logger``
+redirects every message through a user logger object.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class _LogBridge:
+    def __init__(self) -> None:
+        self._logger: Optional[Any] = None
+        self._info_name = "info"
+        self._warning_name = "warning"
+
+    def register(self, logger: Any, info_method_name: str = "info",
+                 warning_method_name: str = "warning") -> None:
+        for name in (info_method_name, warning_method_name):
+            if not callable(getattr(logger, name, None)):
+                raise TypeError(
+                    f"logger must provide a callable {name!r} method"
+                )
+        self._logger = logger
+        self._info_name = info_method_name
+        self._warning_name = warning_method_name
+
+    def info(self, msg: str) -> None:
+        if self._logger is not None:
+            getattr(self._logger, self._info_name)(msg)
+        else:
+            print(msg)
+
+    def warning(self, msg: str) -> None:
+        if self._logger is not None:
+            getattr(self._logger, self._warning_name)(msg)
+        else:
+            print(f"[LightGBM] [Warning] {msg}")
+
+
+_bridge = _LogBridge()
+
+
+def register_logger(logger: Any, info_method_name: str = "info",
+                    warning_method_name: str = "warning") -> None:
+    """Redirect library output to ``logger`` (python-package basic.py:160)."""
+    _bridge.register(logger, info_method_name, warning_method_name)
+
+
+def log_info(msg: str) -> None:
+    _bridge.info(msg)
+
+
+def log_warning(msg: str) -> None:
+    _bridge.warning(msg)

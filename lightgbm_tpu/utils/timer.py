@@ -1,0 +1,51 @@
+"""Per-phase wall-clock accumulation (reference: FunctionTimer/global_timer,
+include/LightGBM/utils/common.h:979-1055 — scoped timers summed per label,
+summary printed at shutdown when verbosity allows).
+
+On an async accelerator runtime, phase walls measure HOST time: dispatch cost
+for jitted phases, full device time for phases that synchronize (eval pulls
+scores to host).  ``jax.named_scope`` annotations inside the grower mark the
+same phases for ``jax.profiler`` traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Iterator
+
+
+class GlobalTimer:
+    def __init__(self) -> None:
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def timed(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
+
+    def summary(self) -> str:
+        if not self.totals:
+            return "LightGBM::timer: (no phases recorded)"
+        width = max(len(k) for k in self.totals)
+        lines = ["LightGBM::timer (host wall per phase)"]
+        for name, total in sorted(
+            self.totals.items(), key=lambda kv: -kv[1]
+        ):
+            lines.append(
+                f"  {name.ljust(width)}  {total:9.3f}s  x{self.counts[name]}"
+            )
+        return "\n".join(lines)
+
+
+global_timer = GlobalTimer()

@@ -1,0 +1,115 @@
+"""Forced splits via forcedsplits_filename (reference:
+SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:627 — BFS over the
+JSON, thresholds quantized through the BinMapper, negative-gain forced splits
+aborted)."""
+
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+
+@pytest.fixture()
+def xy():
+    rng = np.random.default_rng(0)
+    n = 800
+    X = rng.normal(size=(n, 3))
+    # feature 0 dominates; an unforced tree splits it first
+    y = 3.0 * (X[:, 0] > 0) + 0.5 * (X[:, 1] > 0) + rng.normal(scale=0.1, size=n)
+    return X, y
+
+
+def _first_tree(X, y, fs_file):
+    params = {
+        "objective": "regression",
+        "num_leaves": 8,
+        "min_data_in_leaf": 5,
+        "verbosity": -1,
+        "forcedsplits_filename": fs_file,
+    }
+    return lgb.train(params, lgb.Dataset(X, y), 1).models_[0]
+
+
+def test_root_split_is_forced(xy, tmp_path):
+    X, y = xy
+    fs = tmp_path / "forced.json"
+    fs.write_text(json.dumps({"feature": 1, "threshold": 0.0}))
+    tree = _first_tree(X, y, str(fs))
+    assert tree.split_feature[0] == 1
+    # sanity: without forcing, feature 0 wins
+    tree_free = _first_tree(X, y, "")
+    assert tree_free.split_feature[0] == 0
+
+
+def test_nested_forced_splits_follow_bfs(xy, tmp_path):
+    X, y = xy
+    fs = tmp_path / "forced.json"
+    fs.write_text(
+        json.dumps(
+            {
+                "feature": 1,
+                "threshold": 0.0,
+                "left": {"feature": 2, "threshold": 0.5},
+                "right": {"feature": 2, "threshold": -0.5},
+            }
+        )
+    )
+    tree = _first_tree(X, y, str(fs))
+    # step 0: root on feature 1; steps 1/2: both children on feature 2
+    assert tree.split_feature[0] == 1
+    assert tree.split_feature[1] == 2
+    assert tree.split_feature[2] == 2
+    # node 0's children are the forced nodes (left keeps the leaf id ->
+    # becomes node 1; right leaf 1 -> node 2)
+    assert tree.left_child[0] == 1
+    assert tree.right_child[0] == 2
+
+
+def test_bad_forced_split_aborts_and_growth_continues(xy, tmp_path):
+    X, y = xy
+    Xc = X.copy()
+    Xc[:, 2] = 1.0  # constant feature: zero-gain forced split
+    fs = tmp_path / "forced.json"
+    fs.write_text(
+        json.dumps(
+            {
+                "feature": 2,
+                "threshold": 0.5,
+                "left": {"feature": 1, "threshold": 0.0},
+            }
+        )
+    )
+    tree = _first_tree(Xc, y, str(fs))
+    # the forced split failed; normal growth picked the best feature instead
+    assert tree.num_leaves > 1
+    assert tree.split_feature[0] == 0
+
+
+def test_forced_split_model_predicts_consistently(xy, tmp_path):
+    X, y = xy
+    fs = tmp_path / "forced.json"
+    fs.write_text(json.dumps({"feature": 1, "threshold": 0.0}))
+    params = {
+        "objective": "regression",
+        "num_leaves": 8,
+        "min_data_in_leaf": 5,
+        "verbosity": -1,
+        "forcedsplits_filename": str(fs),
+        "metric": "l2",
+    }
+    ev = {}
+    b = lgb.train(
+        params, lgb.Dataset(X, y), 8,
+        valid_sets=[lgb.Dataset(X, y)], valid_names=["t"],
+        callbacks=[lgb.record_evaluation(ev)],
+    )
+    pred = b.predict(X)
+    assert float(np.mean((pred - y) ** 2)) == pytest.approx(
+        ev["t"]["l2"][-1], rel=1e-3
+    )
+    for t in b.models_:
+        assert t.split_feature[0] == 1  # every tree honors the forced root

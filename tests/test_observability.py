@@ -1,0 +1,65 @@
+"""Logging redirect + per-phase timer (reference: utils/log.h:90 callback
+redirect / python register_logger basic.py:160; global_timer common.h:979)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.utils import log as _log  # noqa: E402
+
+
+class _Capture:
+    def __init__(self):
+        self.infos = []
+        self.warnings = []
+
+    def info(self, msg):
+        self.infos.append(msg)
+
+    def warning(self, msg):
+        self.warnings.append(msg)
+
+
+def test_register_logger_redirects_eval_lines():
+    cap = _Capture()
+    lgb.register_logger(cap)
+    try:
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(300, 4))
+        y = X[:, 0] + rng.normal(scale=0.1, size=300)
+        lgb.train(
+            {"objective": "regression", "verbosity": -1, "metric": "l2"},
+            lgb.Dataset(X, y),
+            3,
+            valid_sets=[lgb.Dataset(X, y)],
+            valid_names=["t"],
+            callbacks=[lgb.log_evaluation(1)],
+        )
+        assert any("l2" in m for m in cap.infos)
+    finally:
+        _log._bridge._logger = None  # restore default stdout logging
+
+
+def test_register_logger_validates():
+    with pytest.raises(TypeError):
+        lgb.register_logger(object())
+
+
+def test_global_timer_records_phases(capsys):
+    lgb.global_timer.reset()
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 4))
+    y = X[:, 0] + rng.normal(scale=0.1, size=300)
+    lgb.train(
+        {"objective": "regression", "verbosity": 1, "metric": "l2"},
+        lgb.Dataset(X, y),
+        3,
+    )
+    t = lgb.global_timer
+    assert t.totals.get("dataset/construct", 0) > 0
+    assert t.totals.get("boosting/update", 0) > 0
+    assert t.counts.get("tree/grow", 0) >= 3
+    out = capsys.readouterr().out
+    assert "LightGBM::timer" in out
