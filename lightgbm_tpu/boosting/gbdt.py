@@ -195,6 +195,7 @@ class Booster:
                     "split_is_cat": np.asarray(ta_host.split_is_cat)[:nn],
                     "cat_mask": np.asarray(ta_host.cat_mask)[:nn],
                 }
+                self._cegb_mark_used(rec["split_feature"])
             else:
                 tree = Tree.constant_tree(0.0)
                 rec = {
@@ -390,6 +391,7 @@ class Booster:
         self._max_bin_padded = _ceil_pow2(int(nb.max()) if len(nb) else 2)
         self._setup_constraints()
         self._forced = self._build_forced_splits()
+        self._setup_cegb()
         self._grower_params = self._make_grower_params()
         f_used = self._bins.shape[1]
         if self._mesh is not None:
@@ -505,6 +507,7 @@ class Booster:
                 rng if rng is not None else jax.random.PRNGKey(0),
                 self._iscat_arg,
                 self._forced,
+                *self._cegb_args(),
             )
         return grow_tree(
             self._bins,
@@ -520,6 +523,60 @@ class Booster:
             rng=rng,
             is_cat=self._is_cat,
             forced=self._forced,
+            **(
+                dict(zip(("cegb_penalty", "cegb_used"), self._cegb_args()))
+                if self._cegb_coupled is not None
+                else {}
+            ),
+        )
+
+    def _setup_cegb(self) -> None:
+        """Cost-Effective Gradient Boosting state (reference:
+        cost_effective_gradient_boosting.hpp). The coupled per-feature
+        penalty applies until a feature is first used ANYWHERE in the model
+        (is_feature_used_in_split_ persists across trees); the lazy per-row
+        penalty is not supported and warns."""
+        cfg = self.config
+        used = self.train_set.used_features
+        self._cegb_coupled = None
+        self._cegb_used = None
+        coupled = cfg.cegb_penalty_feature_coupled
+        enabled = (
+            cfg.cegb_tradeoff < 1.0
+            or cfg.cegb_penalty_split > 0.0
+            or bool(coupled)
+        )
+        if cfg.cegb_penalty_feature_lazy:
+            from ..utils.log import log_warning
+
+            log_warning(
+                "cegb_penalty_feature_lazy is not supported; ignoring"
+            )
+        if not enabled:
+            return
+        f_used = max(1, len(used))
+        arr = np.zeros(f_used, np.float64)
+        if coupled:
+            for ci, j in enumerate(used):
+                if j < len(coupled):
+                    arr[ci] = coupled[j]
+        self._cegb_coupled = arr * cfg.cegb_tradeoff
+        self._cegb_used = np.zeros(f_used, bool)
+
+    def _cegb_mark_used(self, split_features) -> None:
+        if self._cegb_used is not None and len(split_features):
+            self._cegb_used[np.asarray(split_features)] = True
+
+    def _cegb_args(self):
+        """(penalty, used) operands; concrete dummies when CEGB is off so the
+        shard_map operand structure stays fixed (statically gated inside
+        grow_tree by use_cegb)."""
+        f = self._bins.shape[1]
+        if self._cegb_coupled is None:
+            return jnp.zeros((f,), jnp.float32), jnp.zeros((f,), bool)
+        return (
+            jnp.asarray(self._cegb_coupled, jnp.float32),
+            jnp.asarray(self._cegb_used),
         )
 
     def _build_forced_splits(self):
@@ -607,6 +664,8 @@ class Booster:
             if self._has_cat
             else None,
             n_forced=0 if self._forced is None else len(self._forced[0]),
+            use_cegb=self._cegb_coupled is not None,
+            cegb_split_penalty=cfg.cegb_tradeoff * cfg.cegb_penalty_split,
         )
 
     def _fit_linear_leaves(
@@ -959,6 +1018,7 @@ class Booster:
                     "split_is_cat": np.asarray(ta_host.split_is_cat)[:nn],
                     "cat_mask": np.asarray(ta_host.cat_mask)[:nn],
                 }
+                self._cegb_mark_used(rec["split_feature"])
                 if is_linear:
                     rec["no_bin_form"] = True  # device walker can't see coeffs
                 self._bin_records.append(rec)
@@ -1297,6 +1357,9 @@ class Booster:
         ds = self.train_set
         csc = X.tocsc() if hasattr(X, "tocsc") else None
         if csc is not None and csc.shape[1] < ds.num_total_features:
+            # copy before resize: tocsc() aliases csc_matrix inputs and
+            # resize() would mutate the caller's matrix
+            csc = csc.copy()
             csc.resize(csc.shape[0], ds.num_total_features)
         cols = []
         for j in ds.used_features:
@@ -1516,6 +1579,7 @@ class Booster:
         if self.train_set is not None:
             self._setup_constraints()
             self._forced = self._build_forced_splits()
+            self._setup_cegb()
             self._grower_params = self._make_grower_params()
             if self._mesh is not None:
                 # the shard_map'd grower closed over the OLD params

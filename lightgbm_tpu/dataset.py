@@ -118,9 +118,13 @@ def _load_text_file(path: str, config: Config) -> Dict[str, Any]:
     text = p.read_text()
     lines = text.splitlines()
     skip = 1 if config.header else 0
-    first_data = next((ln for ln in lines[skip:] if ln.strip()), "")
-    toks = first_data.replace(",", " ").split()
-    if len(toks) > 1 and ":" in toks[1]:
+    # scan a few rows: a leading label-only line is legal LibSVM (all-zero
+    # sample), so one line is not enough to decide the format
+    probe = [ln for ln in lines[skip:] if ln.strip()][:20]
+    def _is_libsvm_row(ln):
+        toks = ln.replace(",", " ").split()
+        return len(toks) > 1 and ":" in toks[1]
+    if probe and any(_is_libsvm_row(ln) for ln in probe):
         return _parse_libsvm(lines[skip:], path)
     first = lines[0] if lines else ""
     delim = "\t" if "\t" in first else ("," if "," in first else None)
@@ -285,7 +289,10 @@ class Dataset:
             self.num_total_features = ref.num_total_features
             if sparse_csc is not None and sparse_csc.shape[1] < self.num_total_features:
                 # a sparse file may simply lack the highest-index features
-                # (LibSVM row widths vary); missing columns are all-zero
+                # (LibSVM row widths vary); missing columns are all-zero.
+                # copy first: tocsc() on a csc_matrix aliases the caller's
+                # object and resize() would mutate it
+                sparse_csc = sparse_csc.copy()
                 sparse_csc.resize(n, self.num_total_features)
         elif sparse_csc is not None:
             self._build_bin_mappers_sparse(sparse_csc, cat_idx)
@@ -320,7 +327,6 @@ class Dataset:
             else:
                 binned = np.zeros((n, 0), dtype=np.int32)
             self.bins = binned.astype(dtype)
-        if sparse_csc is None:
             self.raw = (
                 data
                 if (self.config.linear_tree or not self.free_raw_data)
@@ -426,7 +432,9 @@ class Dataset:
             if frac < 1.0 and len(vals) > 0:
                 keep = rng.random(len(vals)) < frac
                 vals = vals[keep]
-                total = sample_cnt
+                # the binomial draw can keep more than sample_cnt * density
+                # nonzeros; never let the implied zero count go negative
+                total = max(sample_cnt, len(vals))
             if j in cat_idx and total > len(vals):
                 # categorical zeros are a real category, not an implied bin
                 vals = np.concatenate([vals, np.zeros(total - len(vals))])

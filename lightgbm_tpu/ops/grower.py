@@ -61,6 +61,10 @@ class GrowerParams:
     # argmax; a negative-gain forced split aborts the remaining forced steps
     # (reference abort_last_forced_split) and normal growth resumes
     n_forced: int = 0
+    # CEGB (cost_effective_gradient_boosting.hpp): per-split data cost is
+    # static; the per-feature coupled penalty arrives as a runtime operand
+    use_cegb: bool = False
+    cegb_split_penalty: float = 0.0
     # "ordered": maintain a leaf-contiguous row permutation (the reference's
     # DataPartition index array, data_partition.hpp) so every per-split op —
     # partition, gather, histogram — costs O(parent segment), never O(N);
@@ -164,11 +168,13 @@ class _State(NamedTuple):
     num_leaves: jnp.ndarray
     done: jnp.ndarray
     forced_ok: jnp.ndarray  # still applying forced splits (n_forced > 0)
+    cegb_used: jnp.ndarray  # [F] bool — feature bought (use_cegb)
 
 
 def _candidate_for_leaf(
     hist, g, h, c, num_bins, nan_bins, feature_mask, p: GrowerParams,
     monotone=None, lb=None, ub=None, parent_output=0.0, is_cat=None,
+    cegb_penalty=None,
 ):
     return best_split(
         hist,
@@ -191,6 +197,8 @@ def _candidate_for_leaf(
         parent_output=parent_output,
         is_cat=is_cat if p.use_cat else None,
         cat_params=p.cat_params,
+        cegb_penalty=cegb_penalty if p.use_cegb else None,
+        cegb_split_penalty=p.cegb_split_penalty if p.use_cegb else 0.0,
     )
 
 
@@ -299,6 +307,8 @@ def grow_tree(
     rng: Optional[jax.Array] = None,  # for feature_fraction_bynode
     is_cat: Optional[jnp.ndarray] = None,  # [F] bool (use_cat)
     forced: Optional[Tuple] = None,  # (leaf, feat, bin, is_cat) arrays [n_forced]
+    cegb_penalty: Optional[jnp.ndarray] = None,  # [F] f32 (use_cegb)
+    cegb_used: Optional[jnp.ndarray] = None,  # [F] bool — already-bought features
 ):
     """Grow one tree. Returns (TreeArrays, leaf_id[N])."""
     p = params
@@ -309,6 +319,15 @@ def grow_tree(
     use_cat = p.use_cat and is_cat is not None
     Bm = B if use_cat else 1  # cat-mask width (1 = static no-op)
     is_cat_arr = is_cat if use_cat else None
+    use_cegb = p.use_cegb and cegb_penalty is not None
+
+    def _cegb_pen(used_mask):
+        # coupled penalty only until the feature is first used in the MODEL
+        # (cost_effective_gradient_boosting.hpp UpdateLeafBestSplits: buying
+        # a feature unlocks it for every later candidate, same tree included)
+        if not use_cegb:
+            return None
+        return jnp.where(used_mask, 0.0, cegb_penalty)
 
     def node_feature_mask(node_seed, used_row):
         """Per-node usable features: feature_fraction_bynode sampling
@@ -426,6 +445,11 @@ def grow_tree(
 
         hist_branches_ordered = [_make_hist_branch_ordered(c) for c in caps]
 
+    cegb_used0 = (
+        cegb_used
+        if (use_cegb and cegb_used is not None)
+        else jnp.zeros((max(f, 1),), bool)
+    )
     with jax.named_scope("root_histogram"):  # jax.profiler trace labels
         hist0 = leaf_histogram(
             bins, grad, hess, count_mask, B, method=p.hist_method, axis_name=p.axis_name
@@ -442,6 +466,7 @@ def grow_tree(
         ub=pos_inf_s if use_mono else None,
         parent_output=leaf_output(totals[0], totals[1], p.lambda_l1, p.lambda_l2, p.max_delta_step),
         is_cat=is_cat_arr,
+        cegb_penalty=_cegb_pen(cegb_used0),
     )
 
     neg_inf = jnp.full((L,), -jnp.inf, dtype=jnp.float32)
@@ -509,6 +534,7 @@ def grow_tree(
         num_leaves=jnp.asarray(1, jnp.int32),
         done=jnp.asarray(False),
         forced_ok=jnp.asarray(p.n_forced > 0),
+        cegb_used=cegb_used0,
     )
 
     node_ids = jnp.arange(L - 1, dtype=jnp.int32)
@@ -785,6 +811,10 @@ def grow_tree(
             else:
                 used_l = used_r = root_used
 
+            cegb_used_new = (
+                st.cegb_used.at[feat].set(True) if use_cegb else st.cegb_used
+            )
+
             # ---- refresh split candidates for the two children
             cand_l = _candidate_for_leaf(
                 left_hist, lg, lh, lc, num_bins, nan_bins,
@@ -794,6 +824,7 @@ def grow_tree(
                 ub=ub_l if use_mono else None,
                 parent_output=leaf_output(lg, lh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
                 is_cat=is_cat_arr,
+                cegb_penalty=_cegb_pen(cegb_used_new),
             )
             cand_r = _candidate_for_leaf(
                 right_hist, rg, rh, rc, num_bins, nan_bins,
@@ -803,6 +834,7 @@ def grow_tree(
                 ub=ub_r if use_mono else None,
                 parent_output=leaf_output(rg, rh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
                 is_cat=is_cat_arr,
+                cegb_penalty=_cegb_pen(cegb_used_new),
             )
             depth_ok = (p.max_depth <= 0) | (d_new < p.max_depth)
             cand = _set_cand(
@@ -848,6 +880,7 @@ def grow_tree(
                 num_leaves=st.num_leaves + 1,
                 done=done,
                 forced_ok=st.forced_ok,
+                cegb_used=cegb_used_new,
             )
 
         st = lax.cond(done, lambda s: s._replace(done=done), apply, st)

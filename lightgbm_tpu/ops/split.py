@@ -127,7 +127,13 @@ def best_split(
     parent_output=0.0,  # current output of the leaf (path smoothing)
     is_cat: Optional[jnp.ndarray] = None,  # [F] bool — categorical features
     cat_params: Optional[CatParams] = None,  # static; required with is_cat
+    cegb_penalty: Optional[jnp.ndarray] = None,  # [F] f32 per-feature penalty
+    cegb_split_penalty: float = 0.0,  # tradeoff * cegb_penalty_split
 ) -> SplitCandidate:
+    """cegb_*: Cost-Effective Gradient Boosting (reference:
+    cost_effective_gradient_boosting.hpp DeltaGain — gain is reduced by
+    tradeoff*penalty_split*num_data plus a per-feature penalty, here the
+    coupled penalty for features not yet used anywhere in the model)."""
     f, b, _ = hist.shape
     use_full_gain = monotone is not None or path_smooth > 0.0
     use_cat = is_cat is not None
@@ -275,6 +281,11 @@ def best_split(
         cases += [gain_oh, gain_fwd, gain_bwd]
 
     gains = jnp.stack(cases)  # [C, F, B]
+    if cegb_penalty is not None:
+        # per-feature penalty shifts which candidate wins (DeltaGain's
+        # coupled term); applied in improvement units so the parent-gain
+        # subtraction below stays correct
+        gains = gains - cegb_penalty[None, :, None]
     flat = jnp.argmax(gains)
     case = (flat // (f * b)).astype(jnp.int32)
     dl = (case == 1).astype(jnp.int32)
@@ -318,6 +329,9 @@ def best_split(
             ),
         )
     improvement = best_gain_raw - parent_gain - min_gain_to_split
+    if cegb_split_penalty:
+        # uniform per-split data cost: tradeoff * penalty_split * num_data
+        improvement = improvement - cegb_split_penalty * parent[2]
     improvement = jnp.where(jnp.isfinite(best_gain_raw), improvement, -jnp.inf)
 
     return SplitCandidate(

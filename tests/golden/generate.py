@@ -1,0 +1,88 @@
+"""Generate golden parity data from the reference LightGBM CLI.
+
+Run ONCE in an environment with the reference built (see
+tests/test_consistency.py docstring):
+
+    python tests/golden/generate.py /path/to/lightgbm-cli
+
+For each of the four reference examples this trains with the example's
+train.conf, records the eval trajectory, the trained model file, and the
+model's predictions on the example's test set. Tests then compare our
+training/eval/prediction against these WITHOUT needing the reference binary.
+"""
+
+import json
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+EXAMPLES = {
+    "regression": "regression",
+    "binary_classification": "binary",
+    "lambdarank": "rank",
+    "multiclass_classification": "multiclass",
+}
+REF_EXAMPLES = Path("/root/reference/examples")
+OUT = Path(__file__).parent
+
+
+def run_example(cli: str, name: str, stem: str) -> None:
+    src = REF_EXAMPLES / name
+    with tempfile.TemporaryDirectory() as td:
+        work = Path(td)
+        for f in src.iterdir():
+            if f.is_file():
+                shutil.copy(f, work / f.name)
+        # train
+        p = subprocess.run(
+            [cli, "config=train.conf"], cwd=work, capture_output=True, text=True
+        )
+        log = p.stdout + p.stderr
+        if p.returncode != 0:
+            raise RuntimeError(f"{name}: train failed\n{log}")
+        # eval trajectory lines look like:
+        # [LightGBM] [Info] Iteration:N, training <metric> : <value>
+        evals = {}
+        for m in re.finditer(
+            r"Iteration:(\d+), (\S+) (\S+) : ([-\d.eE]+)", log
+        ):
+            it, dsname, metric, val = m.groups()
+            evals.setdefault(f"{dsname}:{metric}", []).append(
+                [int(it), float(val)]
+            )
+        model_file = work / "LightGBM_model.txt"
+        model_text = model_file.read_text()
+        # predict on the example's test file
+        pred_conf = work / "golden_predict.conf"
+        pred_conf.write_text(
+            f"task = predict\ndata = {stem}.test\n"
+            "input_model = LightGBM_model.txt\n"
+            "output_result = golden_preds.txt\n"
+        )
+        p2 = subprocess.run(
+            [cli, "config=golden_predict.conf"],
+            cwd=work,
+            capture_output=True,
+            text=True,
+        )
+        if p2.returncode != 0:
+            raise RuntimeError(f"{name}: predict failed\n{p2.stdout}{p2.stderr}")
+        preds = (work / "golden_preds.txt").read_text()
+    (OUT / f"{name}.model.txt").write_text(model_text)
+    (OUT / f"{name}.preds.txt").write_text(preds)
+    (OUT / f"{name}.evals.json").write_text(json.dumps(evals, indent=1))
+    final = {k: v[-1] for k, v in evals.items()}
+    print(f"{name}: {final}")
+
+
+def main() -> None:
+    cli = sys.argv[1] if len(sys.argv) > 1 else "/tmp/ref_build/lightgbm"
+    for name, stem in EXAMPLES.items():
+        run_example(cli, name, stem)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Cost-Effective Gradient Boosting (reference:
+cost_effective_gradient_boosting.hpp — DeltaGain's split and coupled
+penalties; the coupled penalty applies until a feature is first used
+anywhere in the model)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+
+@pytest.fixture()
+def xy():
+    rng = np.random.default_rng(0)
+    n = 1000
+    X = rng.normal(size=(n, 2))
+    # feature 0 slightly stronger than feature 1 (correlated targets)
+    sig = X[:, 0] * 1.0 + X[:, 1] * 0.9
+    y = sig + rng.normal(scale=0.1, size=n)
+    return X, y
+
+
+def test_coupled_penalty_steers_feature_choice(xy):
+    X, y = xy
+    base = {"objective": "regression", "num_leaves": 4, "verbosity": -1}
+    free = lgb.train(base, lgb.Dataset(X, y), 1)
+    assert free.models_[0].split_feature[0] == 0
+    # a big acquisition cost on feature 0 makes feature 1 win the root
+    pen = lgb.train(
+        {**base, "cegb_tradeoff": 1.0,
+         "cegb_penalty_feature_coupled": [1e6, 0.0]},
+        lgb.Dataset(X, y),
+        1,
+    )
+    assert pen.models_[0].split_feature[0] == 1
+
+
+def test_huge_coupled_penalty_blocks_feature_entirely(xy):
+    X, y = xy
+    b = lgb.train(
+        {
+            "objective": "regression",
+            "num_leaves": 8,
+            "verbosity": -1,
+            "cegb_tradeoff": 1.0,
+            "cegb_penalty_feature_coupled": [1e9, 0.0],
+        },
+        lgb.Dataset(X, y),
+        8,
+    )
+    feats = {int(f) for t in b.models_ for f in t.split_feature[: t.num_leaves - 1]}
+    assert feats == {1}
+
+
+def test_coupled_penalty_paid_once_unlocks_feature():
+    """Once a feature is bought its later splits are free — same tree
+    included (reference UpdateLeafBestSplits unlocks cached candidates).
+    Single feature, penalty below the root gain but above deep-node gains:
+    the tree must still grow past the root."""
+    rng = np.random.default_rng(1)
+    n = 2000
+    X = rng.normal(size=(n, 1))
+    y = np.sign(X[:, 0]) * 2.0 + 0.3 * X[:, 0] + rng.normal(scale=0.1, size=n)
+    base = {
+        "objective": "regression",
+        "num_leaves": 16,
+        "min_data_in_leaf": 5,
+        "verbosity": -1,
+    }
+    free = lgb.train(base, lgb.Dataset(X, y), 1)
+    # root gain ~ n * var_reduction (thousands); deep gains are far smaller
+    pen = lgb.train(
+        {**base, "cegb_tradeoff": 1.0,
+         "cegb_penalty_feature_coupled": [500.0]},
+        lgb.Dataset(X, y),
+        1,
+    )
+    assert free.models_[0].num_leaves > 2
+    assert pen.models_[0].num_leaves == free.models_[0].num_leaves
+
+
+def test_split_penalty_prunes_growth(xy):
+    X, y = xy
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+            "min_data_in_leaf": 5}
+    free = lgb.train(base, lgb.Dataset(X, y), 1)
+    pen = lgb.train(
+        {**base, "cegb_tradeoff": 1.0, "cegb_penalty_split": 0.5},
+        lgb.Dataset(X, y),
+        1,
+    )
+    assert pen.models_[0].num_leaves < free.models_[0].num_leaves
