@@ -78,7 +78,11 @@ def main() -> None:
                 "value": round(iters_per_sec, 4),
                 "unit": "iters/sec",
                 "vs_baseline": round(iters_per_sec / baseline, 4),
+                "rows": n_rows,
+                "baseline_rows": 10_500_000,
+                "note": "vs_baseline divides by the reference CPU's 3.8 iters/s on 10.5M rows (BASELINE.md); this run uses 'rows' rows, so per-row throughput differs by rows/baseline_rows",
                 "preds_per_sec": round(preds_per_sec),
+                "pred_rows": pred_rows,
                 "preds_vs_fork_84k": round(preds_per_sec / 84000.0, 2),
             }
         )

@@ -183,6 +183,8 @@ class Booster:
                     self.train_set.bin_mappers,
                     self.train_set.used_features,
                 )
+                if self.config.verbosity >= 2:
+                    tree.validate()  # debug CHECK paths (tree.py)
                 tree.apply_shrinkage(pend["rate"])
                 nn = int(ta_host.num_leaves) - 1
                 rec = {
@@ -960,6 +962,8 @@ class Booster:
                     self.train_set.bin_mappers,
                     self.train_set.used_features,
                 )
+                if cfg.verbosity >= 2:
+                    tree.validate()  # debug CHECK paths (tree.py)
                 is_linear = bool(cfg.linear_tree)
                 if is_linear:
                     self._fit_linear_leaves(
@@ -1146,13 +1150,26 @@ class Booster:
     # ================================================================== eval
     def _eval_entry(self, entry: _EvalEntry, feval=None) -> List[Tuple[str, str, float, bool]]:
         dev_score = self._score if entry is self._train_entry else entry.score
-        score = np.asarray(dev_score, dtype=np.float64)
-        # drop mesh padding rows so metrics see the real dataset width
-        score = score[:, : entry.dataset.num_data]
+        n_real = entry.dataset.num_data
         out = []
+        score = None  # host copy, pulled only if some metric needs it
+        dev_sliced = None
         for m in entry.metrics:
-            for name, val in m.eval(score, self.objective):
+            res = None
+            if feval is None and hasattr(m, "eval_device"):
+                # device-side metric: only the result scalar crosses to host
+                # (the [K, N] score pull dominates eval at 10M+ rows)
+                if dev_sliced is None:
+                    dev_sliced = dev_score[:, :n_real]
+                res = m.eval_device(dev_sliced, self.objective)
+            if res is None:
+                if score is None:
+                    score = np.asarray(dev_score, dtype=np.float64)[:, :n_real]
+                res = m.eval(score, self.objective)
+            for name, val in res:
                 out.append((entry.name, name, val, m.is_higher_better))
+        if score is None and feval is not None:
+            score = np.asarray(dev_score, dtype=np.float64)[:, :n_real]
         if feval is not None:
             fevals = feval if isinstance(feval, (list, tuple)) else [feval]
             # feval receives transformed predictions, matching the reference

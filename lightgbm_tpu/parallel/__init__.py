@@ -177,8 +177,13 @@ def init_distributed(
     process_id: Optional[int] = None,
 ) -> None:
     """Multi-host initialization (the reference's machine-list / MPI init,
-    src/network/linkers_socket.cpp:25 / linkers_mpi.cpp) via jax.distributed."""
-    kwargs = {}
+    src/network/linkers_socket.cpp:25 / linkers_mpi.cpp) via jax.distributed.
+
+    Defaults come from the launcher's env vars when present
+    (``python -m lightgbm_tpu.parallel.launcher -n N script.py``)."""
+    from .launcher import env_distributed_config
+
+    kwargs = env_distributed_config() or {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
     if num_processes is not None:

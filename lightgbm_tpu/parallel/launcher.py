@@ -1,0 +1,90 @@
+"""Multi-process launcher for distributed training.
+
+Reference analog: the machine-list/MPI launch story (reference
+docs/Parallel-Learning-Guide.rst: run the same CLI on every machine with
+``machine_list_file``; or mpirun) and the Dask interface
+(python-package/lightgbm/dask.py) as the cluster front-end.
+
+The JAX-native equivalent is a multi-controller run: the SAME program runs in
+every process, ``jax.distributed.initialize`` forms the cluster, and meshes
+span all processes' devices. This module provides
+
+  * env-driven ``init_distributed()`` defaults (set by the launcher):
+    LGBM_TPU_COORDINATOR, LGBM_TPU_NUM_PROCESSES, LGBM_TPU_PROCESS_ID;
+  * ``python -m lightgbm_tpu.parallel.launcher -n N script.py [args...]`` —
+    spawns N copies of ``script.py`` on this host with those env vars set
+    (the single-host analog of running the CLI on N machines; on a real pod
+    each host runs one process and the coordinator address is shared).
+
+Single-host TPU training does NOT need any of this: a Mesh over the local
+chips (tree_learner=data) already scales there. Multi-host data feeding —
+each process holding only its local rows — is the remaining integration
+(jax.make_array_from_process_local_data); until then multi-process runs
+replicate the dataset per process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+ENV_COORD = "LGBM_TPU_COORDINATOR"
+ENV_NPROC = "LGBM_TPU_NUM_PROCESSES"
+ENV_PID = "LGBM_TPU_PROCESS_ID"
+
+
+def env_distributed_config() -> Optional[dict]:
+    """Read the launcher's env vars; None when not under the launcher."""
+    if ENV_COORD not in os.environ:
+        return None
+    return {
+        "coordinator_address": os.environ[ENV_COORD],
+        "num_processes": int(os.environ.get(ENV_NPROC, "1")),
+        "process_id": int(os.environ.get(ENV_PID, "0")),
+    }
+
+
+def launch(
+    num_processes: int,
+    argv: List[str],
+    coordinator_port: int = 9462,
+    extra_env: Optional[dict] = None,
+) -> int:
+    """Spawn ``num_processes`` copies of ``python argv...`` with the
+    coordination env set; returns the first nonzero exit code (0 if all ok)."""
+    procs = []
+    for pid in range(num_processes):
+        env = dict(os.environ)
+        env[ENV_COORD] = f"localhost:{coordinator_port}"
+        env[ENV_NPROC] = str(num_processes)
+        env[ENV_PID] = str(pid)
+        env.update(extra_env or {})
+        procs.append(
+            subprocess.Popen([sys.executable] + argv, env=env)
+        )
+    rc = 0
+    for p in procs:
+        p.wait()
+        if p.returncode and not rc:
+            rc = p.returncode
+    return rc
+
+
+def main(args=None) -> None:
+    ap = argparse.ArgumentParser(
+        description="Run script.py in N coordinated processes"
+    )
+    ap.add_argument("-n", "--num-processes", type=int, required=True)
+    ap.add_argument("--port", type=int, default=9462)
+    ap.add_argument("script", nargs=argparse.REMAINDER)
+    ns = ap.parse_args(args)
+    if not ns.script:
+        ap.error("script.py [args...] required")
+    raise SystemExit(launch(ns.num_processes, ns.script, ns.port))
+
+
+if __name__ == "__main__":
+    main()

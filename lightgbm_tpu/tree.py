@@ -168,6 +168,43 @@ class Tree:
             cat_threshold=np.asarray(cat_threshold, dtype=np.uint32) if num_cat else None,
         )
 
+    # ------------------------------------------------------------- validate
+    def validate(self) -> None:
+        """Structural invariants (reference CHECK paths, e.g.
+        Tree::Split CHECKs under DEBUG, src/io/tree.cpp / the learner's
+        CheckSplit). Raises AssertionError on corruption; run by the Booster
+        at verbosity >= 2."""
+        n = self.num_leaves
+        nn = n - 1
+        if n <= 1:
+            return
+        assert len(self.left_child) >= nn and len(self.right_child) >= nn
+        seen_leaves = set()
+        seen_nodes = set()
+        stack = [0]
+        while stack:
+            node = stack.pop()
+            assert 0 <= node < nn, f"node {node} out of range [0, {nn})"
+            assert node not in seen_nodes, f"node {node} visited twice (cycle)"
+            seen_nodes.add(node)
+            for child in (int(self.left_child[node]), int(self.right_child[node])):
+                if child < 0:
+                    leaf = ~child
+                    assert 0 <= leaf < n, f"leaf {leaf} out of range [0, {n})"
+                    assert leaf not in seen_leaves, f"leaf {leaf} reached twice"
+                    seen_leaves.add(leaf)
+                else:
+                    stack.append(child)
+        assert len(seen_leaves) == n, (
+            f"tree reaches {len(seen_leaves)} leaves, expected {n}"
+        )
+        assert len(seen_nodes) == nn, (
+            f"tree reaches {len(seen_nodes)} internal nodes, expected {nn}"
+        )
+        assert np.isfinite(self.leaf_value[:n]).all(), "non-finite leaf value"
+        assert np.isfinite(self.threshold[:nn]).all(), "non-finite threshold"
+        assert (np.asarray(self.split_feature[:nn]) >= 0).all()
+
     # ---------------------------------------------------------------- mutate
     def apply_shrinkage(self, rate: float) -> None:
         """Tree::Shrinkage (include/LightGBM/tree.h:197)."""

@@ -1,0 +1,87 @@
+"""Device-side metric evaluation matches the host (NumPy) path.
+
+The booster prefers Metric.eval_device (score stays in HBM; only the scalar
+crosses) and falls back to the host path per metric — VERDICT weak #4."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.metrics import create_metric  # noqa: E402
+
+
+@pytest.mark.parametrize(
+    "name,label_kind",
+    [
+        ("l2", "reg"),
+        ("rmse", "reg"),
+        ("l1", "reg"),
+        ("quantile", "reg"),
+        ("huber", "reg"),
+        ("fair", "reg"),
+        ("mape", "reg"),
+        ("binary_logloss", "binary"),
+        ("binary_error", "binary"),
+        ("auc", "binary"),
+    ],
+)
+@pytest.mark.parametrize("weighted", [False, True])
+def test_device_matches_host(name, label_kind, weighted):
+    import zlib
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    n = 3000
+    score = rng.normal(size=(1, n))
+    if label_kind == "binary":
+        label = (rng.random(n) < 0.4).astype(np.float64)
+    else:
+        label = rng.normal(size=n) + 1.5
+    weight = rng.random(n) + 0.5 if weighted else None
+    cfg = Config.from_params({})
+    m = create_metric(name, cfg)
+    m.init(label, weight, None)
+
+    class _Obj:  # identity for reg; sigmoid for binary-prob metrics
+        name = "binary" if label_kind == "binary" else "regression"
+
+        def convert_output(self, raw):
+            if label_kind == "binary":
+                return 1.0 / (1.0 + jnp.exp(-raw))
+            return raw
+
+    obj = _Obj()
+    host = dict(m.eval(np.asarray(score), obj))
+    dev = dict(m.eval_device(jnp.asarray(score, jnp.float32), obj))
+    for k in host:
+        assert host[k] == pytest.approx(dev[k], rel=2e-4, abs=1e-5), (
+            k, host[k], dev[k],
+        )
+
+
+def test_multi_logloss_device_matches_host():
+    rng = np.random.default_rng(0)
+    n, k = 2000, 4
+    X = rng.normal(size=(n, 5))
+    y = rng.integers(0, k, size=n)
+    ev = {}
+    b = lgb.train(
+        {
+            "objective": "multiclass",
+            "num_class": k,
+            "verbosity": -1,
+            "metric": "multi_logloss",
+            "num_leaves": 7,
+        },
+        lgb.Dataset(X, y),
+        3,
+        valid_sets=[lgb.Dataset(X, y)],
+        valid_names=["t"],
+        callbacks=[lgb.record_evaluation(ev)],
+    )
+    # cross-check the recorded (device-path) value against host recompute
+    probs = b.predict(X)
+    want = float(-np.log(np.clip(probs[np.arange(n), y], 1e-15, None)).mean())
+    assert ev["t"]["multi_logloss"][-1] == pytest.approx(want, rel=1e-3)

@@ -1,0 +1,74 @@
+"""Multi-process launcher: 2 coordinated CPU processes form a cluster and a
+psum spans both (the reference's machine-list TCP Allreduce as
+jax.distributed + collectives)."""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytest.importorskip("jax")
+
+
+REPO_ROOT = str(__import__("pathlib").Path(__file__).resolve().parents[1])
+
+WORKER_TMPL = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, "__REPO__")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    from lightgbm_tpu.parallel import init_distributed
+
+    init_distributed()
+    assert jax.process_count() == 2, jax.process_count()
+    nloc = jax.local_device_count()
+    assert jax.device_count() == 2 * nloc
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import numpy as np
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    # every process contributes its local shard; the psum spans processes
+    local = np.full((nloc,), float(jax.process_index() + 1), np.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), local
+    )
+    total = jax.jit(
+        jax.shard_map(
+            lambda x: jax.lax.psum(x, "data"),
+            mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+        )
+    )(arr)
+    got = float(np.asarray(jax.device_get(total.addressable_shards[0].data))[0])
+    want = float(nloc * 1 + nloc * 2)  # both processes' shards summed
+    assert got == want, (got, want)
+    print(f"proc {jax.process_index()} ok")
+    """
+)
+
+
+def test_two_process_psum(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_TMPL.replace("__REPO__", REPO_ROOT))
+    r = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "lightgbm_tpu.parallel.launcher",
+            "-n",
+            "2",
+            "--port",
+            "29517",
+            str(script),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=220,
+        cwd=REPO_ROOT,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
