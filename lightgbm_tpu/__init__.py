@@ -23,6 +23,12 @@ from .callback import (
 from .config import Config
 from .dataset import Dataset
 from .engine import CVBooster, cv, train
+from .plotting import (
+    create_tree_digraph,
+    plot_importance,
+    plot_metric,
+    plot_tree,
+)
 from .utils.log import register_logger
 from .utils.timer import global_timer
 
@@ -47,6 +53,10 @@ __all__ = [
     "EarlyStopException",
     "register_logger",
     "global_timer",
+    "plot_importance",
+    "plot_metric",
+    "plot_tree",
+    "create_tree_digraph",
     "Config",
     "LGBMModel",
     "LGBMClassifier",

@@ -226,9 +226,10 @@ class Booster:
         pend = []
         for kk in range(k):
             if self._class_need_train[kk] and self._bins.shape[1] > 0:
+                qg, qh = self._quant_grow_inputs(grad[kk], hess[kk])
                 ta, leaf_id = self._grow_one(
-                    grad[kk],
-                    hess[kk],
+                    qg,
+                    qh,
                     mask,
                     feature_mask,
                     (
@@ -237,6 +238,7 @@ class Booster:
                         else None
                     ),
                 )
+                ta = self._quant_renew(ta, leaf_id, grad[kk], hess[kk], mask)
                 shrunk = ta.leaf_value * self._shrinkage_rate
                 self._score = self._score.at[kk].add(shrunk[leaf_id])
                 for entry in self._valid:
@@ -484,6 +486,46 @@ class Booster:
             if self._is_cat is not None
             else jnp.zeros((f_used,), bool)
         )
+
+    def _quant_grow_inputs(self, grad_k, hess_k):
+        """Quantized-gradient training (GradientDiscretizer): tree growth
+        sees grid-quantized gradients; leaf values are renewed from the true
+        ones afterwards when quant_train_renew_leaf."""
+        cfg = self.config
+        if not cfg.use_quantized_grad:
+            return grad_k, hess_k
+        from ..ops.quantize import quantize_gradients
+
+        return quantize_gradients(
+            grad_k,
+            hess_k,
+            self._next_rng(),
+            num_bins=cfg.num_grad_quant_bins,
+            stochastic=cfg.stochastic_rounding,
+            constant_hessian=bool(
+                self.objective is not None and self.objective.is_constant_hessian
+            ),
+        )
+
+    def _quant_renew(self, ta, leaf_id, grad_k, hess_k, mask):
+        """RenewIntGradTreeOutput (gradient_discretizer.cpp:209) on device."""
+        cfg = self.config
+        if not (cfg.use_quantized_grad and cfg.quant_train_renew_leaf):
+            return ta
+        from ..ops.quantize import renew_leaf_values
+
+        lv = renew_leaf_values(
+            leaf_id,
+            grad_k,
+            hess_k,
+            mask,
+            ta.num_leaves,
+            self._grower_params.num_leaves,
+            cfg.lambda_l1,
+            cfg.lambda_l2,
+            cfg.max_delta_step,
+        )
+        return ta._replace(leaf_value=lv)
 
     def _grow_one(self, grad_k, hess_k, mask, feature_mask, rng):
         """Grow one tree: serial grow_tree or the mesh-sharded shard_map path
@@ -926,9 +968,10 @@ class Booster:
         for kk in range(k):
             tree_idx = len(self.models_)
             if self._class_need_train[kk] and self._bins.shape[1] > 0:
+                qg, qh = self._quant_grow_inputs(grad[kk], hess[kk])
                 ta, leaf_id = self._grow_one(
-                    grad[kk],
-                    hess[kk],
+                    qg,
+                    qh,
                     mask,
                     feature_mask,
                     (
@@ -937,6 +980,7 @@ class Booster:
                         else None
                     ),
                 )
+                ta = self._quant_renew(ta, leaf_id, grad[kk], hess[kk], mask)
                 # two bulk transfers instead of ~14 small ones (remote TPU
                 # round-trips dominate otherwise)
                 ta_host = fetch_tree_arrays(ta)
@@ -1586,6 +1630,123 @@ class Booster:
 
     def feature_name(self) -> List[str]:
         return list(self.feature_names)
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """Reference: Booster.get_leaf_output (basic.py:4913)."""
+        return float(self.models_[tree_id].leaf_value[leaf_id])
+
+    def set_leaf_output(self, tree_id: int, leaf_id: int, value: float) -> "Booster":
+        """Reference: Booster.set_leaf_output (LGBM_BoosterSetLeafValue)."""
+        self.models_[tree_id].leaf_value[leaf_id] = value
+        if tree_id < len(self._bin_records):  # loaded models keep no records
+            rec = self._bin_records[tree_id]
+            if rec is not None and len(rec.get("leaf_value", ())) > leaf_id:
+                rec["leaf_value"][leaf_id] = value
+        self._bump_model_version()
+        return self
+
+    def lower_bound(self) -> float:
+        """Minimum possible model output (reference: Booster.lower_bound ->
+        GBDT::GetLowerBoundValue, sum of per-tree minimum leaves)."""
+        return float(
+            sum(float(np.min(t.leaf_value[: t.num_leaves])) for t in self.models_)
+        )
+
+    def upper_bound(self) -> float:
+        """Maximum possible model output (GBDT::GetUpperBoundValue)."""
+        return float(
+            sum(float(np.max(t.leaf_value[: t.num_leaves])) for t in self.models_)
+        )
+
+    def trees_to_dataframe(self):
+        """Per-node model table (reference: Booster.trees_to_dataframe,
+        basic.py:4060 — same column set and node naming S/L scheme)."""
+        import pandas as pd  # type: ignore
+
+        rows = []
+        for ti, tree in enumerate(self.models_):
+            n = tree.num_leaves
+            feat_names = self.feature_names
+
+            def node_name(idx, is_leaf):
+                return f"{ti}-L{idx}" if is_leaf else f"{ti}-S{idx}"
+
+            def emit(node, depth, parent):
+                if node < 0:
+                    leaf = ~node
+                    rows.append(
+                        {
+                            "tree_index": ti,
+                            "node_depth": depth,
+                            "node_index": node_name(leaf, True),
+                            "left_child": None,
+                            "right_child": None,
+                            "parent_index": parent,
+                            "split_feature": None,
+                            "split_gain": None,
+                            "threshold": None,
+                            "decision_type": None,
+                            "value": float(tree.leaf_value[leaf]),
+                            "weight": float(tree.leaf_weight[leaf])
+                            if len(tree.leaf_weight) > leaf
+                            else None,
+                            "count": int(tree.leaf_count[leaf])
+                            if len(tree.leaf_count) > leaf
+                            else None,
+                        }
+                    )
+                    return ()
+                fidx = int(tree.split_feature[node])
+                is_cat = bool(tree.decision_type[node] & 1)
+                rows.append(
+                    {
+                        "tree_index": ti,
+                        "node_depth": depth,
+                        "node_index": node_name(node, False),
+                        "left_child": node_name(
+                            ~int(tree.left_child[node])
+                            if tree.left_child[node] < 0
+                            else int(tree.left_child[node]),
+                            tree.left_child[node] < 0,
+                        ),
+                        "right_child": node_name(
+                            ~int(tree.right_child[node])
+                            if tree.right_child[node] < 0
+                            else int(tree.right_child[node]),
+                            tree.right_child[node] < 0,
+                        ),
+                        "parent_index": parent,
+                        "split_feature": feat_names[fidx]
+                        if fidx < len(feat_names)
+                        else str(fidx),
+                        "split_gain": float(tree.split_gain[node]),
+                        "threshold": float(tree.threshold[node]),
+                        "decision_type": "==" if is_cat else "<=",
+                        "value": float(tree.internal_value[node])
+                        if len(tree.internal_value) > node
+                        else None,
+                        "weight": float(tree.internal_weight[node])
+                        if len(tree.internal_weight) > node
+                        else None,
+                        "count": int(tree.internal_count[node])
+                        if len(tree.internal_count) > node
+                        else None,
+                    }
+                )
+                me = node_name(node, False)
+                # children pushed right-first so the left subtree emits first
+                return (
+                    (int(tree.right_child[node]), depth + 1, me),
+                    (int(tree.left_child[node]), depth + 1, me),
+                )
+
+            # explicit stack: leaf-wise trees can be ~num_leaves deep, past
+            # Python's recursion limit
+            stack = [(0 if n > 1 else ~0, 1, None)]
+            while stack:
+                node, depth, parent = stack.pop()
+                stack.extend(emit(node, depth, parent))
+        return pd.DataFrame(rows)
 
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
         """Reference: Booster::ResetConfig via LGBM_BoosterResetParameter."""

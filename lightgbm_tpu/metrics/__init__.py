@@ -281,6 +281,12 @@ class AUCMetric(Metric):
         n = s.shape[0]
         if n < 2:
             return None
+        # f32 cumsums drift at very large n / big weights; fall back to the
+        # exact f64 host sweep there (mirrors _PointwiseMetric._f32_ok)
+        if n > 5_000_000 or (
+            self.weight is not None and float(np.abs(self.weight).max()) > 1e3
+        ):
+            return None
         if not hasattr(self, "_label_dev"):
             self._label_dev = jnp.asarray(self.label > 0, jnp.float32)
             self._weight_dev = (

@@ -1,0 +1,100 @@
+"""Quantized-gradient training (reference: GradientDiscretizer,
+src/treelearner/gradient_discretizer.cpp).
+
+The reference discretizes gradients/hessians to int8 bins so histogram
+accumulation runs in narrow integers; split gains multiply the integer sums
+by the per-iteration scales. The TPU formulation quantizes to the SAME grid
+but keeps the values as f32 multiples of the scale — numerically identical
+sums (f32 represents the small-integer grid exactly and the histogram's
+accumulation order is unchanged) with zero changes to the grower; a narrow
+int8 Pallas accumulation can later slot in underneath as a pure optimization.
+
+Leaf outputs are renewed from the TRUE gradients after the tree is grown
+(RenewIntGradTreeOutput, gradient_discretizer.cpp:209) when
+``quant_train_renew_leaf``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .split import leaf_output
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_bins", "stochastic", "constant_hessian")
+)
+def quantize_gradients(
+    grad: jnp.ndarray,  # [N] f32
+    hess: jnp.ndarray,  # [N] f32
+    rng: jax.Array,
+    num_bins: int = 4,
+    stochastic: bool = True,
+    constant_hessian: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize (grad, hess) onto the reference's integer grid, returned as
+    f32 grid multiples (DiscretizeGradients, gradient_discretizer.cpp:70-160:
+    scales from the max |value|, truncation toward zero, optional stochastic
+    rounding)."""
+    max_g = jnp.max(jnp.abs(grad))
+    max_h = jnp.max(jnp.abs(hess))
+    g_scale = jnp.maximum(max_g / (num_bins // 2), 1e-30)
+    h_scale = jnp.maximum(
+        max_h if constant_hessian else max_h / num_bins, 1e-30
+    )
+    gi = grad / g_scale
+    hi = hess / h_scale
+    if stochastic:
+        kg, kh = jax.random.split(rng)
+        rg = jax.random.uniform(kg, grad.shape)
+        rh = jax.random.uniform(kh, hess.shape)
+    else:
+        rg = jnp.float32(0.5)
+        rh = jnp.float32(0.5)
+    # C's int8 cast truncates toward zero; rounding offset follows the sign
+    qg = jnp.trunc(jnp.where(gi >= 0, gi + rg, gi - rg))
+    qh = jnp.trunc(hi + rh)  # hessians are non-negative
+    if constant_hessian:
+        qh = jnp.ones_like(qh)
+    return qg * g_scale, qh * h_scale
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_leaves",
+        "lambda_l1",
+        "lambda_l2",
+        "max_delta_step",
+        "axis_name",
+    ),
+)
+def renew_leaf_values(
+    leaf_id: jnp.ndarray,  # [N] int32 from grow_tree
+    grad: jnp.ndarray,  # [N] TRUE (unquantized) gradients
+    hess: jnp.ndarray,
+    mask: jnp.ndarray,  # [N] in-bag mask
+    num_leaves_used: jnp.ndarray,  # scalar from TreeArrays.num_leaves
+    num_leaves: int,
+    lambda_l1: float,
+    lambda_l2: float,
+    max_delta_step: float,
+    axis_name: Optional[str] = None,
+) -> jnp.ndarray:
+    """Per-leaf outputs from true gradient sums
+    (RenewIntGradTreeOutput, gradient_discretizer.cpp:209; the data-parallel
+    branch GlobalSums the per-leaf stats — here a psum when axis_name)."""
+    sum_g = jax.ops.segment_sum(grad * mask, leaf_id, num_segments=num_leaves)
+    sum_h = jax.ops.segment_sum(hess * mask, leaf_id, num_segments=num_leaves)
+    if axis_name is not None:
+        sum_g = jax.lax.psum(sum_g, axis_name)
+        sum_h = jax.lax.psum(sum_h, axis_name)
+    out = leaf_output(sum_g, sum_h, lambda_l1, lambda_l2, max_delta_step)
+    active = jnp.arange(num_leaves) < num_leaves_used
+    return jnp.where(active & (num_leaves_used > 1), out, 0.0).astype(
+        jnp.float32
+    )

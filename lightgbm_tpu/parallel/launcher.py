@@ -65,11 +65,23 @@ def launch(
         procs.append(
             subprocess.Popen([sys.executable] + argv, env=env)
         )
+    import time
+
+    # poll instead of sequential wait: one worker dying before the
+    # coordination barrier would leave the others (and us) hung forever
     rc = 0
-    for p in procs:
-        p.wait()
-        if p.returncode and not rc:
-            rc = p.returncode
+    alive = list(procs)
+    while alive:
+        for pr in list(alive):
+            ret = pr.poll()
+            if ret is None:
+                continue
+            alive.remove(pr)
+            if ret and not rc:
+                rc = ret
+                for other in alive:  # fail fast: tear the cluster down
+                    other.terminate()
+        time.sleep(0.2)
     return rc
 
 

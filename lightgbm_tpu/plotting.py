@@ -1,0 +1,177 @@
+"""Plotting API (reference: python-package/lightgbm/plotting.py).
+
+matplotlib/graphviz are optional exactly as in the reference: functions
+import them lazily and raise ImportError with the same guidance when absent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+def _check_matplotlib():
+    try:
+        import matplotlib.pyplot as plt  # type: ignore
+
+        return plt
+    except ImportError as e:  # pragma: no cover - env-dependent
+        raise ImportError(
+            "You must install matplotlib and restart your session to plot."
+        ) from e
+
+
+def plot_importance(
+    booster,
+    ax=None,
+    height: float = 0.2,
+    xlim=None,
+    ylim=None,
+    title: Optional[str] = "Feature importance",
+    xlabel: Optional[str] = "Feature importance",
+    ylabel: Optional[str] = "Features",
+    importance_type: str = "auto",
+    max_num_features: Optional[int] = None,
+    ignore_zero: bool = True,
+    figsize=None,
+    dpi=None,
+    grid: bool = True,
+    precision: Optional[int] = 3,
+    **kwargs: Any,
+):
+    """Horizontal bar chart of feature importances (plotting.py:38)."""
+    plt = _check_matplotlib()
+    if importance_type == "auto":
+        importance_type = "split"
+    imp = booster.feature_importance(importance_type)
+    names = booster.feature_name()
+    pairs = sorted(zip(imp, names), key=lambda t: t[0])
+    if ignore_zero:
+        pairs = [p for p in pairs if p[0] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        pairs = pairs[-max_num_features:]
+    values = [p[0] for p in pairs]
+    labels = [p[1] for p in pairs]
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ylocs = range(len(values))
+    ax.barh(list(ylocs), values, height=height, **kwargs)
+    for y, v in zip(ylocs, values):
+        ax.text(
+            v + 1,
+            y,
+            f"{v:.{precision}f}" if precision is not None else str(v),
+            va="center",
+        )
+    ax.set_yticks(list(ylocs))
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    if title:
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(
+    booster,
+    metric: Optional[str] = None,
+    dataset_names=None,
+    ax=None,
+    xlim=None,
+    ylim=None,
+    title: Optional[str] = "Metric during training",
+    xlabel: Optional[str] = "Iterations",
+    ylabel: Optional[str] = "@metric@",
+    figsize=None,
+    dpi=None,
+    grid: bool = True,
+):
+    """Plot an eval history recorded by record_evaluation (plotting.py:167)."""
+    plt = _check_matplotlib()
+    if isinstance(booster, dict):
+        eval_results = booster
+    else:
+        eval_results = getattr(booster, "evals_result_", None)
+        if not eval_results:
+            raise ValueError(
+                "eval results not found; pass the dict from record_evaluation"
+            )
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    names = dataset_names or list(eval_results.keys())
+    for name in names:
+        metrics = eval_results[name]
+        m = metric or next(iter(metrics))
+        vals = metrics[m]
+        ax.plot(range(len(vals)), vals, label=name)
+    m = metric or next(iter(next(iter(eval_results.values()))))
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    if title:
+        ax.set_title(title)
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel:
+        ax.set_ylabel(ylabel.replace("@metric@", m))
+    ax.legend()
+    ax.grid(grid)
+    return ax
+
+
+def create_tree_digraph(booster, tree_index: int = 0, **kwargs: Any):
+    """Graphviz digraph of one tree (plotting.py:414)."""
+    try:
+        import graphviz  # type: ignore
+    except ImportError as e:  # pragma: no cover - env-dependent
+        raise ImportError(
+            "You must install graphviz and restart your session to plot a tree."
+        ) from e
+    tree = booster.models_[tree_index]
+    names = booster.feature_name()
+    g = graphviz.Digraph(**kwargs)
+
+    def rec(node):
+        if node < 0:
+            leaf = ~node
+            nid = f"leaf{leaf}"
+            g.node(nid, f"leaf {leaf}: {float(tree.leaf_value[leaf]):.6g}")
+            return nid
+        nid = f"split{node}"
+        f = int(tree.split_feature[node])
+        fname = names[f] if f < len(names) else str(f)
+        op = "==" if tree.decision_type[node] & 1 else "<="
+        g.node(nid, f"{fname} {op} {float(tree.threshold[node]):.6g}")
+        g.edge(nid, rec(int(tree.left_child[node])), label="yes")
+        g.edge(nid, rec(int(tree.right_child[node])), label="no")
+        return nid
+
+    rec(0 if tree.num_leaves > 1 else ~0)
+    return g
+
+
+def plot_tree(booster, tree_index: int = 0, ax=None, figsize=None, dpi=None,
+              **kwargs: Any):
+    """Render one tree via graphviz (plotting.py:560)."""
+    plt = _check_matplotlib()
+    g = create_tree_digraph(booster, tree_index, **kwargs)
+    import io
+
+    try:
+        import matplotlib.image as mpimg  # type: ignore
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("matplotlib required") from e
+    s = io.BytesIO(g.pipe(format="png"))
+    img = mpimg.imread(s)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

@@ -1,0 +1,75 @@
+"""Model inspection/plotting surface (reference: python-package
+Booster.trees_to_dataframe basic.py:4060, plotting.py)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def booster():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 4))
+    y = X[:, 0] + 0.3 * X[:, 1] + rng.normal(scale=0.2, size=400)
+    return (
+        lgb.train(
+            {"objective": "regression", "verbosity": -1, "num_leaves": 7},
+            lgb.Dataset(X, y),
+            3,
+        ),
+        X,
+        y,
+    )
+
+
+def test_trees_to_dataframe(booster):
+    b, X, y = booster
+    df = b.trees_to_dataframe()
+    # 6 split nodes + 7 leaves per full tree
+    assert (df.groupby("tree_index").size() == 13).all()
+    assert set(
+        ["tree_index", "node_index", "left_child", "right_child",
+         "split_feature", "threshold", "value", "count"]
+    ) <= set(df.columns)
+    splits = df[df.split_feature.notna()]
+    assert (splits.decision_type == "<=").all()
+    # root counts cover the dataset
+    roots = df[(df.node_depth == 1)]
+    assert (roots["count"] == 400).all()
+
+
+def test_leaf_output_and_bounds(booster):
+    b, X, y = booster
+    v = b.get_leaf_output(0, 0)
+    assert np.isfinite(v)
+    assert b.lower_bound() <= b.upper_bound()
+    b2 = lgb.Booster(model_str=b.model_to_string())
+    b2.set_leaf_output(0, 0, 99.0)
+    assert b2.get_leaf_output(0, 0) == 99.0
+    # predictions reflect the mutated leaf
+    row = X[:1]
+    leaves = b2.predict(row, pred_leaf=True)
+    if leaves[0, 0] == 0:
+        assert b2.predict(row)[0] != pytest.approx(b.predict(row)[0])
+
+
+def test_plotting(booster):
+    mpl = pytest.importorskip("matplotlib")
+    mpl.use("Agg")
+    b, X, y = booster
+    ax = lgb.plot_importance(b)
+    assert ax is not None
+    ev = {"t": {"l2": [3.0, 2.0, 1.5]}}
+    ax2 = lgb.plot_metric(ev)
+    assert ax2 is not None
+
+
+def test_tree_digraph(booster):
+    pytest.importorskip("graphviz")
+    b, _, _ = booster
+    g = lgb.create_tree_digraph(b, 0)
+    src = g.source
+    assert "leaf" in src and "Column_0" in src
