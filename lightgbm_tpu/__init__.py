@@ -23,10 +23,13 @@ from .callback import (
 from .config import Config
 from .dataset import Dataset
 from .engine import CVBooster, cv, train
+from .dask import DaskLGBMClassifier, DaskLGBMRanker, DaskLGBMRegressor
+from .dataset import Sequence
 from .plotting import (
     create_tree_digraph,
     plot_importance,
     plot_metric,
+    plot_split_value_histogram,
     plot_tree,
 )
 from .utils.log import register_logger
@@ -55,8 +58,13 @@ __all__ = [
     "global_timer",
     "plot_importance",
     "plot_metric",
+    "plot_split_value_histogram",
     "plot_tree",
     "create_tree_digraph",
+    "Sequence",
+    "DaskLGBMClassifier",
+    "DaskLGBMRegressor",
+    "DaskLGBMRanker",
     "Config",
     "LGBMModel",
     "LGBMClassifier",

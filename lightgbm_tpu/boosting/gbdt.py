@@ -1631,6 +1631,89 @@ class Booster:
     def feature_name(self) -> List[str]:
         return list(self.feature_names)
 
+    def model_from_string(self, model_str: str) -> "Booster":
+        """Load a model from text IN PLACE (reference basic.py model_from_string)."""
+        self._load_model_string(model_str)
+        return self
+
+    def shuffle_models(
+        self, start_iteration: int = 0, end_iteration: int = -1
+    ) -> "Booster":
+        """Permute ITERATION blocks in [start, end) (reference
+        GBDT::ShuffleModels, gbdt.h:89 — whole iterations move together so a
+        multiclass model's per-class tree slots stay aligned; deterministic
+        seed like the reference's Random(17))."""
+        k = self.num_tree_per_iteration
+        total_iter = len(self.models_) // k
+        i0 = max(0, start_iteration)
+        i1 = total_iter if end_iteration <= 0 else min(total_iter, end_iteration)
+        block_perm = np.arange(i0, i1)
+        np.random.default_rng(17).shuffle(block_perm)
+        perm = list(range(len(self.models_)))
+        for pos, src_it in enumerate(block_perm):
+            for kk in range(k):
+                perm[(i0 + pos) * k + kk] = src_it * k + kk
+        models = self.models_
+        recs = self._bin_records
+        self.models_ = [models[i] for i in perm]
+        if len(recs) == len(models):
+            self._bin_records = [recs[i] for i in perm]
+        self._bump_model_version()
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        """Reference basic.py set_train_data_name."""
+        self._train_data_name = name
+        return self
+
+    def set_network(
+        self, machines=None, local_listen_port: int = 12400,
+        listen_time_out: int = 120, num_machines: int = 1,
+    ) -> "Booster":
+        """Compatibility shim: the reference wires its TCP machine list here;
+        the TPU-native path forms clusters via jax.distributed
+        (parallel.init_distributed / parallel.launcher) instead."""
+        from ..utils.log import log_warning
+
+        if num_machines > 1:
+            log_warning(
+                "set_network is a no-op: use lightgbm_tpu.parallel."
+                "init_distributed / the launcher for multi-host training"
+            )
+        return self
+
+    def get_split_value_histogram(
+        self, feature, bins=None, xgboost_style: bool = False
+    ):
+        """Histogram of a feature's split thresholds across the model
+        (reference basic.py get_split_value_histogram)."""
+        if isinstance(feature, str):
+            feature = self.feature_names.index(feature)
+        values = []
+        for t in self.models_:
+            nn = t.num_leaves - 1
+            for node in range(nn):
+                if int(t.split_feature[node]) == feature and not (
+                    t.decision_type[node] & 1
+                ):
+                    values.append(float(t.threshold[node]))
+        values = np.asarray(values)
+        if bins is None:
+            bins = max(1, min(len(values), 10)) if len(values) else 1
+        hist, edges = np.histogram(values, bins=bins)
+        if xgboost_style:
+            # reference drops zero-count bins and falls back to a numpy
+            # array when pandas is unavailable (basic.py)
+            ret = np.column_stack((edges[1:], hist))
+            ret = ret[ret[:, 1] > 0]
+            try:
+                import pandas as pd  # type: ignore
+
+                return pd.DataFrame(ret, columns=["SplitValue", "Count"])
+            except ImportError:
+                return ret
+        return hist, edges
+
     def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
         """Reference: Booster.get_leaf_output (basic.py:4913)."""
         return float(self.models_[tree_id].leaf_value[leaf_id])

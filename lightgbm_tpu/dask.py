@@ -1,0 +1,35 @@
+"""Dask interface placeholder (reference: python-package/lightgbm/dask.py).
+
+dask is not installed in this environment; the TPU-native road to
+multi-machine training is a jax.distributed multi-controller run
+(``lightgbm_tpu.parallel.launcher`` / ``init_distributed``) — meshes span all
+processes' devices and the grower's psum rides ICI/DCN. These classes exist
+for API parity and raise with that guidance, mirroring the reference's
+behavior when dask is absent.
+"""
+
+from __future__ import annotations
+
+_MSG = (
+    "dask is not installed; for distributed training use "
+    "lightgbm_tpu.parallel.init_distributed (jax.distributed multi-controller) "
+    "with tree_learner='data', or the process launcher "
+    "`python -m lightgbm_tpu.parallel.launcher -n N script.py`"
+)
+
+
+class _DaskUnavailable:
+    def __init__(self, *args, **kwargs):
+        raise ImportError(_MSG)
+
+
+class DaskLGBMClassifier(_DaskUnavailable):
+    pass
+
+
+class DaskLGBMRegressor(_DaskUnavailable):
+    pass
+
+
+class DaskLGBMRanker(_DaskUnavailable):
+    pass

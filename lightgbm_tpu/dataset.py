@@ -16,7 +16,8 @@ from __future__ import annotations
 
 import dataclasses
 from pathlib import Path
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Union
+from typing import Sequence as TypingSequence
 
 import numpy as np
 
@@ -61,6 +62,31 @@ class Metadata:
                 f"sum of query sizes ({boundaries[-1]}) != num_data ({self.num_data})"
             )
         self.query_boundaries = boundaries
+
+
+class Sequence:
+    """Generic row-batched data source (reference: basic.py Sequence, the
+    out-of-core ingestion ABC). Subclasses implement __getitem__ (row or
+    slice -> numpy rows) and __len__; Dataset materializes in
+    ``batch_size`` chunks at construction."""
+
+    batch_size = 4096
+
+    def __getitem__(self, idx):  # pragma: no cover - abstract
+        raise NotImplementedError("Sub-classes of Sequence must implement __getitem__")
+
+    def __len__(self) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError("Sub-classes of Sequence must implement __len__")
+
+
+def _materialize_sequences(seqs) -> np.ndarray:
+    parts = []
+    for seq in seqs:
+        n = len(seq)
+        bs = getattr(seq, "batch_size", None) or 4096
+        for start in range(0, n, bs):
+            parts.append(np.asarray(seq[slice(start, min(start + bs, n))]))
+    return np.concatenate(parts, axis=0)
 
 
 def _parse_libsvm(lines, path: str) -> Dict[str, Any]:
@@ -165,8 +191,8 @@ class Dataset:
         weight: Optional[np.ndarray] = None,
         group: Optional[np.ndarray] = None,
         init_score: Optional[np.ndarray] = None,
-        feature_name: Union[str, Sequence[str]] = "auto",
-        categorical_feature: Union[str, Sequence] = "auto",
+        feature_name: Union[str, TypingSequence[str]] = "auto",
+        categorical_feature: Union[str, TypingSequence] = "auto",
         params: Optional[Dict[str, Any]] = None,
         free_raw_data: bool = True,
         position: Optional[np.ndarray] = None,
@@ -239,6 +265,12 @@ class Dataset:
                 self._weight = loaded.get("weight")
             if self._init_score is None:
                 self._init_score = loaded.get("init_score")
+        if isinstance(data, Sequence):
+            data = _materialize_sequences([data])
+        elif isinstance(data, list) and data and all(
+            isinstance(d, Sequence) for d in data
+        ):
+            data = _materialize_sequences(data)
         if pd is not None and isinstance(data, pd.DataFrame):
             if self._feature_name == "auto":
                 self._feature_name = [str(c) for c in data.columns]
@@ -478,6 +510,121 @@ class Dataset:
             self.metadata.position = position
         else:
             self._position = position
+        return self
+
+    def get_data(self):
+        """Raw data if retained (reference basic.py get_data; requires
+        free_raw_data=False)."""
+        self.construct()
+        if self.raw is None:
+            raise ValueError(
+                "raw data was freed; construct the Dataset with "
+                "free_raw_data=False to keep it"
+            )
+        return self.raw
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self.feature_names)
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        if feature_name is None or (
+            isinstance(feature_name, str) and feature_name == "auto"
+        ):
+            return self
+        names = [str(s) for s in feature_name]
+        if self._constructed:
+            if len(names) != self.num_total_features:
+                raise ValueError(
+                    f"{len(names)} feature names for "
+                    f"{self.num_total_features} features"
+                )
+            self.feature_names = names
+        else:
+            self._feature_name = names
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        if self._constructed:
+            raise ValueError(
+                "cannot change categorical_feature after construction; "
+                "create a new Dataset"
+            )
+        self._categorical_feature = categorical_feature
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        if self._constructed:
+            raise ValueError(
+                "cannot change reference after construction; create a new Dataset"
+            )
+        self.reference = reference
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """Set of datasets reachable via reference links (basic.py)."""
+        head = self
+        chain = set()
+        while head is not None and len(chain) < ref_limit:
+            if head in chain:
+                break
+            chain.add(head)
+            head = head.reference
+        return chain
+
+    def feature_num_bin(self, feature) -> int:
+        """Number of bins for a feature (reference LGBM_DatasetGetFeatureNumBin)."""
+        self.construct()
+        if isinstance(feature, str):
+            feature = self.feature_names.index(feature)
+        return int(self.bin_mappers[feature].num_bins)
+
+    def get_position(self):
+        self.construct()
+        return self.metadata.position
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Column-concatenate another dataset's features (reference
+        LGBM_DatasetAddFeaturesFrom). Both must be constructed and have the
+        same row count."""
+        self.construct()
+        other.construct()
+        if self.num_data != other.num_data:
+            raise ValueError("datasets must have the same number of rows")
+        base_f = self.num_total_features
+        self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
+        self.used_features = list(self.used_features) + [
+            base_f + j for j in other.used_features
+        ]
+        self.bins = np.concatenate(
+            [
+                self.bins.astype(np.uint16),
+                other.bins.astype(np.uint16),
+            ],
+            axis=1,
+        )
+        if self.bins.max(initial=0) < 256:
+            self.bins = self.bins.astype(np.uint8)
+        self.feature_names = list(self.feature_names) + list(other.feature_names)
+        self.num_total_features = base_f + other.num_total_features
+        if self.raw is not None and other.raw is not None:
+            if hasattr(self.raw, "toarray") or hasattr(other.raw, "toarray"):
+                import scipy.sparse as sp
+
+                self.raw = sp.hstack(
+                    [sp.csr_matrix(self.raw), sp.csr_matrix(other.raw)]
+                ).tocsr()
+            else:
+                self.raw = np.concatenate([self.raw, other.raw], axis=1)
+        elif self.raw is not None:
+            from .utils.log import log_warning
+
+            log_warning(
+                "cannot merge raw data: the other dataset freed its raw "
+                "data; the merged dataset keeps none (reference warns too)"
+            )
+            self.raw = None
+        self._device_cache.clear()
         return self
 
     def set_init_score(self, init_score: Optional[np.ndarray]) -> "Dataset":

@@ -126,6 +126,52 @@ def plot_metric(
     return ax
 
 
+def plot_split_value_histogram(
+    booster,
+    feature,
+    bins=None,
+    ax=None,
+    width_coef: float = 0.8,
+    xlim=None,
+    ylim=None,
+    title: Optional[str] = "Split value histogram for feature with @index/name@ @feature@",
+    xlabel: Optional[str] = "Feature split value",
+    ylabel: Optional[str] = "Count",
+    figsize=None,
+    dpi=None,
+    grid: bool = True,
+    **kwargs: Any,
+):
+    """Histogram of a feature's split thresholds (plotting.py:268)."""
+    plt = _check_matplotlib()
+    hist, edges = booster.get_split_value_histogram(feature, bins=bins)
+    if hist.sum() == 0:
+        raise ValueError(
+            f"Cannot plot split value histogram, because feature {feature} "
+            "was not used in splitting"
+        )
+    centred = (edges[:-1] + edges[1:]) / 2
+    width = width_coef * (edges[1] - edges[0])
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.bar(centred, hist, width=width, **kwargs)
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    if title:
+        which = "name" if isinstance(feature, str) else "index"
+        ax.set_title(
+            title.replace("@index/name@", which).replace("@feature@", str(feature))
+        )
+    if xlabel:
+        ax.set_xlabel(xlabel)
+    if ylabel:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
 def create_tree_digraph(booster, tree_index: int = 0, **kwargs: Any):
     """Graphviz digraph of one tree (plotting.py:414)."""
     try:

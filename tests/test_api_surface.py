@@ -1,0 +1,100 @@
+"""python-package API parity: Sequence ingestion, Dataset accessors,
+Booster utility methods (reference: basic.py public surface)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 4))
+    y = X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.1, size=500)
+    return X, y
+
+
+def test_sequence_ingestion(xy):
+    X, y = xy
+
+    class Seq(lgb.Sequence):
+        batch_size = 128
+
+        def __init__(self, arr):
+            self.arr = arr
+
+        def __getitem__(self, idx):
+            return self.arr[idx]
+
+        def __len__(self):
+            return len(self.arr)
+
+    d = lgb.Dataset(Seq(X), y)
+    b = lgb.train({"objective": "regression", "verbosity": -1}, d, 5)
+    d2 = lgb.Dataset(X, y)
+    b2 = lgb.train({"objective": "regression", "verbosity": -1}, d2, 5)
+    np.testing.assert_allclose(b.predict(X), b2.predict(X))
+    # list-of-sequences concatenates
+    d3 = lgb.Dataset([Seq(X[:250]), Seq(X[250:])], y)
+    assert d3.num_data == 500
+
+
+def test_dataset_accessors(xy):
+    X, y = xy
+    d = lgb.Dataset(X, y, free_raw_data=False)
+    d.set_feature_name([f"f{i}" for i in range(4)])
+    d.construct()
+    assert d.get_feature_name() == ["f0", "f1", "f2", "f3"]
+    assert d.get_data() is not None
+    assert d.feature_num_bin(0) > 1
+    assert d.feature_num_bin("f1") > 1
+    v = lgb.Dataset(X[:100], y[:100], reference=d)
+    assert d in v.get_ref_chain()
+    with pytest.raises(ValueError):
+        lgb.Dataset(X, y).construct().get_data()  # freed raw
+
+
+def test_add_features_from(xy):
+    X, y = xy
+    d1 = lgb.Dataset(X[:, :2], y).construct()
+    d2 = lgb.Dataset(X[:, 2:], y).construct()
+    d1.add_features_from(d2)
+    assert d1.num_total_features == 4
+    assert d1.bins.shape[1] == len(d1.used_features)
+    b = lgb.Booster({"objective": "regression", "verbosity": -1}, d1)
+    b.update()
+    assert b.num_trees() == 1
+
+
+def test_booster_utilities(xy):
+    X, y = xy
+    b = lgb.train(
+        {"objective": "regression", "verbosity": -1, "num_leaves": 7},
+        lgb.Dataset(X, y),
+        6,
+    )
+    hist, edges = b.get_split_value_histogram(0)
+    assert hist.sum() > 0 and len(edges) == len(hist) + 1
+    # model_from_string replaces in place
+    other = lgb.train(
+        {"objective": "regression", "verbosity": -1, "num_leaves": 3},
+        lgb.Dataset(X, y),
+        2,
+    )
+    b2 = lgb.Booster(model_str=other.model_to_string())
+    b2.model_from_string(b.model_to_string())
+    np.testing.assert_allclose(b2.predict(X), b.predict(X))
+    # shuffle_models permutes but preserves the ensemble sum
+    before = b.predict(X)
+    b.shuffle_models()
+    np.testing.assert_allclose(b.predict(X), before, rtol=1e-6)
+    b.set_network(num_machines=1)  # no-op shim
+    b.set_train_data_name("train")
+
+
+def test_dask_stubs_raise():
+    with pytest.raises(ImportError):
+        lgb.DaskLGBMRegressor()
