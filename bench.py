@@ -19,7 +19,36 @@ import time
 import numpy as np
 
 
+def _probe_accelerator(timeout_s: int = 180) -> bool:
+    """Check (in a subprocess, so a hung tunnel can't wedge the bench) that
+    the default JAX backend actually comes up."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
+    platform_note = None
+    if not _probe_accelerator():
+        # accelerator unreachable (e.g. TPU tunnel down): record an honest
+        # CPU number rather than hanging the whole bench run
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        platform_note = "cpu-fallback (accelerator unreachable)"
     n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
     n_features = 28
     num_leaves = 255
@@ -71,6 +100,8 @@ def main() -> None:
     pred_dt = time.perf_counter() - t0
     preds_per_sec = pred_rows / pred_dt
 
+    import jax as _jax
+
     print(
         json.dumps(
             {
@@ -78,6 +109,7 @@ def main() -> None:
                 "value": round(iters_per_sec, 4),
                 "unit": "iters/sec",
                 "vs_baseline": round(iters_per_sec / baseline, 4),
+                "platform": platform_note or _jax.default_backend(),
                 "rows": n_rows,
                 "baseline_rows": 10_500_000,
                 "note": "vs_baseline divides by the reference CPU's 3.8 iters/s on 10.5M rows (BASELINE.md); this run uses 'rows' rows, so per-row throughput differs by rows/baseline_rows",

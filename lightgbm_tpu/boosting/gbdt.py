@@ -1693,13 +1693,21 @@ class Booster:
         for t in self.models_:
             nn = t.num_leaves - 1
             for node in range(nn):
-                if int(t.split_feature[node]) == feature and not (
-                    t.decision_type[node] & 1
-                ):
+                if int(t.split_feature[node]) == feature:
+                    if t.decision_type[node] & 1:
+                        raise ValueError(
+                            "Cannot compute split value histogram for the "
+                            "categorical feature"
+                        )
                     values.append(float(t.threshold[node]))
         values = np.asarray(values)
-        if bins is None:
-            bins = max(1, min(len(values), 10)) if len(values) else 1
+        n_unique = len(np.unique(values))
+        # reference default: one bin per unique split value; an explicit int
+        # is clamped to n_unique under xgboost_style (basic.py:5123)
+        if bins is None or (
+            xgboost_style and isinstance(bins, int) and bins > n_unique
+        ):
+            bins = max(n_unique, 1)
         hist, edges = np.histogram(values, bins=bins)
         if xgboost_style:
             # reference drops zero-count bins and falls back to a numpy
