@@ -496,7 +496,7 @@ class Booster:
             return grad_k, hess_k
         from ..ops.quantize import quantize_gradients
 
-        return quantize_gradients(
+        qg, qh, g_scale, h_scale = quantize_gradients(
             grad_k,
             hess_k,
             self._next_rng(),
@@ -506,6 +506,8 @@ class Booster:
                 self.objective is not None and self.objective.is_constant_hessian
             ),
         )
+        self._quant_scales = (g_scale, h_scale)  # for the int8 histogram
+        return qg, qh
 
     def _quant_renew(self, ta, leaf_id, grad_k, hess_k, mask):
         """RenewIntGradTreeOutput (gradient_discretizer.cpp:209) on device."""
@@ -526,6 +528,20 @@ class Booster:
             cfg.max_delta_step,
         )
         return ta._replace(leaf_value=lv)
+
+    def _quant_scales_arg(self):
+        """Concrete scales operand for shard_map; raise early when an int8
+        histogram method is configured without quantized gradients (matches
+        leaf_histogram's serial-path validation)."""
+        scales = getattr(self, "_quant_scales", None)
+        if scales is None:
+            if self._grower_params.hist_method.startswith("pallas_int8"):
+                raise ValueError(
+                    "hist_method='pallas_int8' needs quantized gradients "
+                    "(use_quantized_grad=True provides the scales)"
+                )
+            return (jnp.float32(1.0), jnp.float32(1.0))  # unused dummy
+        return scales
 
     def _grow_one(self, grad_k, hess_k, mask, feature_mask, rng):
         """Grow one tree: serial grow_tree or the mesh-sharded shard_map path
@@ -552,6 +568,7 @@ class Booster:
                 self._iscat_arg,
                 self._forced,
                 *self._cegb_args(),
+                self._quant_scales_arg(),
             )
         return grow_tree(
             self._bins,
@@ -567,6 +584,7 @@ class Booster:
             rng=rng,
             is_cat=self._is_cat,
             forced=self._forced,
+            quant_scales=getattr(self, "_quant_scales", None),
             **(
                 dict(zip(("cegb_penalty", "cegb_used"), self._cegb_args()))
                 if self._cegb_coupled is not None
@@ -686,6 +704,7 @@ class Booster:
         return GrowerParams(
             num_leaves=cfg.num_leaves,
             max_bin=self._max_bin_padded,
+            hist_method=str(self.params.get("hist_method", "auto")),
             max_depth=cfg.max_depth,
             min_data_in_leaf=cfg.min_data_in_leaf,
             min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,

@@ -309,6 +309,7 @@ def grow_tree(
     forced: Optional[Tuple] = None,  # (leaf, feat, bin, is_cat) arrays [n_forced]
     cegb_penalty: Optional[jnp.ndarray] = None,  # [F] f32 (use_cegb)
     cegb_used: Optional[jnp.ndarray] = None,  # [F] bool — already-bought features
+    quant_scales=None,  # (g_scale, h_scale) for hist_method='pallas_int8'
 ):
     """Grow one tree. Returns (TreeArrays, leaf_id[N])."""
     p = params
@@ -370,6 +371,7 @@ def grow_tree(
                     B,
                     method=p.hist_method,
                     axis_name=p.axis_name,
+                    quant_scales=quant_scales,
                 )
 
             return branch
@@ -439,6 +441,7 @@ def grow_tree(
                     B,
                     method=p.hist_method,
                     axis_name=p.axis_name,
+                    quant_scales=quant_scales,
                 )
 
             return branch
@@ -452,7 +455,8 @@ def grow_tree(
     )
     with jax.named_scope("root_histogram"):  # jax.profiler trace labels
         hist0 = leaf_histogram(
-            bins, grad, hess, count_mask, B, method=p.hist_method, axis_name=p.axis_name
+            bins, grad, hess, count_mask, B, method=p.hist_method,
+            axis_name=p.axis_name, quant_scales=quant_scales,
         )
     totals = hist0[0].sum(axis=0)  # every row lands in exactly one bin of feature 0
     root_used = jnp.zeros((f,), bool)
@@ -767,7 +771,8 @@ def grow_tree(
                 target = jnp.where(left_smaller, l, nl)
                 mask = count_mask * (leaf_id == target)
                 sm = leaf_histogram(
-                    bins, grad, hess, mask, B, method=p.hist_method, axis_name=p.axis_name
+                    bins, grad, hess, mask, B, method=p.hist_method,
+                    axis_name=p.axis_name, quant_scales=quant_scales,
                 )
             other = parent_hist - sm
             left_hist = jnp.where(left_smaller, sm, other)

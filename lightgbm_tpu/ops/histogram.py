@@ -96,6 +96,7 @@ def leaf_histogram(
     *,
     method: str = "auto",
     axis_name: Optional[str] = None,
+    quant_scales=None,  # (g_scale, h_scale) for the pallas_int8 methods
 ) -> jnp.ndarray:
     """Dispatch histogram impl; psum across the data mesh axis if given.
 
@@ -132,10 +133,27 @@ def leaf_histogram(
         from .pallas.histogram import histogram_pallas
 
         hist = histogram_pallas(bins, grad, hess, mask, num_bins, interpret=True)
+    elif method in ("pallas_int8", "pallas_int8_interpret"):
+        # quantized-gradient integer kernel: exact int32 accumulation of the
+        # int8 grid (requires use_quantized_grad so the scales exist)
+        if quant_scales is None:
+            raise ValueError(
+                f"method={method!r} needs quantized gradients "
+                "(use_quantized_grad=True provides the scales)"
+            )
+        from .pallas.histogram_int8 import histogram_pallas_int8
+
+        hist = histogram_pallas_int8(
+            bins, grad, hess, mask, num_bins,
+            quant_scales[0], quant_scales[1],
+            interpret=method.endswith("interpret"),
+        )
     elif method == "onehot":
         hist = leaf_histogram_onehot(bins, grad, hess, mask, num_bins)
-    else:
+    elif method == "segment":
         hist = leaf_histogram_segment(bins, grad, hess, mask, num_bins)
+    else:
+        raise ValueError(f"unknown histogram method {method!r}")
     if axis_name is not None:
         hist = jax.lax.psum(hist, axis_name)
     return hist

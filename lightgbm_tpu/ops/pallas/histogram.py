@@ -98,6 +98,44 @@ def _hist_kernel(
         )
 
 
+def tile_pallas_histogram(
+    bins, ghc, num_bins, kernel_body, scratch_dtype, out_dtype, interpret
+):
+    """Shared tile/pad/group machinery for the histogram kernels (bf16 hi/lo
+    and int8): rows tiled into VMEM, features grouped to ~_TARGET_LANES
+    lanes, accumulation across row tiles. Returns ([3, F*bpad], bpad)."""
+    n, f = bins.shape
+    bpad = _round_up(max(num_bins, 1), 128)
+    group = min(max(1, _TARGET_LANES // bpad), f)
+    tr = min(_TILE_ROWS, max(256, 1 << (n - 1).bit_length() if n > 1 else 256))
+    pad = (-n) % tr
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        ghc = jnp.pad(ghc, ((0, pad), (0, 0)))
+    tiles = (n + pad) // tr
+    kernel = functools.partial(
+        kernel_body, num_features=f, bpad=bpad, group=group
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(tiles,),
+        in_specs=[
+            pl.BlockSpec((tr, f), lambda i: (i, 0)),
+            pl.BlockSpec((tr, ghc.shape[1]), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((3, f * bpad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((3, f * bpad), out_dtype),
+        scratch_shapes=[pltpu.VMEM((tr, group * bpad), scratch_dtype)],
+        interpret=interpret,
+        compiler_params=(
+            pltpu.CompilerParams(dimension_semantics=("arbitrary",))
+            if not interpret
+            else None
+        ),
+    )(bins, ghc)
+    return out, bpad
+
+
 @functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))
 def histogram_pallas(
     bins: jnp.ndarray,  # [N, F] integer bins (int8/uint8/int32 ...)
@@ -116,35 +154,8 @@ def histogram_pallas(
 
         return leaf_histogram_segment(bins, grad, hess, mask, num_bins)
     ghc = jnp.stack([grad * mask, hess * mask, mask], axis=1)  # [N, 3]
-    bpad = _round_up(max(num_bins, 1), 128)
-    group = max(1, _TARGET_LANES // bpad)
-    group = min(group, f)
-    tr = min(_TILE_ROWS, max(256, 1 << (n - 1).bit_length() if n > 1 else 256))
-    pad = (-n) % tr
-    if pad:
-        bins = jnp.pad(bins, ((0, pad), (0, 0)))
-        ghc = jnp.pad(ghc, ((0, pad), (0, 0)))
-    tiles = (n + pad) // tr
-
-    kernel = functools.partial(
-        _hist_kernel, num_features=f, bpad=bpad, group=group
+    out, bpad = tile_pallas_histogram(
+        bins, ghc, num_bins, _hist_kernel, jnp.bfloat16, jnp.float32, interpret
     )
-    out = pl.pallas_call(
-        kernel,
-        grid=(tiles,),
-        in_specs=[
-            pl.BlockSpec((tr, f), lambda i: (i, 0)),
-            pl.BlockSpec((tr, 3), lambda i: (i, 0)),
-        ],
-        out_specs=pl.BlockSpec((3, f * bpad), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((3, f * bpad), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((tr, group * bpad), jnp.bfloat16)],
-        interpret=interpret,
-        compiler_params=(
-            pltpu.CompilerParams(dimension_semantics=("arbitrary",))
-            if not interpret
-            else None
-        ),
-    )(bins, ghc)
     # [3, F*bpad] -> [F, B, 3]
     return out.reshape(3, f, bpad)[:, :, :num_bins].transpose(1, 2, 0)

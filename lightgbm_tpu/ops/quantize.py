@@ -35,11 +35,18 @@ def quantize_gradients(
     num_bins: int = 4,
     stochastic: bool = True,
     constant_hessian: bool = False,
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Quantize (grad, hess) onto the reference's integer grid, returned as
-    f32 grid multiples (DiscretizeGradients, gradient_discretizer.cpp:70-160:
-    scales from the max |value|, truncation toward zero, optional stochastic
-    rounding)."""
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Quantize (grad, hess) onto the reference's integer grid
+    (DiscretizeGradients, gradient_discretizer.cpp:70-160: scales from the
+    max |value|, truncation toward zero, optional stochastic rounding).
+
+    Returns (qg, qh, g_scale, h_scale): qg/qh are f32 grid MULTIPLES
+    (qg = k * g_scale with integer k), and the scales let integer kernels
+    recover k exactly (ops/pallas/histogram_int8.py)."""
+    if num_bins > 127:
+        raise ValueError(
+            "num_grad_quant_bins must be <= 127 (int8 grid)"
+        )
     max_g = jnp.max(jnp.abs(grad))
     max_h = jnp.max(jnp.abs(hess))
     g_scale = jnp.maximum(max_g / (num_bins // 2), 1e-30)
@@ -60,7 +67,7 @@ def quantize_gradients(
     qh = jnp.trunc(hi + rh)  # hessians are non-negative
     if constant_hessian:
         qh = jnp.ones_like(qh)
-    return qg * g_scale, qh * h_scale
+    return qg * g_scale, qh * h_scale, g_scale, h_scale
 
 
 @functools.partial(

@@ -79,12 +79,12 @@ def make_sharded_grow(mesh: Mesh, params: GrowerParams, axis_name: str = DATA_AX
 
     def local(bins, grad, hess, mask, num_bins, nan_bins, feature_mask,
               monotone, interaction_sets, rng, is_cat, forced, cegb_penalty,
-              cegb_used):
+              cegb_used, quant_scales):
         return grow_tree(
             bins, grad, hess, mask, num_bins, nan_bins, feature_mask, p,
             monotone=monotone, interaction_sets=interaction_sets, rng=rng,
             is_cat=is_cat, forced=forced, cegb_penalty=cegb_penalty,
-            cegb_used=cegb_used,
+            cegb_used=cegb_used, quant_scales=quant_scales,
         )
 
     sh = P(axis_name)
@@ -93,7 +93,7 @@ def make_sharded_grow(mesh: Mesh, params: GrowerParams, axis_name: str = DATA_AX
     fn = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(sh2, sh, sh, sh, rep, rep, rep, rep, rep, rep, rep, rep, rep, rep),
+        in_specs=(sh2, sh, sh, sh, rep, rep, rep, rep, rep, rep, rep, rep, rep, rep, rep),
         out_specs=(
             jax.tree.map(lambda _: rep, TreeArrays(*([0] * len(TreeArrays._fields)))),
             sh,

@@ -15,13 +15,14 @@ def test_quantize_grid_and_scales():
     rng = np.random.default_rng(0)
     g = rng.normal(size=512).astype(np.float32)
     h = np.abs(rng.normal(size=512)).astype(np.float32) + 0.1
-    qg, qh = quantize_gradients(
+    qg, qh, gs, hs = quantize_gradients(
         jnp.asarray(g), jnp.asarray(h), jax.random.PRNGKey(0),
         num_bins=4, stochastic=False,
     )
     qg, qh = np.asarray(qg), np.asarray(qh)
-    g_scale = np.abs(g).max() / 2  # num_bins/2
-    h_scale = h.max() / 4
+    g_scale, h_scale = float(gs), float(hs)
+    assert g_scale == pytest.approx(np.abs(g).max() / 2)  # num_bins/2
+    assert h_scale == pytest.approx(h.max() / 4)
     # every quantized value sits on the integer grid of its scale
     assert np.allclose(np.round(qg / g_scale), qg / g_scale, atol=1e-4)
     assert np.allclose(np.round(qh / h_scale), qh / h_scale, atol=1e-4)
@@ -33,7 +34,7 @@ def test_quantize_grid_and_scales():
 def test_stochastic_rounding_unbiased():
     g = jnp.full((20000,), 0.3, jnp.float32)
     h = jnp.ones((20000,), jnp.float32)
-    qg, _ = quantize_gradients(
+    qg, _, _, _ = quantize_gradients(
         g, h, jax.random.PRNGKey(1), num_bins=4, stochastic=True
     )
     # E[q] == g under stochastic rounding (reference stochastic_rounding)
