@@ -309,6 +309,9 @@ class BinMapper:
                 out[nan_mask] = self.nan_bin
             return out
 
+        out = self._values_to_bins_native(values)
+        if out is not None:
+            return out
         nan_mask = np.isnan(values)
         if self.missing_type == MissingType.ZERO:
             miss = nan_mask | (np.abs(values) <= K_ZERO_THRESHOLD)
@@ -320,6 +323,37 @@ class BinMapper:
         out = np.searchsorted(self.bin_upper_bound, safe, side="left").astype(np.int32)
         if self.missing_type == MissingType.NAN and self.nan_bin >= 0:
             out[nan_mask] = self.nan_bin
+        return out
+
+    def _values_to_bins_native(self, values: np.ndarray):
+        """OpenMP binning for large numeric columns (native/binning.cpp —
+        the reference's C++ DenseBin::Push ingestion analog). None when the
+        native library is unavailable, the column is small, or the host has
+        a single core (NumPy's searchsorted wins without parallelism)."""
+        import os
+
+        if len(values) < 65536 or (os.cpu_count() or 1) < 2:
+            return None
+        try:
+            from .native import load_native
+        except Exception:  # pragma: no cover
+            return None
+        lib = load_native()
+        if lib is None:
+            return None
+        vals = np.ascontiguousarray(values, dtype=np.float64)
+        ub = np.ascontiguousarray(self.bin_upper_bound, dtype=np.float64)
+        out = np.empty(len(vals), dtype=np.int32)
+        lib.bin_numeric_f64(
+            vals.ctypes.data,
+            len(vals),
+            ub.ctypes.data,
+            len(ub),
+            int(self.missing_type),
+            int(self.nan_bin),
+            K_ZERO_THRESHOLD,
+            out.ctypes.data,
+        )
         return out
 
     def bin_to_threshold(self, bin_idx: int) -> float:

@@ -1,0 +1,9 @@
+"""Native host-runtime components (C++/OpenMP via ctypes).
+
+The TPU compute path is JAX/XLA; host-side ingestion (binning, parsing) is
+native here just as the reference's DatasetLoader is C++/OpenMP. Builds on
+demand with g++; every caller falls back to the NumPy path when the
+toolchain or the compiled library is unavailable.
+"""
+
+from .build import load_native  # noqa: F401

@@ -1,0 +1,40 @@
+// Native (OpenMP) host-side binning — the hot loop of Dataset.construct.
+//
+// Reference analog: the multi-threaded DatasetLoader/Bin construction
+// (src/io/dataset_loader.cpp CostructFromSampleData + DenseBin::Push under
+// OpenMP).  The device-side training path is JAX/XLA; ingestion is host work
+// exactly as it is in the reference, so it gets the same native treatment.
+//
+// Compiled on demand by native/build.py (g++ -O3 -fopenmp), loaded via
+// ctypes; lightgbm_tpu/binning.py falls back to NumPy when unavailable.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+// MissingType values mirror lightgbm_tpu/binning.py
+enum { MISSING_NONE = 0, MISSING_ZERO = 1, MISSING_NAN = 2 };
+
+// bin one numeric column: out[i] = lower_bound(ub, value) with the
+// missing-direction rules of BinMapper.values_to_bins
+void bin_numeric_f64(const double* values, long long n, const double* ub,
+                     int nb, int missing_type, int nan_bin,
+                     double zero_threshold, int32_t* out) {
+#pragma omp parallel for schedule(static)
+  for (long long i = 0; i < n; ++i) {
+    double v = values[i];
+    bool is_nan = std::isnan(v);
+    double safe = is_nan ? 0.0 : v;
+    int b = static_cast<int>(std::lower_bound(ub, ub + nb, safe) - ub);
+    if (missing_type == MISSING_ZERO) {
+      if (is_nan || std::fabs(v) <= zero_threshold) b = nan_bin;
+    } else if (missing_type == MISSING_NAN && nan_bin >= 0) {
+      if (is_nan) b = nan_bin;
+    }
+    out[i] = b;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,68 @@
+"""On-demand g++ build + ctypes loader for the native helpers."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build(src: Path, out: Path) -> bool:
+    # no -march=native: the cached .so may be shared across hosts (NFS,
+    # container images) and a binary search gains little from wide SIMD.
+    # compile to a temp file and os.replace: concurrent builders (the
+    # multi-process launcher) must never let a reader map a half-written ELF
+    tmp = out.with_name(f"{out.name}.{os.getpid()}.tmp")
+    cmd = [
+        "g++", "-O3", "-fopenmp", "-shared", "-fPIC",
+        str(src), "-o", str(tmp),
+    ]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode != 0 or not tmp.exists():
+            return False
+        os.replace(tmp, out)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if tmp.exists():
+            try:
+                tmp.unlink()
+            except OSError:
+                pass
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """The compiled helper library, or None (NumPy fallback)."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("LGBM_TPU_NO_NATIVE"):
+            return None
+        here = Path(__file__).parent
+        src = here / "binning.cpp"
+        out = here / "_binning.so"
+        try:
+            if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
+                if not _build(src, out):
+                    return None
+            lib = ctypes.CDLL(str(out))
+            lib.bin_numeric_f64.argtypes = [
+                ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
+                ctypes.c_void_p,
+            ]
+            _lib = lib
+        except OSError:
+            _lib = None
+        return _lib

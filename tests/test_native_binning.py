@@ -1,0 +1,65 @@
+"""Native (C++/OpenMP) binning vs the NumPy path — exact parity across
+missing-type modes (native/binning.cpp; reference DenseBin::Push analog)."""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import K_ZERO_THRESHOLD, BinMapper
+from lightgbm_tpu.native import load_native
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = load_native()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    return lib
+
+
+def _native_bins(lib, mapper: BinMapper, values: np.ndarray) -> np.ndarray:
+    vals = np.ascontiguousarray(values, dtype=np.float64)
+    ub = np.ascontiguousarray(mapper.bin_upper_bound, dtype=np.float64)
+    out = np.empty(len(vals), dtype=np.int32)
+    lib.bin_numeric_f64(
+        vals.ctypes.data,
+        len(vals),
+        ub.ctypes.data,
+        len(ub),
+        int(mapper.missing_type),
+        int(mapper.nan_bin),
+        K_ZERO_THRESHOLD,
+        out.ctypes.data,
+    )
+    return out
+
+
+@pytest.mark.parametrize("zero_as_missing", [False, True])
+@pytest.mark.parametrize("with_nan", [False, True])
+def test_native_matches_numpy(lib, zero_as_missing, with_nan):
+    rng = np.random.default_rng(int(zero_as_missing) * 2 + int(with_nan))
+    vals = rng.normal(size=200_000)
+    vals[rng.random(len(vals)) < 0.1] = 0.0
+    if with_nan:
+        vals[rng.random(len(vals)) < 0.05] = np.nan
+    m = BinMapper.from_sample(
+        vals[:50_000], 255, zero_as_missing=zero_as_missing
+    )
+    # the GENUINE NumPy fallback (native path disabled), not a re-derivation
+    orig = BinMapper._values_to_bins_native
+    BinMapper._values_to_bins_native = lambda self, values: None
+    try:
+        want = m.values_to_bins(vals)
+    finally:
+        BinMapper._values_to_bins_native = orig
+    got = _native_bins(lib, m, vals)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_native_handles_extremes(lib):
+    m = BinMapper.from_sample(np.linspace(-5, 5, 1000), 16)
+    vals = np.array([-np.inf, np.inf, -1e300, 1e300, 0.0, np.nan])
+    got = _native_bins(lib, m, vals)
+    want = m.values_to_bins(vals)
+    np.testing.assert_array_equal(got, want)
