@@ -1359,7 +1359,7 @@ class Booster:
                     [t.predict(X) for t in self.models_[t0:t1]], axis=1
                 )
             else:
-                batch = stack_real_trees(self.models_[t0:t1])
+                batch = self._stacked_real(t0, t1)
                 Xd = jnp.asarray(X, dtype=jnp.float32)
                 if pred_leaf:
                     return np.asarray(predict_real_leaves(batch, Xd), dtype=np.int32)
@@ -1474,10 +1474,24 @@ class Booster:
     def _bump_model_version(self) -> None:
         self._model_version = getattr(self, "_model_version", 0) + 1
 
+    def _stacked_real(self, t0: int, t1: int):
+        """Cached real-space tree batch (same invalidation discipline as
+        _stacked_bins: any models_ mutation bumps _model_version)."""
+        key = ("real", t0, t1, self._model_version)
+        if key not in self._stack_cache:
+            self._stack_cache = {
+                k: v for k, v in self._stack_cache.items() if k[0] != "real"
+            }
+            self._stack_cache[key] = stack_real_trees(self.models_[t0:t1])
+        return self._stack_cache[key]
+
     def _stacked_bins(self, t0: int, t1: int) -> BinTreeBatch:
         key = (t0, t1, self._model_version)
         if key not in self._stack_cache:
-            self._stack_cache = {}  # invalidate older stacks
+            # evict older BIN stacks only; real-space batches stay valid
+            self._stack_cache = {
+                k: v for k, v in self._stack_cache.items() if k[0] == "real"
+            }
             self._stack_cache[key] = stack_bin_trees(
                 self._bin_records[t0:t1], self.config.num_leaves
             )
