@@ -439,6 +439,15 @@ class Booster:
         used = ds.used_features
         self._monotone = None
         if cfg.monotone_constraints and any(v != 0 for v in cfg.monotone_constraints):
+            if cfg.monotone_constraints_method != "basic":
+                from ..utils.log import log_warning
+
+                log_warning(
+                    f"monotone_constraints_method="
+                    f"{cfg.monotone_constraints_method!r} is not implemented; "
+                    "using 'basic' (outputs are still guaranteed monotone, "
+                    "bounds are just more conservative)"
+                )
             mc = np.zeros(len(used), dtype=np.int8)
             for ci, j in enumerate(used):
                 if j < len(cfg.monotone_constraints):

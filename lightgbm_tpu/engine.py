@@ -166,11 +166,39 @@ def _make_n_folds(
     seed: int,
     stratified: bool,
     shuffle: bool,
+    group_aware: bool = False,
 ):
+    """Yields (train_idx, test_idx, train_group, test_group); the group
+    entries are None except for ranking data, where whole QUERIES are
+    assigned to folds (reference engine.py:559 group_kfold split)."""
     full_data.construct()
     num_data = full_data.num_data
     rng = np.random.default_rng(seed)
     label = full_data.get_label()
+    qb = full_data.metadata.query_boundaries
+    if group_aware and qb is not None:
+        nq = len(qb) - 1
+        if nq < nfold:
+            raise ValueError(
+                f"ranking cv needs at least nfold queries: have {nq} "
+                f"queries for nfold={nfold}"
+            )
+        order = np.arange(nq)
+        if shuffle:
+            rng.shuffle(order)
+        fold_of_query = np.zeros(nq, dtype=np.int64)
+        fold_of_query[order] = np.arange(nq) % nfold
+        sizes = np.diff(qb)
+        row_fold = np.repeat(fold_of_query, sizes)
+        for k in range(nfold):
+            test_q = fold_of_query == k
+            yield (
+                np.nonzero(row_fold != k)[0],
+                np.nonzero(row_fold == k)[0],
+                sizes[~test_q],
+                sizes[test_q],
+            )
+        return
     if stratified:
         # per-class round-robin assignment after an optional shuffle
         fold_id = np.zeros(num_data, dtype=np.int64)
@@ -187,7 +215,33 @@ def _make_n_folds(
         fold_id[idx] = np.arange(num_data) % nfold
     for k in range(nfold):
         test_mask = fold_id == k
-        yield np.nonzero(~test_mask)[0], np.nonzero(test_mask)[0]
+        yield np.nonzero(~test_mask)[0], np.nonzero(test_mask)[0], None, None
+
+
+def _fold_groups(train_set: Dataset, fold, need_query: bool):
+    """(train_group, test_group) for a user-supplied (train_idx, test_idx)
+    fold: for ranking data the indices must cover whole queries; their
+    per-query sizes are derived from the dataset's boundaries."""
+    if not need_query:
+        return None, None
+    qb = train_set.metadata.query_boundaries
+    if qb is None:
+        return None, None
+    query_of_row = np.repeat(np.arange(len(qb) - 1), np.diff(qb))
+
+    def sizes_for(idx):
+        idx = np.sort(np.asarray(idx))
+        qs = query_of_row[idx]
+        uniq, counts = np.unique(qs, return_counts=True)
+        full = np.diff(qb)[uniq]
+        if not np.array_equal(counts, full):
+            raise ValueError(
+                "ranking cv folds must contain whole queries; a supplied "
+                "fold splits a query across train/test"
+            )
+        return counts
+
+    return sizes_for(fold[0]), sizes_for(fold[1])
 
 
 def cv(
@@ -223,10 +277,22 @@ def cv(
     weight = train_set.get_weight()
 
     # folds on raw arrays: reconstruct per-fold Datasets sharing bin mappers
+    from .objectives import create_objective
+
+    _obj = create_objective(cfg)
+    need_query = bool(_obj is not None and _obj.need_query)
     if folds is None:
-        folds = list(_make_n_folds(train_set, nfold, params, seed, stratified, shuffle))
+        folds = list(
+            _make_n_folds(
+                train_set, nfold, params, seed, stratified, shuffle,
+                group_aware=need_query,
+            )
+        )
     else:
-        folds = list(folds)
+        folds = [
+            f if len(f) == 4 else (*f, *_fold_groups(train_set, f, need_query))
+            for f in folds
+        ]
 
     cvbooster = CVBooster()
     raw = train_set.raw
@@ -235,11 +301,12 @@ def cv(
             "cv requires the training Dataset to keep raw data; construct it "
             "with free_raw_data=False"
         )
-    for train_idx, test_idx in folds:
+    for train_idx, test_idx, train_group, test_group in folds:
         dtrain = Dataset(
             raw[train_idx],
             label[train_idx],
             weight=None if weight is None else weight[train_idx],
+            group=train_group,
             params=params,
             free_raw_data=False,
         )
@@ -247,6 +314,7 @@ def cv(
             raw[test_idx],
             label[test_idx],
             weight=None if weight is None else weight[test_idx],
+            group=test_group,
         )
         booster = create_booster(params, dtrain)
         booster.add_valid(dtest, "valid")

@@ -1,0 +1,62 @@
+"""Group-aware cross-validation for ranking objectives (reference:
+engine.py:559 — folds split by whole queries so no query straddles folds)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.engine import _make_n_folds  # noqa: E402
+
+
+def test_folds_keep_queries_whole():
+    rng = np.random.default_rng(0)
+    sizes = rng.integers(3, 9, size=30)
+    n = int(sizes.sum())
+    X = rng.normal(size=(n, 3))
+    y = rng.random(n)
+    d = lgb.Dataset(X, y, group=sizes)
+    folds = list(
+        _make_n_folds(d, 3, {}, seed=1, stratified=False, shuffle=True,
+                      group_aware=True)
+    )
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    starts = set(qb[:-1])
+    all_test = []
+    for train_idx, test_idx, tg, eg in folds:
+        assert tg is not None and eg is not None
+        assert tg.sum() == len(train_idx) and eg.sum() == len(test_idx)
+        # each fold's test rows are a union of whole queries
+        pos = 0
+        for size in eg:
+            seg = test_idx[pos : pos + size]
+            assert seg[0] in starts
+            assert np.array_equal(seg, np.arange(seg[0], seg[0] + size))
+            pos += size
+        all_test.append(test_idx)
+    # folds partition the rows
+    union = np.sort(np.concatenate(all_test))
+    assert np.array_equal(union, np.arange(n))
+
+
+def test_ranking_cv_end_to_end():
+    rng = np.random.default_rng(3)
+    nq, q = 45, 6
+    X = rng.normal(size=(nq * q, 4))
+    y = (X[:, 0] + 0.3 * rng.normal(size=nq * q) > 0.4).astype(float)
+    res = lgb.cv(
+        {
+            "objective": "lambdarank",
+            "verbosity": -1,
+            "min_data_in_leaf": 2,
+            "metric": "ndcg",
+            "eval_at": [3],
+        },
+        lgb.Dataset(X, y, group=np.full(nq, q), free_raw_data=False),
+        num_boost_round=4,
+        nfold=3,
+    )
+    assert any("ndcg@3-mean" in k for k in res)
+    vals = res[[k for k in res if "mean" in k][0]]
+    assert len(vals) == 4 and np.isfinite(vals).all()
