@@ -235,6 +235,7 @@ class Booster:
                     (
                         self._next_rng()
                         if self.config.feature_fraction_bynode < 1.0
+                        or self.config.extra_trees
                         else None
                     ),
                 )
@@ -725,6 +726,7 @@ class Booster:
             use_monotone=self._monotone is not None,
             use_interaction=self._interaction_sets is not None,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
+            extra_trees=cfg.extra_trees,
             use_cat=self._has_cat,
             cat_params=CatParams(
                 max_cat_to_onehot=cfg.max_cat_to_onehot,
@@ -1005,6 +1007,7 @@ class Booster:
                     (
                         self._next_rng()
                         if self.config.feature_fraction_bynode < 1.0
+                        or self.config.extra_trees
                         else None
                     ),
                 )

@@ -229,17 +229,36 @@ def _fold_groups(train_set: Dataset, fold, need_query: bool):
         return None, None
     query_of_row = np.repeat(np.arange(len(qb) - 1), np.diff(qb))
 
+    full_sizes = np.diff(qb)
+
     def sizes_for(idx):
-        idx = np.sort(np.asarray(idx))
+        # respect the GIVEN row order: group sizes are emitted per run of
+        # consecutive same-query rows, and each run must cover its query
+        # exactly (any order inside the run is fine for listwise losses)
+        idx = np.asarray(idx)
         qs = query_of_row[idx]
-        uniq, counts = np.unique(qs, return_counts=True)
-        full = np.diff(qb)[uniq]
-        if not np.array_equal(counts, full):
+        change = np.nonzero(np.diff(qs))[0] + 1
+        bounds = np.concatenate([[0], change, [len(qs)]])
+        run_q = qs[bounds[:-1]]
+        run_len = np.diff(bounds)
+        bad = (
+            len(np.unique(run_q)) != len(run_q)
+            or not np.array_equal(run_len, full_sizes[run_q])
+        )
+        if not bad:
+            for b0, b1, q in zip(bounds[:-1], bounds[1:], run_q):
+                if not np.array_equal(
+                    np.sort(idx[b0:b1]), np.arange(qb[q], qb[q + 1])
+                ):
+                    bad = True
+                    break
+        if bad:
             raise ValueError(
-                "ranking cv folds must contain whole queries; a supplied "
-                "fold splits a query across train/test"
+                "ranking cv folds must contain whole queries with each "
+                "query's rows consecutive; a supplied fold splits or "
+                "interleaves a query"
             )
-        return counts
+        return run_len
 
     return sizes_for(fold[0]), sizes_for(fold[1])
 

@@ -75,6 +75,7 @@ class GrowerParams:
     use_monotone: bool = False  # monotone_constraints (basic method)
     use_interaction: bool = False  # interaction_constraints
     feature_fraction_bynode: float = 1.0
+    extra_trees: bool = False  # one random threshold per feature (USE_RAND)
 
 
 def _hist_caps(n: int, full_range: bool = False) -> list:
@@ -174,7 +175,7 @@ class _State(NamedTuple):
 def _candidate_for_leaf(
     hist, g, h, c, num_bins, nan_bins, feature_mask, p: GrowerParams,
     monotone=None, lb=None, ub=None, parent_output=0.0, is_cat=None,
-    cegb_penalty=None,
+    cegb_penalty=None, rand_bins=None,
 ):
     return best_split(
         hist,
@@ -199,6 +200,7 @@ def _candidate_for_leaf(
         cat_params=p.cat_params,
         cegb_penalty=cegb_penalty if p.use_cegb else None,
         cegb_split_penalty=p.cegb_split_penalty if p.use_cegb else 0.0,
+        rand_bins=rand_bins if p.extra_trees else None,
     )
 
 
@@ -329,6 +331,17 @@ def grow_tree(
         if not use_cegb:
             return None
         return jnp.where(used_mask, 0.0, cegb_penalty)
+
+    def node_rand_bins(node_seed):
+        """extra_trees: one uniform random candidate bin per feature for
+        this node (reference rand.NextInt over the bin range)."""
+        if not (p.extra_trees and rng is not None):
+            return None
+        key = jax.random.fold_in(jax.random.fold_in(rng, 7919), node_seed)
+        num_ordered = num_bins - (nan_bins >= 0).astype(jnp.int32)
+        hi = jnp.maximum(num_ordered - 1, 1)
+        u = jax.random.uniform(key, (f,))
+        return (u * hi).astype(jnp.int32)
 
     def node_feature_mask(node_seed, used_row):
         """Per-node usable features: feature_fraction_bynode sampling
@@ -471,6 +484,7 @@ def grow_tree(
         parent_output=leaf_output(totals[0], totals[1], p.lambda_l1, p.lambda_l2, p.max_delta_step),
         is_cat=is_cat_arr,
         cegb_penalty=_cegb_pen(cegb_used0),
+        rand_bins=node_rand_bins(0),
     )
 
     neg_inf = jnp.full((L,), -jnp.inf, dtype=jnp.float32)
@@ -830,6 +844,7 @@ def grow_tree(
                 parent_output=leaf_output(lg, lh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
                 is_cat=is_cat_arr,
                 cegb_penalty=_cegb_pen(cegb_used_new),
+                rand_bins=node_rand_bins(2 * t + 1),
             )
             cand_r = _candidate_for_leaf(
                 right_hist, rg, rh, rc, num_bins, nan_bins,
@@ -840,6 +855,7 @@ def grow_tree(
                 parent_output=leaf_output(rg, rh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
                 is_cat=is_cat_arr,
                 cegb_penalty=_cegb_pen(cegb_used_new),
+                rand_bins=node_rand_bins(2 * t + 2),
             )
             depth_ok = (p.max_depth <= 0) | (d_new < p.max_depth)
             cand = _set_cand(

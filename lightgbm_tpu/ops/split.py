@@ -129,6 +129,7 @@ def best_split(
     cat_params: Optional[CatParams] = None,  # static; required with is_cat
     cegb_penalty: Optional[jnp.ndarray] = None,  # [F] f32 per-feature penalty
     cegb_split_penalty: float = 0.0,  # tradeoff * cegb_penalty_split
+    rand_bins: Optional[jnp.ndarray] = None,  # [F] extra_trees random bin
 ) -> SplitCandidate:
     """cegb_*: Cost-Effective Gradient Boosting (reference:
     cost_effective_gradient_boosting.hpp DeltaGain — gain is reduced by
@@ -156,6 +157,11 @@ def best_split(
     # candidate threshold at bin t is valid for t in [0, num_ordered_bins-2]
     num_ordered = num_bins - has_nan.astype(jnp.int32)
     valid_bin = bin_ids < (num_ordered[:, None] - 1)
+    if rand_bins is not None:
+        # extra_trees (extremely randomized trees): only ONE random
+        # threshold per feature competes (reference USE_RAND branch of
+        # FindBestThresholdSequentially, feature_histogram.hpp:870)
+        valid_bin = valid_bin & (bin_ids == rand_bins[:, None])
     num_feature_mask = feature_mask & ~is_cat if use_cat else feature_mask
 
     def eval_gain(lg, lh, lc, l2v, ok):
@@ -221,10 +227,16 @@ def best_split(
         in_range = (bin_ids < num_bins[:, None]) & ~is_nan_bin
         catf = (is_cat & feature_mask)[:, None]
         use_onehot_f = (num_bins <= cp.max_cat_to_onehot)[:, None]
+        oh_ok = in_range & catf & use_onehot_f
+        if rand_bins is not None:
+            # extra_trees randomizes categorical candidates too (reference
+            # USE_RAND in FindBestThresholdCategoricalInner): one random
+            # category for one-hot ...
+            oh_ok = oh_ok & (
+                bin_ids == (rand_bins % jnp.maximum(num_bins, 1))[:, None]
+            )
         # case 2 — one-hot: left = the single category bin (:188-241)
-        gain_oh = eval_gain(
-            g_, h_, c_, lambda_l2, in_range & catf & use_onehot_f
-        )
+        gain_oh = eval_gain(g_, h_, c_, lambda_l2, oh_ok)
         # cases 3/4 — sorted subset scan, both directions (:243-342)
         l2c = lambda_l2 + cp.cat_l2
         validb = in_range & (c_ >= cp.cat_smooth)
@@ -243,6 +255,10 @@ def best_split(
         tot_g, tot_h, tot_c = pre_g[:, -1:], pre_h[:, -1:], pre_c[:, -1:]
         max_num_cat = jnp.minimum(cp.max_cat_threshold, (used + 1) // 2)
         pos_ok = bin_ids < jnp.minimum(used, max_num_cat)[:, None]
+        if rand_bins is not None:
+            # ... and one random subset size for the sorted scan (:271)
+            rpos = rand_bins % jnp.maximum(jnp.minimum(used, max_num_cat), 1)
+            pos_ok = pos_ok & (bin_ids == rpos[:, None])
         ok_sorted = catf & ~use_onehot_f & pos_ok
 
         bidx = used[:, None] - 2 - bin_ids  # bwd prefix end (may be < 0)

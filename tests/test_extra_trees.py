@@ -1,0 +1,40 @@
+"""extra_trees (extremely randomized trees — reference USE_RAND branch of
+FindBestThresholdSequentially: one random threshold per feature per node)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1500, 5))
+    y = X[:, 0] * 2 - X[:, 1] + rng.normal(scale=0.2, size=1500)
+    return X, y
+
+
+def test_extra_trees_randomizes_thresholds_but_learns(xy):
+    X, y = xy
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "seed": 3}
+    b_norm = lgb.train(base, lgb.Dataset(X, y), 10)
+    b_et = lgb.train({**base, "extra_trees": True}, lgb.Dataset(X, y), 10)
+    t_n, t_e = b_norm.models_[0], b_et.models_[0]
+    assert not np.array_equal(np.asarray(t_n.threshold), np.asarray(t_e.threshold))
+    mse = float(np.mean((b_et.predict(X) - y) ** 2))
+    assert mse < np.var(y) * 0.3  # randomized splits still learn
+
+
+def test_extra_trees_deterministic_per_seed(xy):
+    X, y = xy
+    params = {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+              "seed": 11, "extra_trees": True}
+    b1 = lgb.train(params, lgb.Dataset(X, y), 5)
+    b2 = lgb.train(params, lgb.Dataset(X, y), 5)
+    np.testing.assert_array_equal(b1.predict(X), b2.predict(X))
+    b3 = lgb.train({**params, "seed": 12}, lgb.Dataset(X, y), 5)
+    assert not np.array_equal(b1.predict(X), b3.predict(X))

@@ -1,0 +1,101 @@
+"""Cross-feature interaction smoke tests: boosting modes x sampling x
+categorical x constraints x quantization trained together must produce
+finite, serializable, self-consistent models (the reference's config matrix
+is exercised similarly by its R/python test grids)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+COMBOS = [
+    {"boosting": "dart", "use_quantized_grad": True, "num_grad_quant_bins": 8},
+    {"boosting": "dart", "bagging_fraction": 0.7, "bagging_freq": 1,
+     "drop_rate": 0.3},
+    {"boosting": "goss", "top_rate": 0.3, "other_rate": 0.2,
+     "learning_rate": 0.3},
+    {"boosting": "rf", "bagging_fraction": 0.6, "bagging_freq": 1},
+    {"monotone_constraints": [1, -1, 0, 0], "lambda_l1": 0.5},
+    {"use_quantized_grad": True, "quant_train_renew_leaf": True,
+     "feature_fraction": 0.7},
+    {"linear_tree": True, "lambda_l2": 1.0},
+    {"min_gain_to_split": 0.5, "max_depth": 3,
+     "interaction_constraints": [[0, 1], [2, 3]]},
+    {"cegb_tradeoff": 1.0, "cegb_penalty_split": 0.01,
+     "feature_fraction_bynode": 0.8},
+    {"path_smooth": 2.0, "max_delta_step": 0.5, "extra_trees": True},
+]
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.default_rng(0)
+    n = 1200
+    X = rng.normal(size=(n, 4))
+    X[:, 3] = rng.integers(0, 6, size=n)  # categorical column
+    y = (
+        X[:, 0]
+        - 0.5 * X[:, 1]
+        + np.where(X[:, 3] % 2 == 0, 0.7, -0.7)
+        + rng.normal(scale=0.2, size=n)
+    )
+    return X, y
+
+
+@pytest.mark.parametrize("extra", COMBOS)
+def test_combo_trains_and_roundtrips(xy, extra):
+    X, y = xy
+    params = {
+        "objective": "regression",
+        "num_leaves": 15,
+        "min_data_in_leaf": 5,
+        "verbosity": -1,
+        "seed": 7,
+        **extra,
+    }
+    cat = [] if extra.get("linear_tree") else [3]
+    b = lgb.train(params, lgb.Dataset(X, y, categorical_feature=cat), 8)
+    p = b.predict(X)
+    assert np.isfinite(p).all()
+    assert p.std() > 0  # actually learned something
+    b2 = lgb.Booster(model_str=b.model_to_string())
+    np.testing.assert_allclose(b2.predict(X), p, rtol=1e-5, atol=1e-6)
+    for t in b.models_:
+        t.validate()
+
+
+@pytest.mark.parametrize(
+    "objective,extra",
+    [
+        ("binary", {"is_unbalance": True, "use_quantized_grad": True}),
+        ("multiclass", {"num_class": 3, "bagging_fraction": 0.8,
+                        "bagging_freq": 1}),
+        ("regression_l1", {"boosting": "dart"}),
+        ("huber", {"use_quantized_grad": True,
+                   "quant_train_renew_leaf": True}),
+        ("poisson", {"monotone_constraints": [1, 0, 0, 0]}),
+    ],
+)
+def test_objective_combos(xy, objective, extra):
+    X, y = xy
+    if objective == "binary":
+        y = (y > 0).astype(np.float64)
+    elif objective == "multiclass":
+        y = np.clip(np.digitize(y, [-0.5, 0.5]), 0, 2)
+    elif objective == "poisson":
+        y = np.abs(y)
+    params = {
+        "objective": objective,
+        "num_leaves": 7,
+        "min_data_in_leaf": 5,
+        "verbosity": -1,
+        **extra,
+    }
+    b = lgb.train(params, lgb.Dataset(X, y), 6)
+    p = b.predict(X)
+    assert np.isfinite(np.asarray(p)).all()
+    b2 = lgb.Booster(model_str=b.model_to_string())
+    np.testing.assert_allclose(np.asarray(b2.predict(X)), np.asarray(p),
+                               rtol=1e-5, atol=1e-6)
