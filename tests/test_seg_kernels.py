@@ -1,0 +1,128 @@
+"""Oracle tests for the segment-resident layout (ops/pallas/seg.py) and the
+sort-based partition (ops/segpart.py).
+
+Reference semantics under test: DataPartition::Split (stable partition,
+src/treelearner/data_partition.hpp:101) and DenseBin::ConstructHistogram
+(src/io/dense_bin.hpp:99), via a NumPy oracle.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.histogram import leaf_histogram_segment
+from lightgbm_tpu.ops.pallas.seg import (
+    pack_rows,
+    padded_rows,
+    seg_hist,
+    unpack_stats,
+)
+from lightgbm_tpu.ops.segpart import (
+    leaf_id_from_seg,
+    leaf_of_positions,
+    sort_partition,
+)
+
+
+@pytest.fixture(scope="module")
+def packed():
+    rng = np.random.default_rng(7)
+    f, n = 11, 5000
+    n_pad = padded_rows(n)
+    bins = rng.integers(0, 256, size=(n, f)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.random(n).astype(np.float32) + 0.5
+    m = (rng.random(n) < 0.8).astype(np.float32)
+    seg = pack_rows(
+        jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m), n_pad
+    )
+    catmask = (rng.random(256) < 0.5).astype(np.float32)
+    return dict(
+        f=f, n=n, n_pad=n_pad, bins=bins, g=g, h=h, m=m,
+        seg=seg, segnp=np.asarray(seg), catmask=catmask,
+    )
+
+
+def test_pack_unpack_roundtrip(packed):
+    p = packed
+    b2, g2, h2, m2, r2 = unpack_stats(p["seg"][: p["n"]], p["f"])
+    assert np.array_equal(np.asarray(b2), p["bins"])
+    assert np.array_equal(np.asarray(g2), p["g"])  # exact f32 bit transport
+    assert np.array_equal(np.asarray(h2), p["h"])
+    assert np.array_equal(np.asarray(m2), p["m"])
+    assert np.array_equal(np.asarray(r2), np.arange(p["n"]))
+
+
+def _np_partition(segnp, f, sb, cnt, feat, tbin, dl, nanb, iscat, catmask):
+    rows = segnp[sb : sb + cnt]
+    packedcol = rows.view(np.uint16).reshape(cnt, -1)[:, feat // 2].astype(np.int64)
+    colv = (packedcol >> (8 * (feat % 2))) & 0xFF
+    if iscat:
+        gl = (catmask[np.clip(colv, 0, len(catmask) - 1)] > 0.5) & (
+            colv < len(catmask)
+        )
+    else:
+        gl = (colv <= tbin) | ((dl != 0) & (nanb >= 0) & (colv == nanb))
+    return rows[gl], rows[~gl]
+
+
+@pytest.mark.parametrize(
+    "sb,cnt,feat,tbin,dl,nanb,iscat",
+    [
+        (0, 5000, 3, 120, 0, -1, 0),  # root
+        (17, 3000, 5, 80, 1, 200, 0),  # unaligned begin, NaN default-left
+        (1000, 37, 2, 128, 0, -1, 0),  # tiny segment
+        (513, 1029, 7, 30, 0, -1, 1),  # categorical
+        (5, 600, 1, 255, 0, -1, 0),  # all-left
+        (9, 600, 1, -1, 0, -1, 0),  # all-right
+        (4000, 1000, 10, 100, 0, -1, 0),  # tail of the array
+    ],
+)
+def test_sort_partition_vs_oracle(packed, sb, cnt, feat, tbin, dl, nanb, iscat):
+    p = packed
+    seg1, nl, nr = sort_partition(
+        p["seg"], jnp.int32(sb), jnp.int32(cnt), jnp.int32(feat),
+        jnp.int32(tbin), jnp.int32(dl), jnp.int32(nanb), jnp.int32(iscat),
+        jnp.asarray(p["catmask"]), f=p["f"], n_pad=p["n_pad"],
+    )
+    nl, nr = int(nl), int(nr)
+    expL, expR = _np_partition(
+        p["segnp"], p["f"], sb, cnt, feat, tbin, dl, nanb, iscat, p["catmask"]
+    )
+    assert (nl, nr) == (len(expL), len(expR))
+    got = np.asarray(seg1)
+    assert np.array_equal(got[sb : sb + nl], expL)  # stable left
+    assert np.array_equal(got[sb + nl : sb + cnt], expR)  # stable right
+    assert np.array_equal(got[:sb], p["segnp"][:sb])  # neighbors untouched
+    assert np.array_equal(got[sb + cnt :], p["segnp"][sb + cnt :])
+
+
+@pytest.mark.parametrize("st,cnt", [(0, 5000), (17, 3000), (513, 1029), (1000, 37)])
+def test_seg_hist_vs_oracle(packed, st, cnt):
+    p = packed
+    hs = seg_hist(
+        p["seg"], jnp.asarray([st, cnt], jnp.int32),
+        f=p["f"], num_bins=256, n_pad=p["n_pad"],
+    )
+    bo, go, ho, mo, _ = unpack_stats(p["seg"][st : st + cnt], p["f"])
+    ref = leaf_histogram_segment(bo, go, ho, mo, 256)
+    d = np.abs(np.asarray(hs) - np.asarray(ref)).max()
+    rel = d / max(1e-9, np.abs(np.asarray(ref)).max())
+    assert rel < 2e-3
+
+
+def test_leaf_mapping_roundtrip(packed):
+    n = packed["n"]
+    rng = np.random.default_rng(3)
+    Lb = jnp.asarray([0, 1200, 700, 0], jnp.int32)
+    Lr = jnp.asarray([700, n - 1200, 500, 0], jnp.int32)
+    lp = np.asarray(leaf_of_positions(Lb, Lr, jnp.int32(3), n))
+    assert (lp[:700] == 0).all()
+    assert (lp[700:1200] == 2).all()
+    assert (lp[1200:] == 1).all()
+    perm = rng.permutation(n).astype(np.int32)
+    lid = np.asarray(leaf_id_from_seg(jnp.asarray(perm), jnp.asarray(lp)))
+    exp = np.empty(n, np.int32)
+    exp[perm] = lp
+    assert np.array_equal(lid, exp)
