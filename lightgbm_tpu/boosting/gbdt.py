@@ -711,10 +711,27 @@ class Booster:
         from ..ops.split import CatParams
 
         cfg = self.config
+        hist_method = str(self.params.get("hist_method", "auto"))
+        # segment-resident mode (sort-partition + streaming histograms,
+        # ops/segpart.py) is the fast path on TPU: eligible whenever bins fit
+        # a byte and the packed row fits 128 i16 lanes; the quantized int8
+        # kernel keeps the ordered path (it histograms int8 grad pairs)
+        n_used = len(self.train_set.used_features) if self.train_set else 0
+        seg_ok = (
+            self._max_bin_padded <= 256
+            and 0 < n_used <= 242
+            # an explicitly chosen histogram kernel keeps the ordered path
+            # (the seg path has its own fixed kernel)
+            and hist_method == "auto"
+        )
+        hist_mode = str(
+            self.params.get("hist_mode", "seg" if seg_ok else "ordered")
+        )
         return GrowerParams(
             num_leaves=cfg.num_leaves,
             max_bin=self._max_bin_padded,
-            hist_method=str(self.params.get("hist_method", "auto")),
+            hist_mode=hist_mode,
+            hist_method=hist_method,
             max_depth=cfg.max_depth,
             min_data_in_leaf=cfg.min_data_in_leaf,
             min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,

@@ -65,11 +65,15 @@ class GrowerParams:
     # static; the per-feature coupled penalty arrives as a runtime operand
     use_cegb: bool = False
     cegb_split_penalty: float = 0.0
-    # "ordered": maintain a leaf-contiguous row permutation (the reference's
-    # DataPartition index array, data_partition.hpp) so every per-split op —
-    # partition, gather, histogram — costs O(parent segment), never O(N);
-    # "gather": leaf-id vector + per-split jnp.nonzero compaction (O(N) per
-    # split for the nonzero); "full": masked pass over all rows per split.
+    # "seg": keep rows PHYSICALLY in leaf-segment order (packed 256B rows);
+    # each split is a stable sort of the parent's contiguous window and each
+    # histogram a contiguous DMA stream — no random gathers, which serialize
+    # on TPU (~35ns/element measured; see ops/segpart.py);
+    # "ordered": leaf-contiguous row permutation (the reference's
+    # DataPartition index array, data_partition.hpp) with per-split index
+    # gathers — O(parent segment) work but gather-bound on TPU;
+    # "gather": leaf-id vector + per-split jnp.nonzero compaction; "full":
+    # masked pass over all rows per split.
     hist_mode: str = "ordered"
     path_smooth: float = 0.0
     use_monotone: bool = False  # monotone_constraints (basic method)
@@ -357,8 +361,28 @@ def grow_tree(
             m = m & (jax.random.uniform(key, (f,)) < p.feature_fraction_bynode)
         return m
 
+    use_seg = p.hist_mode == "seg" and f > 0 and n > 1
     use_ordered = p.hist_mode == "ordered" and f > 0 and n > 1
     use_gather = p.hist_mode == "gather" and f > 0 and n > 1
+
+    if use_seg:
+        from .pallas.seg import pack_rows, padded_rows, seg_hist, stat_lanes
+        from .segpart import leaf_id_from_seg, leaf_of_positions, sort_partition
+
+        n_pad_seg = padded_rows(n)
+        seg0 = pack_rows(bins, grad, hess, count_mask, n_pad_seg)
+
+        def _seg_hist(seg_arr, start, cnt_rows):
+            hist = seg_hist(
+                seg_arr,
+                jnp.stack([start, cnt_rows]).astype(jnp.int32),
+                f=f,
+                num_bins=B,
+                n_pad=n_pad_seg,
+            )
+            if p.axis_name is not None:
+                hist = lax.psum(hist, p.axis_name)
+            return hist
     if use_ordered or use_gather:
         caps = sorted(
             _hist_caps(n, full_range=p.axis_name is not None)
@@ -467,10 +491,13 @@ def grow_tree(
         else jnp.zeros((max(f, 1),), bool)
     )
     with jax.named_scope("root_histogram"):  # jax.profiler trace labels
-        hist0 = leaf_histogram(
-            bins, grad, hess, count_mask, B, method=p.hist_method,
-            axis_name=p.axis_name, quant_scales=quant_scales,
-        )
+        if use_seg:
+            hist0 = _seg_hist(seg0, jnp.int32(0), jnp.int32(n))
+        else:
+            hist0 = leaf_histogram(
+                bins, grad, hess, count_mask, B, method=p.hist_method,
+                axis_name=p.axis_name, quant_scales=quant_scales,
+            )
     totals = hist0[0].sum(axis=0)  # every row lands in exactly one bin of feature 0
     root_used = jnp.zeros((f,), bool)
     neg_inf_s = jnp.float32(-jnp.inf)
@@ -511,6 +538,12 @@ def grow_tree(
                 jnp.full((order_len - n,), n, jnp.int32),
             ]
         )
+        leaf_begin0 = jnp.zeros((L,), jnp.int32)
+        leaf_nrows0 = jnp.zeros((L,), jnp.int32).at[0].set(n)
+        leaf_id0 = jnp.zeros((0,), jnp.int32)
+    elif use_seg:
+        # the order slot carries the packed segment matrix in seg mode
+        order0 = seg0
         leaf_begin0 = jnp.zeros((L,), jnp.int32)
         leaf_nrows0 = jnp.zeros((L,), jnp.int32).at[0].set(n)
         leaf_id0 = jnp.zeros((0,), jnp.int32)
@@ -647,6 +680,39 @@ def grow_tree(
         can_split = c_gain > 0.0
         done = st.done | ~can_split
 
+        if use_seg:
+            # Hoisted OUT of the cond below: threading the big segment matrix
+            # through conditional branches makes XLA materialize a defensive
+            # copy of it every split (~0.8 ms at 1M rows, measured).  A
+            # zero-count partition/histogram is a value-level no-op, so when
+            # `done` these run harmlessly on an empty window.
+            seg_begin_l = st.leaf_begin[l]
+            seg_cnt_l = jnp.where(can_split, st.leaf_nrows[l], 0)
+            new_order, seg_nl, seg_nr = sort_partition(
+                st.order,
+                seg_begin_l,
+                seg_cnt_l,
+                c_feat,
+                c_bin,
+                c_dl.astype(jnp.int32),
+                nan_bins[c_feat],
+                c_cis.astype(jnp.int32),
+                c_cmask.astype(jnp.float32),
+                f=f,
+                n_pad=n_pad_seg,
+            )
+            if p.axis_name is not None:
+                # global smaller-child choice (see gather-mode comment)
+                seg_left_smaller = lax.psum(seg_nl, p.axis_name) <= lax.psum(
+                    seg_nr, p.axis_name
+                )
+            else:
+                seg_left_smaller = seg_nl <= seg_nr
+            seg_child_start = seg_begin_l + jnp.where(seg_left_smaller, 0, seg_nl)
+            seg_child_cnt = jnp.where(seg_left_smaller, seg_nl, seg_nr)
+            seg_sm = _seg_hist(new_order, seg_child_start, seg_child_cnt)
+            st = st._replace(order=new_order)
+
         def apply(st: _State) -> _State:
             l = best_leaf
             nl = (t + 1).astype(jnp.int32)
@@ -657,7 +723,13 @@ def grow_tree(
             cmask = c_cmask
 
             # ---- partition rows of leaf l (reference DataPartition::Split)
-            if use_ordered:
+            if use_seg:
+                # already partitioned before the cond (see above)
+                begin_l = seg_begin_l
+                order = st.order
+                nleft, nright = seg_nl, seg_nr
+                leaf_id = st.leaf_id
+            elif use_ordered:
                 # stable in-place partition of the parent's contiguous
                 # segment, sized by its capacity bucket — O(parent), not O(N)
                 begin_l = st.leaf_begin[l]
@@ -726,7 +798,10 @@ def grow_tree(
             # over that buffer — the TPU formulation of the reference's
             # ordered_gradients gather (rows touched per tree ~ N log L).
             parent_hist = st.hist_buf[l]
-            if use_ordered:
+            if use_seg:
+                left_smaller = seg_left_smaller
+                sm = seg_sm
+            elif use_ordered:
                 if p.axis_name is not None:
                     # global smaller-child choice + pmax'd capacity bucket so
                     # every shard histograms the SAME child (see gather-mode
@@ -865,7 +940,7 @@ def grow_tree(
                 cand, nl, cand_r, jnp.where(depth_ok, cand_r.gain, -jnp.inf)
             )
 
-            if use_ordered:
+            if use_ordered or use_seg:
                 leaf_begin = st.leaf_begin.at[nl].set(begin_l + nleft)
                 leaf_nrows = st.leaf_nrows.at[l].set(nleft).at[nl].set(nright)
             else:
@@ -949,6 +1024,17 @@ def grow_tree(
         cat_mask=state.node_cat_mask,
     )
 
+    if use_seg:
+        # leaf per segment position (marker-cumsum) -> row order via ONE sort
+        # (the scatter alternative serializes on TPU)
+        lp = leaf_of_positions(
+            state.leaf_begin, state.leaf_nrows, state.num_leaves, n
+        )
+        GLO = stat_lanes(f)[0]
+        ridx = (state.order[GLO + 5, :n].astype(jnp.int32) & 0xFFFF) | (
+            (state.order[GLO + 6, :n].astype(jnp.int32) & 0xFFFF) << 16
+        )
+        return tree, leaf_id_from_seg(ridx, lp)
     if use_ordered:
         # reconstruct the per-row leaf-id vector from the segment layout in
         # ONE O(N) pass: mark each active leaf's segment start, turn starts

@@ -27,13 +27,12 @@ like the reference's histogram-pool size classes.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .pallas.seg import LANES, bin_lanes, used_lanes
+from .pallas.seg import _u16, used_lanes
 
 
 def window_caps(n_pad: int, floor: int = 8192) -> list:
@@ -60,7 +59,9 @@ def _go_left(colv, tbin, dl, nanb, iscat, catmask):
     jax.jit, static_argnames=("f", "n_pad")
 )
 def sort_partition(
-    seg: jnp.ndarray,  # [n_pad, LANES] i16 packed rows
+    seg: jnp.ndarray,  # [LANES, n_pad] i16 packed rows, PLANE-MAJOR — the
+    #                    layout XLA assigns this loop carry anyway; storing it
+    #                    that way avoids full-array relayout copies per split
     sbegin: jnp.ndarray,  # scalar i32 — segment begin
     cnt: jnp.ndarray,  # scalar i32 — segment rows
     feat: jnp.ndarray,  # scalar i32 — split feature (used-feature index)
@@ -81,36 +82,44 @@ def sort_partition(
     n_ops = (used_lanes(f) + 1) // 2  # i32 lanes that carry real data
     caps = window_caps(n_pad)
 
-    seg32_full = lax.bitcast_convert_type(
-        seg.reshape(n_pad, LANES // 2, 2), jnp.int32
-    )  # [n_pad, 64] i32 (little-endian lane pairs)
-
     def make_branch(P: int):
         def branch(op):
-            seg32, sbegin, cnt, feat, tbin, dl, nanb, iscat = op
+            seg, sbegin, cnt, feat, tbin, dl, nanb, iscat = op
             start = jnp.minimum(sbegin, n_pad - P)
             off = sbegin - start
-            win = lax.dynamic_slice(seg32, (start, 0), (P, n_ops))
+            # window-first: only O(P) data is ever materialized — a
+            # full-array bitcast/reassemble here would copy the whole
+            # 256B-per-row matrix on every split
+            # only the used planes are sliced/rewritten (the rest are zero)
+            win16 = lax.dynamic_slice(seg, (0, start), (2 * n_ops, P))
+            uT = win16.astype(jnp.int32) & 0xFFFF  # [2*n_ops, P]
             pos = jnp.arange(P, dtype=jnp.int32)
             in_seg = (pos >= off) & (pos < off + cnt)
-            # feature column: byte j&1 of i16 lane j>>1 = byte (j&3) of i32
-            # lane j>>2
-            l32 = feat >> 2
-            shift = (feat & 3) * 8
-            col32 = lax.dynamic_slice(win, (0, l32), (P, 1))[:, 0]
-            colv = (col32 >> shift) & 0xFF
+            # feature column: byte j&1 of i16 lane j>>1
+            lane = feat >> 1
+            shift = (feat & 1) * 8
+            col16 = lax.dynamic_slice(uT, (lane, 0), (1, P))[0]
+            colv = (col16 >> shift) & 0xFF
             gl = _go_left(colv, tbin, dl, nanb, iscat, catmask) & in_seg
             key = jnp.where(
                 pos < off,
                 0,
                 jnp.where(gl, 1, jnp.where(in_seg, 2, 3)),
             ).astype(jnp.int32)
-            ops_in = (key,) + tuple(win[:, i] for i in range(n_ops))
+            # combine i16 lane pairs into i32 payloads with strided slices
+            # (a widening bitcast would materialize a [P, 64, 2] tensor whose
+            # 2-wide minor dim tile-pads 64x)
+            win32T = uT[0::2] | (uT[1::2] << 16)  # [n_ops, P]
+            ops_in = (key,) + tuple(win32T[i] for i in range(n_ops))
             sorted_ops = lax.sort(ops_in, num_keys=1, is_stable=True)
-            win_sorted = jnp.stack(sorted_ops[1:], axis=1)  # [P, n_ops]
-            seg32 = lax.dynamic_update_slice(seg32, win_sorted, (start, 0))
+            wsT = jnp.stack(sorted_ops[1:], axis=0)  # [n_ops, P] i32
+            outT = jnp.zeros((2 * n_ops, P), jnp.int32)
+            outT = outT.at[0::2].set(wsT & 0xFFFF)
+            outT = outT.at[1::2].set((wsT >> 16) & 0xFFFF)
+            win16_new = _u16(outT)  # [2*n_ops, P]
+            seg = lax.dynamic_update_slice(seg, win16_new, (0, start))
             nl = jnp.sum(gl).astype(jnp.int32)
-            return seg32, nl
+            return seg, nl
 
         return branch
 
@@ -119,16 +128,10 @@ def sort_partition(
         jnp.searchsorted(caps_arr, cnt, side="left"), 0, len(caps) - 1
     ).astype(jnp.int32)
     branches = [make_branch(P) for P in caps]
-    seg32_used = seg32_full[:, :n_ops]
-    seg32_new, nl = lax.switch(
-        bucket, branches, (seg32_used, sbegin, cnt, feat, tbin, dl, nanb, iscat)
+    seg_new, nl = lax.switch(
+        bucket, branches, (seg, sbegin, cnt, feat, tbin, dl, nanb, iscat)
     )
     nr = cnt - nl
-    # restore the full 64-lane i32 view (unused lanes are all zero)
-    pad = jnp.zeros((n_pad, LANES // 2 - n_ops), jnp.int32)
-    seg_new = lax.bitcast_convert_type(
-        jnp.concatenate([seg32_new, pad], axis=1), jnp.int16
-    ).reshape(n_pad, LANES)
     return seg_new, nl, nr
 
 
