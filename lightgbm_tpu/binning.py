@@ -373,3 +373,58 @@ class BinMapper:
             cats = sorted(int(c) for c in (self.bin_to_cat if self.bin_to_cat is not None else []))
             return ":".join(str(c) for c in cats)
         return f"[{self.min_value:g}:{self.max_value:g}]"
+
+    # ------------------------------------------------- distributed transport
+    # (reference: DatasetLoader::ConstructBinMappersFromTextData syncs
+    # per-rank BinMappers over the network via CopyTo/CopyFrom byte buffers,
+    # src/io/dataset_loader.cpp:1079 + bin.cpp SizesInByte; here the wire is
+    # a fixed-width float64 vector so process_allgather can carry it)
+
+    def to_vector(self, width: int) -> np.ndarray:
+        """Serialize into a fixed-width float64 vector."""
+        ub = np.asarray(self.bin_upper_bound, dtype=np.float64)
+        cats = (
+            np.asarray(self.bin_to_cat, dtype=np.float64)
+            if self.bin_to_cat is not None
+            else np.zeros((0,), np.float64)
+        )
+        head = np.array(
+            [
+                self.num_bins,
+                1.0 if self.is_categorical else 0.0,
+                float(self.missing_type),
+                float(self.nan_bin),
+                self.min_value,
+                self.max_value,
+                float(self.default_bin),
+                float(len(ub)),
+                float(len(cats)),
+            ],
+            dtype=np.float64,
+        )
+        out = np.zeros((width,), np.float64)
+        vec = np.concatenate([head, ub, cats])
+        if len(vec) > width:
+            raise ValueError(f"mapper needs {len(vec)} slots, width={width}")
+        out[: len(vec)] = vec
+        return out
+
+    @classmethod
+    def from_vector(cls, vec: np.ndarray) -> "BinMapper":
+        n_ub = int(vec[7])
+        n_cat = int(vec[8])
+        ub = np.asarray(vec[9 : 9 + n_ub], dtype=np.float64)
+        cats = vec[9 + n_ub : 9 + n_ub + n_cat].astype(np.int64)
+        bin_to_cat = cats if n_cat else None
+        return cls(
+            bin_upper_bound=ub,
+            is_categorical=bool(vec[1]),
+            missing_type=int(vec[2]),
+            num_bins=int(vec[0]),
+            nan_bin=int(vec[3]),
+            cat_to_bin={int(c): i for i, c in enumerate(cats)} if n_cat else None,
+            bin_to_cat=bin_to_cat,
+            min_value=float(vec[4]),
+            max_value=float(vec[5]),
+            default_bin=int(vec[6]),
+        )

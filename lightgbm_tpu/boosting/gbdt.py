@@ -287,6 +287,15 @@ class Booster:
     # ================================================================ training
     def _init_train(self, train_set: Dataset) -> None:
         """Reference: GBDT::Init (src/boosting/gbdt.cpp:59)."""
+        if not train_set._constructed:
+            # merge booster params the dataset doesn't set itself — the
+            # reference pushes train() params into the Dataset before lazy
+            # construction (basic.py Dataset._update_params), so e.g.
+            # categorical_feature/max_bin passed to train() must bind here
+            merged = {**self.params, **train_set.params}
+            if merged != train_set.params:
+                train_set.params = merged
+                train_set.config = type(train_set.config).from_params(merged)
         train_set.construct()
         self.train_set = train_set
         cfg = self.config
@@ -717,12 +726,17 @@ class Booster:
         # a byte and the packed row fits 128 i16 lanes; the quantized int8
         # kernel keeps the ordered path (it histograms int8 grad pairs)
         n_used = len(self.train_set.used_features) if self.train_set else 0
+        import jax as _jax
+
         seg_ok = (
             self._max_bin_padded <= 256
             and 0 < n_used <= 242
             # an explicitly chosen histogram kernel keeps the ordered path
             # (the seg path has its own fixed kernel)
             and hist_method == "auto"
+            # off-TPU the seg histogram falls back to a masked full-N pass
+            # per split — ordered mode's O(parent segment) wins there
+            and _jax.default_backend() == "tpu"
         )
         hist_mode = str(
             self.params.get("hist_mode", "seg" if seg_ok else "ordered")
@@ -1370,8 +1384,19 @@ class Booster:
                 r.get("no_bin_form") for r in self._bin_records[t0:t1]
             )
         )
+        es_requested = bool(
+            kwargs.get("pred_early_stop", self.config.pred_early_stop)
+        ) and self._early_stop_type(k) != "none"
         if use_bins:
-            bins = self._bin_input(X)
+            mat = self._bin_input_host(X)
+            if not pred_leaf and not es_requested:
+                # fast path: Pallas forest-walk kernel (the fork's
+                # tree_avx512 batch predictor, TPU-shaped) — falls back to
+                # the XLA walker off-TPU or for categorical/wide trees
+                raw_fw = self._forest_walk_raw(mat, X.shape[0], t0, t1, k)
+                if raw_fw is not None:
+                    return self._finish_predict(raw_fw, t0, t1, k, raw_score)
+            bins = jnp.asarray(mat)
             batch = self._stacked_bins(t0, t1)
             if pred_leaf:
                 leaves = predict_bins_leaves(batch, bins, self._nan_bins)
@@ -1395,18 +1420,60 @@ class Booster:
                 per_tree = np.asarray(predict_real_raw(batch, Xd), dtype=np.float64)
 
         n = X.shape[0]
-        es_on = bool(kwargs.get("pred_early_stop", self.config.pred_early_stop))
-        if es_on and self._early_stop_type(k) != "none":
+        if es_requested:
             raw = self._apply_pred_early_stop(per_tree, k, kwargs)
         else:
             raw = per_tree.reshape(n, -1, k).sum(axis=1)  # [N, K]
+        return self._finish_predict(raw, t0, t1, k, raw_score)
+
+    def _finish_predict(self, raw: np.ndarray, t0, t1, k, raw_score):
         if self.average_output:
-            raw /= (t1 - t0) // k
+            raw = raw / ((t1 - t0) // k)
         if k == 1:
             raw = raw[:, 0]
         if raw_score or self.objective is None:
             return raw
         return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+
+    def _forest_walk_raw(self, mat: np.ndarray, n: int, t0, t1, k):
+        """Raw class scores via the Pallas forest-walk kernel
+        (ops/pallas/forest_walk.py — the fork's tree_avx512 batch path,
+        TPU-shaped), or None when ineligible."""
+        import jax as _jax
+
+        from ..ops.pallas.forest_walk import (
+            build_tables,
+            forest_walk,
+            pad_bins_for_walk,
+            unpack_walk_scores,
+            walk_eligible,
+        )
+
+        from ..ops.pallas.forest_walk import KPAD
+
+        if _jax.default_backend() != "tpu":
+            return None
+        if k > KPAD:
+            return None  # kernel output is padded to KPAD class columns
+        recs = self._bin_records[t0:t1]
+        nanb = np.asarray(self._nan_bins)
+        if not walk_eligible(recs, nanb, mat.shape[1], self._max_bin_padded):
+            return None
+        key = ("fw", t0, t1, self._model_version)
+        if key not in self._stack_cache:
+            self._stack_cache = {
+                kk: v for kk, v in self._stack_cache.items() if kk[0] != "fw"
+            }
+            self._stack_cache[key] = build_tables(recs, nanb)
+        tables = self._stack_cache[key]
+        out = forest_walk(
+            pad_bins_for_walk(mat),
+            tables,
+            n_trees=tables.n_trees,
+            max_depth=tables.max_depth,
+            k=k,
+        )
+        return unpack_walk_scores(np.asarray(out), n, k).astype(np.float64)
 
     def _early_stop_type(self, k: int) -> str:
         """Reference c_api chooses the margin rule from the objective
@@ -1462,7 +1529,7 @@ class Booster:
             X = X[None, :]
         return X
 
-    def _bin_input(self, X) -> jnp.ndarray:
+    def _bin_input_host(self, X) -> np.ndarray:
         ds = self.train_set
         csc = X.tocsc() if hasattr(X, "tocsc") else None
         if csc is not None and csc.shape[1] < ds.num_total_features:
@@ -1498,7 +1565,7 @@ class Booster:
             # walker's gathers stay in range; constant trees never read it
             else np.zeros((X.shape[0], 1), dtype=np.int32)
         )
-        return jnp.asarray(mat.astype(np.int32))
+        return mat.astype(np.int32)
 
     def _bump_model_version(self) -> None:
         self._model_version = getattr(self, "_model_version", 0) + 1
@@ -1517,9 +1584,12 @@ class Booster:
     def _stacked_bins(self, t0: int, t1: int) -> BinTreeBatch:
         key = (t0, t1, self._model_version)
         if key not in self._stack_cache:
-            # evict older BIN stacks only; real-space batches stay valid
+            # evict older BIN stacks only; real-space batches and
+            # forest-walk tables stay valid
             self._stack_cache = {
-                k: v for k, v in self._stack_cache.items() if k[0] == "real"
+                k: v
+                for k, v in self._stack_cache.items()
+                if k[0] in ("real", "fw")
             }
             self._stack_cache[key] = stack_bin_trees(
                 self._bin_records[t0:t1], self.config.num_leaves

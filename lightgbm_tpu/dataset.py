@@ -330,6 +330,7 @@ class Dataset:
             self._build_bin_mappers_sparse(sparse_csc, cat_idx)
         else:
             self._build_bin_mappers(data, cat_idx)
+        self._sync_mappers_across_processes()
 
         max_bins = max((m.num_bins for m in self.bin_mappers), default=1)
         dtype = np.uint8 if max_bins <= 256 else np.uint16
@@ -406,11 +407,82 @@ class Dataset:
                 out.append(int(str(c).replace("name:", "")) if str(c).isdigit() else -1)
         return [c for c in out if 0 <= c < num_features]
 
+    def _sync_mappers_across_processes(self) -> None:
+        """Distributed binning (reference:
+        DatasetLoader::ConstructBinMappersFromTextData,
+        src/io/dataset_loader.cpp:1079): under ``pre_partition`` each process
+        holds only its local rows, so per-process quantile mappers would
+        disagree.  Like the reference, each rank keeps the mappers for its
+        CONTIGUOUS feature slice (built from local rows) and the slices are
+        allgathered so every process ends with identical mappers; binning
+        then proceeds locally."""
+        if not self.config.pre_partition:
+            return
+        try:
+            import jax
+
+            nproc = jax.process_count()
+        except Exception:  # pragma: no cover
+            return
+        if nproc <= 1:
+            return
+        from jax.experimental import multihost_utils
+
+        f = len(self.bin_mappers)
+        rank = jax.process_index()
+        mb_max = max(
+            [int(self.config.max_bin), 2]
+            + [int(m) for m in self.config.max_bin_by_feature]
+        )
+        width = 16 + 2 * mb_max
+        local = np.zeros((f, width), np.float64)
+        per = (f + nproc - 1) // nproc
+        lo, hi = rank * per, min(f, (rank + 1) * per)
+        for j in range(lo, hi):
+            local[j] = self.bin_mappers[j].to_vector(width)
+        gathered = np.asarray(
+            multihost_utils.process_allgather(local)
+        )  # [nproc, F, W]
+        mappers: List[BinMapper] = []
+        for j in range(f):
+            owner = min(j // per, nproc - 1)
+            mappers.append(BinMapper.from_vector(gathered[owner, j]))
+        self.bin_mappers = mappers
+        self.used_features = [
+            j for j in range(f) if not mappers[j].is_trivial
+        ]
+
+    def _owned_feature_range(self, f: int):
+        """Under pre_partition + multi-process, the contiguous feature slice
+        this rank bins (others arrive via the mapper allgather); None when
+        every feature is local."""
+        if not self.config.pre_partition:
+            return None
+        try:
+            import jax
+
+            nproc = jax.process_count()
+        except Exception:  # pragma: no cover
+            return None
+        if nproc <= 1:
+            return None
+        per = (f + nproc - 1) // nproc
+        rank = jax.process_index()
+        return rank * per, min(f, (rank + 1) * per)
+
     def _add_mapper(self, j: int, values: np.ndarray, cat_idx: List[int],
                     total_cnt: Optional[int] = None) -> None:
         """Shared per-feature mapper construction for the dense and sparse
         builders (max_bin_by_feature lookup + trivial-feature pruning)."""
         cfg = self.config
+        owned = self._owned_feature_range(self.num_total_features)
+        if owned is not None and not (owned[0] <= j < owned[1]):
+            # another rank bins this feature; a placeholder keeps indices
+            # aligned until _sync_mappers_across_processes replaces it
+            self.bin_mappers.append(
+                BinMapper(bin_upper_bound=np.array([np.inf]), num_bins=1)
+            )
+            return
         mb = (
             cfg.max_bin_by_feature[j]
             if j < len(cfg.max_bin_by_feature)

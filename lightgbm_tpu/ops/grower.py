@@ -369,6 +369,13 @@ def grow_tree(
         from .pallas.seg import pack_rows, padded_rows, seg_hist, stat_lanes
         from .segpart import leaf_id_from_seg, leaf_of_positions, sort_partition
 
+        if B > 256:
+            raise ValueError(
+                "hist_mode='seg' packs bins into bytes: max_bin (padded to "
+                f"{B}) must be <= 256 — use hist_mode='ordered' for wider "
+                "bin spaces"
+            )
+
         n_pad_seg = padded_rows(n)
         seg0 = pack_rows(bins, grad, hess, count_mask, n_pad_seg)
 

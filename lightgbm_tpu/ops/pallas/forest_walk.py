@@ -1,0 +1,270 @@
+"""Pallas forest-walk kernel — batched level-synchronous tree inference.
+
+Reference analog: the fork's cache-blocked batch predictor
+``PredictTreeBatchAVX512`` (include/LightGBM/tree_avx512.hpp:41): 8-row
+level-synchronous walks with the tree resident in cache.  The TPU-native
+formulation walks a 1024-row tile through EVERY tree with all trees' node
+tables resident in VMEM.
+
+Two layout decisions make it fast:
+  * the walk state (current node per row) lives as ONE [8, 128] vreg per
+    1024-row tile; node-table lookups are in-VMEM lane-gathers
+    (``tpu.dynamic_gather`` spans one 128-lane vreg, so a 256-node table is
+    two [8,128] gathers + a select — ~3 vector ops instead of the 16-vreg
+    broadcasts a row-major formulation pays);
+  * all per-node scalars (threshold, feature, default-left, NaN bin) are
+    bit-packed into ONE i32 table, so a level costs two table lookups plus
+    one bin fetch.
+
+The XLA while-loop walker in predict.py pays ~35 ns/element of serialized
+gather for each of these lookups; this kernel replaces them with VPU-rate
+vector ops.
+
+Supported: numeric splits in BIN space (v <= thr, NaN-bin default-left),
+bin values < 256 (byte-packed), trees up to 256 nodes, F <= 128 features,
+up to KPAD classes.  Categorical splits or wider models fall back to the
+XLA walker.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+LANES = 128
+ROW_TILE = 1024
+MAX_NODES = 256  # two lane-gather halves
+MAX_THR = 256  # bin values are byte-packed: thresholds/NaN bins must fit u8
+#               (the packed node word has 9 bits of headroom, but fval reads
+#               are 8-bit)
+KPAD = 8  # output class columns padded for layout friendliness
+BINS_PACKED = 32  # 128 features at 4 bins per i32 lane
+
+
+class ForestTables(NamedTuple):
+    """Per-tree node tables, shaped [T, 2, 128] (two lane-gather halves —
+    the leading dim carries the tree index so per-tree slicing never hits
+    the tiled-dim alignment rules)."""
+
+    pk1: jnp.ndarray  # i32: thr | feat<<9 | dl<<16 | (nanb+1)<<17
+    pk2: jnp.ndarray  # i32: (left+MAX_NODES) | (right+MAX_NODES)<<16 (negatives = ~leaf)
+    leaf: jnp.ndarray  # f32 [T, 2, 128]: leaf value by LEAF index
+    n_trees: int
+    max_depth: int
+
+
+def walk_eligible(
+    records, nan_bins: np.ndarray, num_features: int, max_bin: int
+) -> bool:
+    """Numeric-only, <=255 splits/tree, bin space fits a byte."""
+    if num_features > LANES:
+        return False
+    if max_bin > MAX_THR:
+        # input bins would clip at 255 and could misroute at high thresholds
+        return False
+    if len(nan_bins) and int(np.max(nan_bins)) >= MAX_THR:
+        return False  # NaN bin must fit the 8-bit fval (nanb+1 has 9 bits)
+    for r in records:
+        sf = r.get("split_feature")
+        if sf is None or len(sf) >= MAX_NODES:
+            return False
+        sic = r.get("split_is_cat")
+        if sic is not None and np.any(np.asarray(sic)):
+            return False
+        if len(sf) and int(np.max(np.asarray(r["split_bin"]))) >= MAX_THR:
+            return False
+    return True
+
+
+def build_tables(records, nan_bins: np.ndarray) -> ForestTables:
+    """Stack bin-space tree records (host dicts, see gbdt._bin_records) into
+    kernel tables.  Caller must have checked `walk_eligible`."""
+    t = len(records)
+    pk1 = np.zeros((t, MAX_NODES), np.int32)
+    pk2 = np.zeros((t, MAX_NODES), np.int32)
+    leaf = np.zeros((t, MAX_NODES), np.float32)
+    nan_bins = np.asarray(nan_bins, np.int64)
+    max_depth = 1
+    for i, r in enumerate(records):
+        sf = np.asarray(r["split_feature"], np.int64)
+        nn = len(sf)
+        lv = np.asarray(r["leaf_value"], np.float32)
+        leaf[i, : len(lv)] = lv
+        if nn == 0:
+            # single-leaf tree: node 0 routes every row to leaf 0
+            pk2[i, 0] = (~0 + MAX_NODES) | ((~0 + MAX_NODES) << 16)
+            continue
+        thr = np.asarray(r["split_bin"], np.int64)
+        dl = np.asarray(r["default_left"], np.int64)
+        lc = np.asarray(r["left_child"], np.int64)
+        rc = np.asarray(r["right_child"], np.int64)
+        nb = nan_bins[sf] + 1  # 0 = no NaN bin
+        pk1[i, :nn] = (thr | (sf << 9) | (dl << 16) | (nb << 17)).astype(np.int32)
+        pk2[i, :nn] = ((lc + MAX_NODES) | ((rc + MAX_NODES) << 16)).astype(np.int32)
+        depth = np.ones(nn, np.int32)
+        for m in range(nn):
+            for c in (lc[m], rc[m]):
+                if c >= 0:
+                    depth[c] = depth[m] + 1
+        max_depth = max(max_depth, int(depth.max()) + 1)
+    shape = (t, 2, LANES)
+    return ForestTables(
+        pk1=jnp.asarray(pk1.reshape(shape)),
+        pk2=jnp.asarray(pk2.reshape(shape)),
+        leaf=jnp.asarray(leaf.reshape(shape)),
+        n_trees=t,
+        max_depth=max_depth,
+    )
+
+
+def _lookup(table_2x128, cur):
+    """table [2, 128] gathered by cur [8, 128] in [0, 256) -> [8, 128].
+    One broadcast + two single-vreg lane-gathers + a select."""
+    lo = jnp.broadcast_to(table_2x128[0:1, :], (8, LANES))
+    hi = jnp.broadcast_to(table_2x128[1:2, :], (8, LANES))
+    idx = cur & 127
+    glo = jnp.take_along_axis(lo, idx, axis=1)
+    ghi = jnp.take_along_axis(hi, idx, axis=1)
+    return jnp.where(cur < 128, glo, ghi)
+
+
+def _walk_kernel(
+    bins_ref,  # VMEM [1, BINS_PACKED, 8, 128] i32 — 4 bins per i32, tile
+    #           rows laid out as (sublane, lane); everything in the walk is a
+    #           vreg-shaped [8, 128] op — no reshapes, no row-major crossings
+    pk1_ref,  # VMEM [T, 2, 128] i32
+    pk2_ref,
+    leaf_ref,  # VMEM [T, 2, 128] f32
+    out_ref,  # VMEM [1, KPAD, 8, 128] f32
+    *,
+    n_trees: int,
+    max_depth: int,
+    k: int,
+):
+    planes = [bins_ref[0, p] for p in range(BINS_PACKED)]  # 32 x [8, 128]
+    out_ref[...] = jnp.zeros_like(out_ref)
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (KPAD, 8, LANES), 0)
+
+    def select_plane(lane_idx):
+        """31-select binary tree: out[s,l] = planes[lane_idx[s,l]][s,l]."""
+        level_vals = planes
+        for bit in range(5):
+            b = (lane_idx >> bit) & 1
+            level_vals = [
+                jnp.where(b != 0, level_vals[2 * i + 1], level_vals[2 * i])
+                for i in range(len(level_vals) // 2)
+            ]
+        return level_vals[0]
+
+    def tree_body(t, _):
+        pk1 = pk1_ref[t]  # [2, 128]
+        pk2 = pk2_ref[t]
+        lv = leaf_ref[t]
+
+        def level(_, cur):
+            curc = jnp.maximum(cur, 0)  # [8, 128]
+            p1 = _lookup(pk1, curc)
+            thr = p1 & 0x1FF
+            feat = (p1 >> 9) & 0x7F
+            dl = (p1 >> 16) & 1
+            nb = ((p1 >> 17) & 0x1FF) - 1
+            packed = select_plane(feat >> 2)
+            fval = (packed >> ((feat & 3) * 8)) & 0xFF
+            gl = (fval <= thr) | ((dl != 0) & (nb >= 0) & (fval == nb))
+            p2 = _lookup(pk2, curc)
+            child = jnp.where(gl, p2 & 0xFFFF, (p2 >> 16) & 0xFFFF) - MAX_NODES
+            return jnp.where(cur >= 0, child, cur)
+
+        nodes = lax.fori_loop(
+            0, max_depth, level, jnp.zeros((8, LANES), jnp.int32)
+        )
+        val = jnp.where(
+            nodes < 0,
+            _lookup(lv, ~jnp.minimum(nodes, -1)),
+            0.0,
+        )
+        col = t % k  # class of tree t (trees interleave classes)
+        out_ref[0] += jnp.where(iota_k == col, val[None, :, :], 0.0)
+        return 0
+
+    lax.fori_loop(0, n_trees, tree_body, 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_trees", "max_depth", "k", "interpret")
+)
+def forest_walk(
+    bins: jnp.ndarray,  # [N_pad, BINS_PACKED] i32 (N_pad % ROW_TILE == 0)
+    tables: ForestTables,
+    *,
+    n_trees: int,
+    max_depth: int,
+    k: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Raw scores [n_tiles, KPAD, 8, 128] (sum of leaf outputs per class;
+    row n of tile i lives at [i, :, n // 128, n % 128])."""
+    n_tiles = bins.shape[0]
+    kernel = functools.partial(
+        _walk_kernel, n_trees=n_trees, max_depth=max_depth, k=k
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((1, BINS_PACKED, 8, LANES), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((n_trees, 2, LANES), lambda i: (0, 0, 0)),
+            pl.BlockSpec((n_trees, 2, LANES), lambda i: (0, 0, 0)),
+            pl.BlockSpec((n_trees, 2, LANES), lambda i: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, KPAD, 8, LANES), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_tiles, KPAD, 8, LANES), jnp.float32),
+        interpret=interpret,
+    )(bins, tables.pk1, tables.pk2, tables.leaf)
+
+
+@functools.partial(jax.jit, static_argnames=("n_pad",))
+def _pack_bins_device(mat_u8: jnp.ndarray, n_pad: int) -> jnp.ndarray:
+    """Device-side bin packing: [N, F] u8 -> [n_tiles, 32, 8, 128] i32."""
+    n, f = mat_u8.shape
+    b = jnp.zeros((n_pad, LANES), jnp.int32)
+    b = b.at[:n, :f].set(mat_u8.astype(jnp.int32))
+    packed = (
+        b[:, 0::4] | (b[:, 1::4] << 8) | (b[:, 2::4] << 16) | (b[:, 3::4] << 24)
+    )  # [n_pad, 32]
+    return packed.reshape(n_pad // ROW_TILE, 8, LANES, BINS_PACKED).transpose(
+        0, 3, 1, 2
+    )
+
+
+def pad_bins_for_walk(bins: np.ndarray) -> jnp.ndarray:
+    """[N, F] int bins -> [n_tiles, BINS_PACKED, 8, 128] i32, 4 bins
+    byte-packed per i32 (feature j in byte j&3 of pack j>>2); row n sits at
+    [n // 1024, :, (n % 1024) // 128, n % 128].  Only the compact u8 matrix
+    crosses host->device (the padded i32 form is 9x bigger — built on
+    device)."""
+    n, f = bins.shape
+    n_pad = (n + ROW_TILE - 1) // ROW_TILE * ROW_TILE
+    # clip: categorical columns may carry an out-of-range unseen-category
+    # sentinel — numeric-only models never read them, but byte packing must
+    # not bleed into neighbors
+    mat_u8 = np.clip(bins, 0, 255).astype(np.uint8)
+    return _pack_bins_device(jnp.asarray(mat_u8), n_pad)
+
+
+def unpack_walk_scores(out: np.ndarray, n: int, k: int) -> np.ndarray:
+    """[n_tiles, KPAD, 8, 128] -> [n, k] row-major scores."""
+    t = out.shape[0]
+    flat = out.transpose(0, 2, 3, 1).reshape(t * ROW_TILE, KPAD)
+    return flat[:n, :k]

@@ -85,6 +85,74 @@ def launch(
     return rc
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def launch_collect(
+    num_processes: int,
+    argv: List[str],
+    coordinator_port: Optional[int] = None,
+    extra_env: Optional[dict] = None,
+    timeout: float = 300.0,
+):
+    """Like ``launch`` but captures each process's stdout (argv includes the
+    interpreter). Returns (first_nonzero_rc, [stdout per process]).
+    Picks a free coordinator port by default so concurrent launches (e.g.
+    parallel test runs) don't collide."""
+    if coordinator_port is None:
+        coordinator_port = _free_port()
+    procs = []
+    for pid in range(num_processes):
+        env = dict(os.environ)
+        env[ENV_COORD] = f"localhost:{coordinator_port}"
+        env[ENV_NPROC] = str(num_processes)
+        env[ENV_PID] = str(pid)
+        env.update(extra_env or {})
+        procs.append(
+            subprocess.Popen(
+                argv,
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    # drain every pipe concurrently: a worker that fills its ~64KB pipe
+    # buffer before a collective would deadlock the whole group if the
+    # parent read the pipes sequentially
+    import threading
+
+    outs = [""] * num_processes
+    rcs = [0] * num_processes
+
+    def drain(i, pr):
+        try:
+            out, _ = pr.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            pr.kill()
+            out, _ = pr.communicate()
+            rcs[i] = 124
+        outs[i] = out or ""
+        if pr.returncode and not rcs[i]:
+            rcs[i] = pr.returncode
+
+    threads = [
+        threading.Thread(target=drain, args=(i, pr))
+        for i, pr in enumerate(procs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rc = next((r for r in rcs if r), 0)
+    return rc, outs
+
+
 def main(args=None) -> None:
     ap = argparse.ArgumentParser(
         description="Run script.py in N coordinated processes"

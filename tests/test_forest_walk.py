@@ -1,0 +1,111 @@
+"""Oracle tests for the Pallas forest-walk predictor (ops/pallas/forest_walk.py)
+against the XLA level-sync walker — run in interpret mode so CPU CI covers
+the kernel body (bit packing, NaN default-left, class interleave).
+
+Reference semantics under test: the fork's PredictTreeBatchAVX512
+(include/LightGBM/tree_avx512.hpp:41) batch walk.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.pallas.forest_walk import (
+    KPAD,
+    build_tables,
+    forest_walk,
+    pad_bins_for_walk,
+    unpack_walk_scores,
+    walk_eligible,
+)
+from lightgbm_tpu.predict import predict_bins_raw
+
+
+def _train(X, y, params, rounds):
+    return lgb.train({**params, "verbosity": -1}, lgb.Dataset(X, y), rounds)
+
+
+def _walk_raw(booster, X, k):
+    mat = booster._bin_input_host(X)
+    recs = booster._bin_records
+    nanb = np.asarray(booster._nan_bins)
+    assert walk_eligible(recs, nanb, mat.shape[1], booster._max_bin_padded)
+    tables = build_tables(recs, nanb)
+    out = forest_walk(
+        pad_bins_for_walk(mat),
+        tables,
+        n_trees=tables.n_trees,
+        max_depth=tables.max_depth,
+        k=k,
+        interpret=True,
+    )
+    return unpack_walk_scores(np.asarray(out), X.shape[0], k)
+
+
+def _xla_raw(booster, X, k):
+    bins = jnp.asarray(booster._bin_input_host(X))
+    batch = booster._stacked_bins(0, len(booster.models_))
+    per_tree = np.asarray(predict_bins_raw(batch, bins, booster._nan_bins))
+    return per_tree.reshape(X.shape[0], -1, k).sum(axis=1)
+
+
+def test_forest_walk_matches_xla_walker_with_nans():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(3000, 7))
+    X[::5, 2] = np.nan
+    y = np.where(np.isnan(X[:, 2]), 1.0, X[:, 0]) + rng.normal(size=3000) * 0.1
+    b = _train(X, y, {"objective": "regression", "num_leaves": 31}, 12)
+    got = _walk_raw(b, X, 1)[:, 0]
+    exp = _xla_raw(b, X, 1)[:, 0]
+    assert np.allclose(got, exp, atol=1e-5)
+
+
+def test_forest_walk_multiclass_interleave():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(2000, 5))
+    y = np.digitize(X[:, 1], [-0.4, 0.4]).astype(float)
+    b = _train(
+        X, y, {"objective": "multiclass", "num_class": 3, "num_leaves": 15}, 6
+    )
+    got = _walk_raw(b, X, 3)
+    exp = _xla_raw(b, X, 3)
+    assert np.allclose(got, exp, atol=1e-5)
+
+
+def test_walk_eligibility_gates():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(3000, 4))
+    y = X[:, 0] + rng.normal(size=3000) * 0.1
+    # bins must fit a byte for the packed layout: a model whose bin space
+    # exceeds 256 must be rejected regardless of observed thresholds
+    b = _train(X, y, {"objective": "regression"}, 3)
+    assert not walk_eligible(
+        b._bin_records, np.asarray(b._nan_bins), X.shape[1], 512
+    )
+    # categorical splits fall back
+    Xc = X.copy()
+    Xc[:, 3] = rng.integers(0, 6, size=3000)
+    yc = (Xc[:, 3] >= 3).astype(float) + X[:, 0] * 0.1
+    bc = _train(
+        Xc, yc, {"objective": "regression", "categorical_feature": [3]}, 3
+    )
+    assert not walk_eligible(
+        bc._bin_records, np.asarray(bc._nan_bins), Xc.shape[1],
+        bc._max_bin_padded,
+    )
+
+
+def test_predict_fast_path_k_guard():
+    # num_class > KPAD must not take the kernel path (classes would be lost)
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(1500, 4))
+    y = rng.integers(0, KPAD + 2, size=1500).astype(float)
+    b = _train(
+        X, y,
+        {"objective": "multiclass", "num_class": KPAD + 2, "num_leaves": 7},
+        2,
+    )
+    p = b.predict(X)
+    assert p.shape == (1500, KPAD + 2)
+    assert np.allclose(p.sum(axis=1), 1.0, atol=1e-5)

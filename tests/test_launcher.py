@@ -72,3 +72,61 @@ def test_two_process_psum(tmp_path):
         cwd=REPO_ROOT,
     )
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+BINSYNC_TMPL = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, "__REPO__")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    import numpy as np
+    from lightgbm_tpu.parallel import init_distributed
+
+    init_distributed()
+    rank = jax.process_index()
+    # each process holds DIFFERENT local rows (pre-partitioned), so local
+    # quantiles disagree unless the mappers are synced
+    rng = np.random.default_rng(100 + rank)
+    X = rng.normal(loc=rank * 3.0, size=(4000, 5))
+    y = X[:, 0] + rng.normal(size=4000)
+    import lightgbm_tpu as lgb
+
+    ds = lgb.Dataset(X, y, params={"pre_partition": True, "max_bin": 63})
+    ds.construct()
+    # mappers must be identical on every process: print a digest the parent
+    # compares across workers
+    import hashlib
+
+    h = hashlib.sha256()
+    for m in ds.bin_mappers:
+        h.update(np.asarray(m.bin_upper_bound).tobytes())
+        h.update(bytes([m.num_bins & 0xFF, m.missing_type & 0xFF]))
+    print(f"MAPPERHASH {h.hexdigest()}")
+    """
+)
+
+
+def test_two_process_binning_sync(tmp_path):
+    """Reference: per-rank binning of a feature slice + mapper allgather
+    (DatasetLoader::ConstructBinMappersFromTextData,
+    src/io/dataset_loader.cpp:1079)."""
+    script = tmp_path / "binsync_worker.py"
+    script.write_text(BINSYNC_TMPL.replace("__REPO__", REPO_ROOT))
+    from lightgbm_tpu.parallel.launcher import launch_collect
+
+    rc, outputs = launch_collect(2, [sys.executable, str(script)])
+    assert rc == 0, outputs
+    digests = []
+    for out in outputs:
+        for line in out.splitlines():
+            if line.startswith("MAPPERHASH"):
+                # other libraries' log writes can interleave mid-line;
+                # the digest is exactly 64 hex chars
+                digests.append(line.split()[1][:64])
+    assert len(digests) == 2, f"expected a digest per worker: {outputs}"
+    assert len(set(digests)) == 1, f"mappers differ across processes: {digests}"
