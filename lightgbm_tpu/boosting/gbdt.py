@@ -1388,15 +1388,15 @@ class Booster:
             kwargs.get("pred_early_stop", self.config.pred_early_stop)
         ) and self._early_stop_type(k) != "none"
         if use_bins:
-            mat = self._bin_input_host(X)
             if not pred_leaf and not es_requested:
                 # fast path: Pallas forest-walk kernel (the fork's
-                # tree_avx512 batch predictor, TPU-shaped) — falls back to
-                # the XLA walker off-TPU or for categorical/wide trees
-                raw_fw = self._forest_walk_raw(mat, X.shape[0], t0, t1, k)
+                # tree_avx512 batch predictor, TPU-shaped) with device-side
+                # binning — falls back to the XLA walker off-TPU or for
+                # categorical/wide trees
+                raw_fw = self._forest_walk_raw(X, t0, t1, k)
                 if raw_fw is not None:
                     return self._finish_predict(raw_fw, t0, t1, k, raw_score)
-            bins = jnp.asarray(mat)
+            bins = jnp.asarray(self._bin_input_host(X))
             batch = self._stacked_bins(t0, t1)
             if pred_leaf:
                 leaves = predict_bins_leaves(batch, bins, self._nan_bins)
@@ -1435,13 +1435,21 @@ class Booster:
             return raw
         return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
 
-    def _forest_walk_raw(self, mat: np.ndarray, n: int, t0, t1, k):
+    def _forest_walk_raw(self, X, t0, t1, k):
         """Raw class scores via the Pallas forest-walk kernel
         (ops/pallas/forest_walk.py — the fork's tree_avx512 batch path,
-        TPU-shaped), or None when ineligible."""
+        TPU-shaped), or None when ineligible.  Binning runs on device
+        when every used feature is numeric (the f32 compare-reduce form of
+        BinMapper::ValueToBin); otherwise the exact host binning feeds the
+        same kernel."""
         import jax as _jax
 
         from ..ops.pallas.forest_walk import (
+            KPAD,
+            _pack_bins_device,
+            ROW_TILE,
+            bin_numeric_device,
+            build_devbin_tables,
             build_tables,
             forest_walk,
             pad_bins_for_walk,
@@ -1449,15 +1457,15 @@ class Booster:
             walk_eligible,
         )
 
-        from ..ops.pallas.forest_walk import KPAD
-
         if _jax.default_backend() != "tpu":
             return None
         if k > KPAD:
             return None  # kernel output is padded to KPAD class columns
+        n = X.shape[0]
+        n_used = len(self.train_set.used_features)
         recs = self._bin_records[t0:t1]
         nanb = np.asarray(self._nan_bins)
-        if not walk_eligible(recs, nanb, mat.shape[1], self._max_bin_padded):
+        if not walk_eligible(recs, nanb, n_used, self._max_bin_padded):
             return None
         key = ("fw", t0, t1, self._model_version)
         if key not in self._stack_cache:
@@ -1466,8 +1474,26 @@ class Booster:
             }
             self._stack_cache[key] = build_tables(recs, nanb)
         tables = self._stack_cache[key]
+
+        dense_np = isinstance(X, np.ndarray) and X.ndim == 2
+        dbt = None
+        if dense_np:
+            if "devbin" not in self._stack_cache:
+                self._stack_cache["devbin"] = build_devbin_tables(
+                    self.train_set.bin_mappers, self.train_set.used_features
+                )
+            dbt = self._stack_cache["devbin"]
+        if dbt is not None:
+            xs = np.ascontiguousarray(
+                X[:, self.train_set.used_features], dtype=np.float32
+            )
+            mat_dev = bin_numeric_device(jnp.asarray(xs), *dbt)
+            n_pad = (n + ROW_TILE - 1) // ROW_TILE * ROW_TILE
+            packed = _pack_bins_device(mat_dev, n_pad)
+        else:
+            packed = pad_bins_for_walk(self._bin_input_host(X))
         out = forest_walk(
-            pad_bins_for_walk(mat),
+            packed,
             tables,
             n_trees=tables.n_trees,
             max_depth=tables.max_depth,
