@@ -208,16 +208,23 @@ def _candidate_for_leaf(
     )
 
 
-def _set_cand(cand: SplitCandidate, idx, new: SplitCandidate, gain_override=None) -> SplitCandidate:
+def _set_cand(
+    cand: SplitCandidate, idx, new: SplitCandidate, gain_override=None, pred=None
+) -> SplitCandidate:
+    """Write `new` into row `idx`; with `pred` the write is value-preserving
+    (old row back when pred is False) so it stays an in-place update with no
+    conditional around it."""
     gain = new.gain if gain_override is None else gain_override
+    vals = (gain, new.feature, new.bin, new.default_left, new.left_g, new.left_h,
+            new.left_cnt, new.right_g, new.right_h, new.right_cnt,
+            new.is_cat, new.cat_mask)
+    if pred is None:
+        return SplitCandidate(*[
+            arr.at[idx].set(val) for arr, val in zip(cand, vals)
+        ])
     return SplitCandidate(*[
-        arr.at[idx].set(val)
-        for arr, val in zip(
-            cand,
-            (gain, new.feature, new.bin, new.default_left, new.left_g, new.left_h,
-             new.left_cnt, new.right_g, new.right_h, new.right_cnt,
-             new.is_cat, new.cat_mask),
-        )
+        arr.at[idx].set(jnp.where(pred, val, arr[idx]))
+        for arr, val in zip(cand, vals)
     ])
 
 
@@ -599,6 +606,18 @@ def grow_tree(
     use_forced_splits = p.n_forced > 0 and forced is not None
 
     def body(t, st: _State) -> _State:
+        """One split step, fully UNCONDITIONAL.
+
+        Round-2 measurement: threading the carry through ``lax.cond``/
+        ``lax.switch`` branches makes XLA materialize defensive copies of
+        every large array a modifying branch touches (~0.45 ms per copy at 1M
+        rows — hist_buf is 22 MB at L=255, the packed seg matrix 0.3 GB at
+        1M).  So instead of an `apply` branch, every state write below is
+        value-preserving under ``~can_split`` (write the old value back at
+        the same index), which keeps each update an in-place
+        dynamic-update-slice on the loop carry with NO conditional in sight.
+        A no-split step degenerates to zero-count partition/histogram work
+        plus O(L·F·B) bookkeeping."""
         norm_leaf = jnp.argmax(st.cand.gain).astype(jnp.int32)
 
         # ---- local candidate for this step: the per-leaf best, or — for the
@@ -684,310 +703,337 @@ def grow_tree(
             c_rh = jnp.where(use_forced, f_rh, c_rh)
             c_rc = jnp.where(use_forced, f_rc, c_rc)
 
-        can_split = c_gain > 0.0
-        done = st.done | ~can_split
+        raw_can = c_gain > 0.0
+        done = st.done | ~raw_can
+        # once any step's best gain is <= 0 it stays <= 0 (cand is frozen),
+        # but gate on st.done anyway so no stale candidate can ever re-split
+        can_split = raw_can & ~st.done
+        nl = (t + 1).astype(jnp.int32)
+        feat, tbin, dl, cis, cmask = c_feat, c_bin, c_dl, c_cis, c_cmask
 
+        # ---- partition rows of leaf l (reference DataPartition::Split) and
+        # histogram the smaller child (serial_tree_learner.cpp:558-583), all
+        # with a zero count when not splitting (value-level no-ops)
         if use_seg:
-            # Hoisted OUT of the cond below: threading the big segment matrix
-            # through conditional branches makes XLA materialize a defensive
-            # copy of it every split (~0.8 ms at 1M rows, measured).  A
-            # zero-count partition/histogram is a value-level no-op, so when
-            # `done` these run harmlessly on an empty window.
-            seg_begin_l = st.leaf_begin[l]
+            begin_l = st.leaf_begin[l]
             seg_cnt_l = jnp.where(can_split, st.leaf_nrows[l], 0)
-            new_order, seg_nl, seg_nr = sort_partition(
+            order, nleft, nright = sort_partition(
                 st.order,
-                seg_begin_l,
+                begin_l,
                 seg_cnt_l,
-                c_feat,
-                c_bin,
-                c_dl.astype(jnp.int32),
-                nan_bins[c_feat],
-                c_cis.astype(jnp.int32),
-                c_cmask.astype(jnp.float32),
+                feat,
+                tbin,
+                dl.astype(jnp.int32),
+                nan_bins[feat],
+                cis.astype(jnp.int32),
+                cmask.astype(jnp.float32),
                 f=f,
                 n_pad=n_pad_seg,
             )
             if p.axis_name is not None:
                 # global smaller-child choice (see gather-mode comment)
-                seg_left_smaller = lax.psum(seg_nl, p.axis_name) <= lax.psum(
-                    seg_nr, p.axis_name
+                left_smaller = lax.psum(nleft, p.axis_name) <= lax.psum(
+                    nright, p.axis_name
                 )
             else:
-                seg_left_smaller = seg_nl <= seg_nr
-            seg_child_start = seg_begin_l + jnp.where(seg_left_smaller, 0, seg_nl)
-            seg_child_cnt = jnp.where(seg_left_smaller, seg_nl, seg_nr)
-            seg_sm = _seg_hist(new_order, seg_child_start, seg_child_cnt)
-            st = st._replace(order=new_order)
-
-        def apply(st: _State) -> _State:
-            l = best_leaf
-            nl = (t + 1).astype(jnp.int32)
-            feat = c_feat
-            tbin = c_bin
-            dl = c_dl
-            cis = c_cis
-            cmask = c_cmask
-
-            # ---- partition rows of leaf l (reference DataPartition::Split)
-            if use_seg:
-                # already partitioned before the cond (see above)
-                begin_l = seg_begin_l
-                order = st.order
-                nleft, nright = seg_nl, seg_nr
-                leaf_id = st.leaf_id
-            elif use_ordered:
-                # stable in-place partition of the parent's contiguous
-                # segment, sized by its capacity bucket — O(parent), not O(N)
-                begin_l = st.leaf_begin[l]
-                cnt_l = st.leaf_nrows[l]
-                pbucket = jnp.clip(
-                    jnp.searchsorted(pcaps_arr, cnt_l, side="left"),
-                    0,
-                    len(pcaps) - 1,
-                ).astype(jnp.int32)
-                order, nleft = lax.switch(
-                    pbucket,
-                    part_branches,
-                    (st.order, begin_l, cnt_l, feat, tbin, dl, cis, cmask),
-                )
-                nright = cnt_l - nleft
-                leaf_id = st.leaf_id
-            else:
-                order = st.order
-                col = lax.dynamic_slice_in_dim(bins_t_cols, feat, 1, axis=0)[0]
-                nb = nan_bins[feat]
-                go_left = (col <= tbin) | (dl & (nb >= 0) & (col == nb))
-                if use_cat:
-                    go_left = jnp.where(
-                        cis, cmask[jnp.minimum(col, Bm - 1)], go_left
-                    )
-                in_leaf = st.leaf_id == l
-                leaf_id = jnp.where(in_leaf & ~go_left, nl, st.leaf_id)
-
-            # ---- record node t (reference Tree::Split, src/io/tree.cpp:65)
-            pg, ph, pc = st.leaf_g[l], st.leaf_h[l], st.leaf_cnt[l]
-            left_child = st.left_child.at[t].set(-(l + 1))
-            right_child = st.right_child.at[t].set(-(nl + 1))
-            par = st.leaf_parent[l]
-            is_r = st.leaf_is_right[l]
-            fix = node_ids == par
-            left_child = jnp.where(fix & (par >= 0) & ~is_r, t, left_child)
-            right_child = jnp.where(fix & (par >= 0) & is_r, t, right_child)
-
-            split_feature = st.split_feature.at[t].set(feat)
-            split_bin = st.split_bin.at[t].set(tbin)
-            split_gain = st.split_gain.at[t].set(c_gain + p.min_gain_to_split)
-            default_left = st.default_left.at[t].set(dl)
-            split_is_cat = st.split_is_cat.at[t].set(cis)
-            node_cat_mask = st.node_cat_mask.at[t].set(cmask)
-            internal_value = st.internal_value.at[t].set(
-                leaf_output(pg, ph, p.lambda_l1, p.lambda_l2, p.max_delta_step)
+                left_smaller = nleft <= nright
+            child_start = begin_l + jnp.where(left_smaller, 0, nleft)
+            child_cnt = jnp.where(left_smaller, nleft, nright)
+            sm = _seg_hist(order, child_start, child_cnt)
+            leaf_id = st.leaf_id
+        elif use_ordered:
+            # stable in-place partition of the parent's contiguous
+            # segment, sized by its capacity bucket — O(parent), not O(N)
+            begin_l = st.leaf_begin[l]
+            cnt_l = jnp.where(can_split, st.leaf_nrows[l], 0)
+            pbucket = jnp.clip(
+                jnp.searchsorted(pcaps_arr, cnt_l, side="left"),
+                0,
+                len(pcaps) - 1,
+            ).astype(jnp.int32)
+            order, nleft = lax.switch(
+                pbucket,
+                part_branches,
+                (st.order, begin_l, cnt_l, feat, tbin, dl, cis, cmask),
             )
-            internal_weight = st.internal_weight.at[t].set(ph)
-            internal_count = st.internal_count.at[t].set(pc)
-
-            # ---- leaf bookkeeping
-            lg, lh, lc = c_lg, c_lh, c_lc
-            rg, rh, rc = c_rg, c_rh, c_rc
-            leaf_g = st.leaf_g.at[l].set(lg).at[nl].set(rg)
-            leaf_h = st.leaf_h.at[l].set(lh).at[nl].set(rh)
-            leaf_cnt = st.leaf_cnt.at[l].set(lc).at[nl].set(rc)
-            d_new = st.leaf_depth[l] + 1
-            leaf_depth = st.leaf_depth.at[l].set(d_new).at[nl].set(d_new)
-            leaf_parent = st.leaf_parent.at[l].set(t).at[nl].set(t)
-            leaf_is_right = st.leaf_is_right.at[l].set(False).at[nl].set(True)
-
-            # ---- histograms: pass over the smaller child only, subtraction
-            # for the sibling (serial_tree_learner.cpp:558-583).  In gather
-            # mode the child's rows are first compacted into a static-capacity
-            # buffer (jnp.nonzero with static size) and the histogram runs
-            # over that buffer — the TPU formulation of the reference's
-            # ordered_gradients gather (rows touched per tree ~ N log L).
-            parent_hist = st.hist_buf[l]
-            if use_seg:
-                left_smaller = seg_left_smaller
-                sm = seg_sm
-            elif use_ordered:
-                if p.axis_name is not None:
-                    # global smaller-child choice + pmax'd capacity bucket so
-                    # every shard histograms the SAME child (see gather-mode
-                    # comment below)
-                    nleft_g = lax.psum(nleft, p.axis_name)
-                    nright_g = lax.psum(nright, p.axis_name)
-                    left_smaller = nleft_g <= nright_g
-                    tc = lax.pmax(
-                        jnp.where(left_smaller, nleft, nright), p.axis_name
-                    )
-                else:
-                    left_smaller = nleft <= nright
-                    tc = jnp.minimum(nleft, nright)
-                child_start = begin_l + jnp.where(left_smaller, 0, nleft)
-                child_cnt = jnp.where(left_smaller, nleft, nright)
-                cbucket = jnp.clip(
-                    jnp.searchsorted(caps_arr, tc, side="left"), 0, len(caps) - 1
-                ).astype(jnp.int32)
-                sm = lax.switch(
-                    cbucket,
-                    hist_branches_ordered,
-                    (order, child_start, child_cnt),
+            nright = cnt_l - nleft
+            leaf_id = st.leaf_id
+            if p.axis_name is not None:
+                # global smaller-child choice + pmax'd capacity bucket so
+                # every shard histograms the SAME child (gather-mode comment)
+                nleft_g = lax.psum(nleft, p.axis_name)
+                nright_g = lax.psum(nright, p.axis_name)
+                left_smaller = nleft_g <= nright_g
+                tc = lax.pmax(
+                    jnp.where(left_smaller, nleft, nright), p.axis_name
                 )
-            elif use_gather:
-                # choose the smaller child by RAW row count (capacity bound);
-                # masked (bagging) stats still flow through lc/rc above
-                rows_l = jnp.sum(in_leaf & go_left).astype(jnp.int32)
-                rows_in = jnp.sum(in_leaf).astype(jnp.int32)
-                rows_r = rows_in - rows_l
-                if p.axis_name is not None:
-                    # the smaller-child choice must be GLOBAL: if shards chose
-                    # locally, some would histogram the left child and others
-                    # the right, and the psum would mix the two (the reference
-                    # decides smaller/larger from global counts too,
-                    # serial_tree_learner.cpp:343).  The capacity bucket is the
-                    # max over shards of the chosen child's LOCAL rows — which
-                    # can exceed local_n/2 on imbalanced shards, hence the
-                    # full_range ladder.
-                    rows_l_g = lax.psum(rows_l, p.axis_name)
-                    rows_r_g = lax.psum(rows_r, p.axis_name)
-                    left_smaller = rows_l_g <= rows_r_g
-                    target = jnp.where(left_smaller, l, nl)
-                    tc = lax.pmax(
-                        jnp.where(left_smaller, rows_l, rows_r), p.axis_name
-                    )
-                else:
-                    left_smaller = rows_l <= rows_r
-                    target = jnp.where(left_smaller, l, nl)
-                    tc = jnp.minimum(rows_l, rows_r)
-                bucket = jnp.clip(
-                    jnp.searchsorted(caps_arr, tc, side="left"), 0, len(caps) - 1
-                ).astype(jnp.int32)
-                sm = lax.switch(bucket, hist_branches, leaf_id == target)
             else:
-                left_smaller = lc <= rc
+                left_smaller = nleft <= nright
+                tc = jnp.minimum(nleft, nright)
+            child_start = begin_l + jnp.where(left_smaller, 0, nleft)
+            child_cnt = jnp.where(left_smaller, nleft, nright)
+            cbucket = jnp.clip(
+                jnp.searchsorted(caps_arr, tc, side="left"), 0, len(caps) - 1
+            ).astype(jnp.int32)
+            sm = lax.switch(
+                cbucket,
+                hist_branches_ordered,
+                (order, child_start, child_cnt),
+            )
+        elif use_gather:
+            # gather mode: the child's rows are compacted into a
+            # static-capacity buffer (jnp.nonzero with static size) and the
+            # histogram runs over that buffer — the TPU formulation of the
+            # reference's ordered_gradients gather (rows/tree ~ N log L)
+            order = st.order
+            begin_l = nleft = nright = jnp.int32(0)
+            col = lax.dynamic_slice_in_dim(bins_t_cols, feat, 1, axis=0)[0]
+            nb = nan_bins[feat]
+            go_left = (col <= tbin) | (dl & (nb >= 0) & (col == nb))
+            if use_cat:
+                go_left = jnp.where(
+                    cis, cmask[jnp.minimum(col, Bm - 1)], go_left
+                )
+            in_leaf = (st.leaf_id == l) & can_split
+            leaf_id = jnp.where(in_leaf & ~go_left, nl, st.leaf_id)
+            # smaller child by RAW row count (capacity bound); masked
+            # (bagging) stats still flow through lc/rc
+            rows_l = jnp.sum(in_leaf & go_left).astype(jnp.int32)
+            rows_in = jnp.sum(in_leaf).astype(jnp.int32)
+            rows_r = rows_in - rows_l
+            if p.axis_name is not None:
+                # the smaller-child choice must be GLOBAL: if shards chose
+                # locally, some would histogram the left child and others
+                # the right, and the psum would mix the two (the reference
+                # decides smaller/larger from global counts too,
+                # serial_tree_learner.cpp:343).  The capacity bucket is the
+                # max over shards of the chosen child's LOCAL rows — which
+                # can exceed local_n/2 on imbalanced shards, hence the
+                # full_range ladder.
+                rows_l_g = lax.psum(rows_l, p.axis_name)
+                rows_r_g = lax.psum(rows_r, p.axis_name)
+                left_smaller = rows_l_g <= rows_r_g
                 target = jnp.where(left_smaller, l, nl)
-                mask = count_mask * (leaf_id == target)
-                sm = leaf_histogram(
-                    bins, grad, hess, mask, B, method=p.hist_method,
-                    axis_name=p.axis_name, quant_scales=quant_scales,
+                tc = lax.pmax(
+                    jnp.where(left_smaller, rows_l, rows_r), p.axis_name
                 )
-            other = parent_hist - sm
-            left_hist = jnp.where(left_smaller, sm, other)
-            right_hist = jnp.where(left_smaller, other, sm)
-            hist_buf = st.hist_buf.at[l].set(left_hist).at[nl].set(right_hist)
+            else:
+                left_smaller = rows_l <= rows_r
+                target = jnp.where(left_smaller, l, nl)
+                tc = jnp.minimum(rows_l, rows_r)
+            bucket = jnp.clip(
+                jnp.searchsorted(caps_arr, tc, side="left"), 0, len(caps) - 1
+            ).astype(jnp.int32)
+            sm = lax.switch(bucket, hist_branches, (leaf_id == target) & can_split)
+        else:
+            order = st.order
+            begin_l = nleft = nright = jnp.int32(0)
+            leaf_id = st.leaf_id
+            col = lax.dynamic_slice_in_dim(bins_t_cols, feat, 1, axis=0)[0]
+            nb = nan_bins[feat]
+            go_left = (col <= tbin) | (dl & (nb >= 0) & (col == nb))
+            if use_cat:
+                go_left = jnp.where(
+                    cis, cmask[jnp.minimum(col, Bm - 1)], go_left
+                )
+            in_leaf = (st.leaf_id == l) & can_split
+            leaf_id = jnp.where(in_leaf & ~go_left, nl, st.leaf_id)
+            left_smaller = c_lc <= c_rc
+            target = jnp.where(left_smaller, l, nl)
+            mask = count_mask * (leaf_id == target) * can_split
+            sm = leaf_histogram(
+                bins, grad, hess, mask, B, method=p.hist_method,
+                axis_name=p.axis_name, quant_scales=quant_scales,
+            )
 
-            # ---- monotone bounds for the children (BasicConstraint,
-            # monotone_constraints.hpp:465 — split midpoint partitions the
-            # parent's output interval)
-            leaf_lb, leaf_ub = st.leaf_lb, st.leaf_ub
-            lb_par, ub_par = st.leaf_lb[l], st.leaf_ub[l]
-            out_l_c = out_r_c = None
+        def _set1(arr, idx, val):
+            """Value-preserving write: old value back when not splitting."""
+            return arr.at[idx].set(jnp.where(can_split, val, arr[idx]))
+
+        # ---- record node t (reference Tree::Split, src/io/tree.cpp:65)
+        pg, ph, pc = st.leaf_g[l], st.leaf_h[l], st.leaf_cnt[l]
+        left_child = _set1(st.left_child, t, -(l + 1))
+        right_child = _set1(st.right_child, t, -(nl + 1))
+        par = st.leaf_parent[l]
+        is_r = st.leaf_is_right[l]
+        fix = (node_ids == par) & (par >= 0) & can_split
+        left_child = jnp.where(fix & ~is_r, t, left_child)
+        right_child = jnp.where(fix & is_r, t, right_child)
+
+        split_feature = _set1(st.split_feature, t, feat)
+        split_bin = _set1(st.split_bin, t, tbin)
+        split_gain = _set1(st.split_gain, t, c_gain + p.min_gain_to_split)
+        default_left = _set1(st.default_left, t, dl)
+        split_is_cat = _set1(st.split_is_cat, t, cis)
+        node_cat_mask = _set1(st.node_cat_mask, t, cmask)
+        internal_value = _set1(
+            st.internal_value,
+            t,
+            leaf_output(pg, ph, p.lambda_l1, p.lambda_l2, p.max_delta_step),
+        )
+        internal_weight = _set1(st.internal_weight, t, ph)
+        internal_count = _set1(st.internal_count, t, pc)
+
+        # ---- leaf bookkeeping
+        lg, lh, lc = c_lg, c_lh, c_lc
+        rg, rh, rc = c_rg, c_rh, c_rc
+        leaf_g = _set1(_set1(st.leaf_g, l, lg), nl, rg)
+        leaf_h = _set1(_set1(st.leaf_h, l, lh), nl, rh)
+        leaf_cnt = _set1(_set1(st.leaf_cnt, l, lc), nl, rc)
+        d_new = st.leaf_depth[l] + 1
+        leaf_depth = _set1(_set1(st.leaf_depth, l, d_new), nl, d_new)
+        leaf_parent = _set1(_set1(st.leaf_parent, l, t), nl, t)
+        leaf_is_right = _set1(
+            _set1(st.leaf_is_right, l, jnp.asarray(False)), nl, jnp.asarray(True)
+        )
+
+        # ---- histograms: smaller child measured, sibling by subtraction
+        parent_hist = st.hist_buf[l]
+        other = parent_hist - sm
+        left_hist = jnp.where(left_smaller, sm, other)
+        right_hist = jnp.where(left_smaller, other, sm)
+        hist_buf = st.hist_buf.at[l].set(
+            jnp.where(can_split, left_hist, parent_hist)
+        )
+        hist_buf = hist_buf.at[nl].set(
+            jnp.where(can_split, right_hist, st.hist_buf[nl])
+        )
+
+        # ---- monotone bounds for the children (BasicConstraint,
+        # monotone_constraints.hpp:465 — split midpoint partitions the
+        # parent's output interval)
+        leaf_lb, leaf_ub = st.leaf_lb, st.leaf_ub
+        lb_par, ub_par = st.leaf_lb[l], st.leaf_ub[l]
+        if use_mono:
+            out_l_c = jnp.clip(
+                leaf_output(lg, lh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
+                lb_par, ub_par,
+            )
+            out_r_c = jnp.clip(
+                leaf_output(rg, rh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
+                lb_par, ub_par,
+            )
+            mc_f = mono_arr[feat]
+            mid = 0.5 * (out_l_c + out_r_c)
+            lb_l = jnp.where(mc_f < 0, mid, lb_par)
+            ub_l = jnp.where(mc_f > 0, mid, ub_par)
+            lb_r = jnp.where(mc_f > 0, mid, lb_par)
+            ub_r = jnp.where(mc_f < 0, mid, ub_par)
+            leaf_lb = _set1(_set1(st.leaf_lb, l, lb_l), nl, lb_r)
+            leaf_ub = _set1(_set1(st.leaf_ub, l, ub_l), nl, ub_r)
+        else:
+            lb_l = ub_l = lb_r = ub_r = None
+
+        # path-used features for interaction constraints
+        leaf_allowed = st.leaf_allowed
+        if p.use_interaction:
+            new_used = st.leaf_allowed[l] | (
+                jnp.arange(f, dtype=jnp.int32) == feat
+            )
+            leaf_allowed = _set1(_set1(st.leaf_allowed, l, new_used), nl, new_used)
+            used_l = used_r = new_used
+        else:
+            used_l = used_r = root_used
+
+        cegb_used_new = (
+            st.cegb_used.at[feat].set(st.cegb_used[feat] | can_split)
+            if use_cegb
+            else st.cegb_used
+        )
+
+        # ---- refresh split candidates for the two children in ONE vmapped
+        # best_split (halves the per-split fixed scan cost vs two calls)
+        hist2 = jnp.stack([left_hist, right_hist])
+        g2 = jnp.stack([lg, rg])
+        h2 = jnp.stack([lh, rh])
+        c2 = jnp.stack([lc, rc])
+        fm2 = jnp.stack(
+            [node_feature_mask(2 * t + 1, used_l),
+             node_feature_mask(2 * t + 2, used_r)]
+        )
+        po2 = leaf_output(g2, h2, p.lambda_l1, p.lambda_l2, p.max_delta_step)
+        opt2 = []
+        if use_mono:
+            opt2 += [jnp.stack([lb_l, lb_r]), jnp.stack([ub_l, ub_r])]
+        use_rand = p.extra_trees and rng is not None
+        if use_rand:
+            opt2 += [
+                jnp.stack(
+                    [node_rand_bins(2 * t + 1), node_rand_bins(2 * t + 2)]
+                )
+            ]
+        cpen = _cegb_pen(cegb_used_new)
+
+        def _child_cand(hist, g_, h_, c_, fm, po, *rest):
+            lbv = ubv = rbv = None
+            i = 0
             if use_mono:
-                out_l_c = jnp.clip(
-                    leaf_output(lg, lh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
-                    lb_par, ub_par,
-                )
-                out_r_c = jnp.clip(
-                    leaf_output(rg, rh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
-                    lb_par, ub_par,
-                )
-                mc_f = mono_arr[feat]
-                mid = 0.5 * (out_l_c + out_r_c)
-                lb_l = jnp.where(mc_f < 0, mid, lb_par)
-                ub_l = jnp.where(mc_f > 0, mid, ub_par)
-                lb_r = jnp.where(mc_f > 0, mid, lb_par)
-                ub_r = jnp.where(mc_f < 0, mid, ub_par)
-                leaf_lb = st.leaf_lb.at[l].set(lb_l).at[nl].set(lb_r)
-                leaf_ub = st.leaf_ub.at[l].set(ub_l).at[nl].set(ub_r)
-            else:
-                lb_l = ub_l = lb_r = ub_r = None
-
-            # path-used features for interaction constraints
-            leaf_allowed = st.leaf_allowed
-            if p.use_interaction:
-                new_used = st.leaf_allowed[l] | (
-                    jnp.arange(f, dtype=jnp.int32) == feat
-                )
-                leaf_allowed = st.leaf_allowed.at[l].set(new_used).at[nl].set(new_used)
-                used_l = used_r = new_used
-            else:
-                used_l = used_r = root_used
-
-            cegb_used_new = (
-                st.cegb_used.at[feat].set(True) if use_cegb else st.cegb_used
-            )
-
-            # ---- refresh split candidates for the two children
-            cand_l = _candidate_for_leaf(
-                left_hist, lg, lh, lc, num_bins, nan_bins,
-                node_feature_mask(2 * t + 1, used_l), p,
+                lbv, ubv = rest[0], rest[1]
+                i = 2
+            if use_rand:
+                rbv = rest[i]
+            return _candidate_for_leaf(
+                hist, g_, h_, c_, num_bins, nan_bins, fm, p,
                 monotone=mono_arr,
-                lb=lb_l if use_mono else None,
-                ub=ub_l if use_mono else None,
-                parent_output=leaf_output(lg, lh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
+                lb=lbv,
+                ub=ubv,
+                parent_output=po,
                 is_cat=is_cat_arr,
-                cegb_penalty=_cegb_pen(cegb_used_new),
-                rand_bins=node_rand_bins(2 * t + 1),
-            )
-            cand_r = _candidate_for_leaf(
-                right_hist, rg, rh, rc, num_bins, nan_bins,
-                node_feature_mask(2 * t + 2, used_r), p,
-                monotone=mono_arr,
-                lb=lb_r if use_mono else None,
-                ub=ub_r if use_mono else None,
-                parent_output=leaf_output(rg, rh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
-                is_cat=is_cat_arr,
-                cegb_penalty=_cegb_pen(cegb_used_new),
-                rand_bins=node_rand_bins(2 * t + 2),
-            )
-            depth_ok = (p.max_depth <= 0) | (d_new < p.max_depth)
-            cand = _set_cand(
-                st.cand, l, cand_l, jnp.where(depth_ok, cand_l.gain, -jnp.inf)
-            )
-            cand = _set_cand(
-                cand, nl, cand_r, jnp.where(depth_ok, cand_r.gain, -jnp.inf)
+                cegb_penalty=cpen,
+                rand_bins=rbv,
             )
 
-            if use_ordered or use_seg:
-                leaf_begin = st.leaf_begin.at[nl].set(begin_l + nleft)
-                leaf_nrows = st.leaf_nrows.at[l].set(nleft).at[nl].set(nright)
-            else:
-                leaf_begin, leaf_nrows = st.leaf_begin, st.leaf_nrows
+        cand2 = jax.vmap(_child_cand)(hist2, g2, h2, c2, fm2, po2, *opt2)
+        cand_l = SplitCandidate(*[a[0] for a in cand2])
+        cand_r = SplitCandidate(*[a[1] for a in cand2])
+        depth_ok = (p.max_depth <= 0) | (d_new < p.max_depth)
+        cand = _set_cand(
+            st.cand, l, cand_l,
+            jnp.where(depth_ok, cand_l.gain, -jnp.inf), pred=can_split,
+        )
+        cand = _set_cand(
+            cand, nl, cand_r,
+            jnp.where(depth_ok, cand_r.gain, -jnp.inf), pred=can_split,
+        )
 
-            return _State(
-                leaf_id=leaf_id,
-                order=order,
-                leaf_begin=leaf_begin,
-                leaf_nrows=leaf_nrows,
-                hist_buf=hist_buf,
-                leaf_g=leaf_g,
-                leaf_h=leaf_h,
-                leaf_cnt=leaf_cnt,
-                leaf_depth=leaf_depth,
-                leaf_parent=leaf_parent,
-                leaf_is_right=leaf_is_right,
-                leaf_lb=leaf_lb,
-                leaf_ub=leaf_ub,
-                leaf_allowed=leaf_allowed,
-                cand=cand,
-                split_feature=split_feature,
-                split_bin=split_bin,
-                split_gain=split_gain,
-                default_left=default_left,
-                split_is_cat=split_is_cat,
-                node_cat_mask=node_cat_mask,
-                left_child=left_child,
-                right_child=right_child,
-                internal_value=internal_value,
-                internal_weight=internal_weight,
-                internal_count=internal_count,
-                num_leaves=st.num_leaves + 1,
-                done=done,
-                forced_ok=st.forced_ok,
-                cegb_used=cegb_used_new,
-            )
+        if use_ordered or use_seg:
+            leaf_begin = _set1(st.leaf_begin, nl, begin_l + nleft)
+            leaf_nrows = _set1(_set1(st.leaf_nrows, l, nleft), nl, nright)
+        else:
+            leaf_begin, leaf_nrows = st.leaf_begin, st.leaf_nrows
 
-        st = lax.cond(done, lambda s: s._replace(done=done), apply, st)
-        return st._replace(forced_ok=forced_ok_next)
+        return _State(
+            leaf_id=leaf_id,
+            order=order,
+            leaf_begin=leaf_begin,
+            leaf_nrows=leaf_nrows,
+            hist_buf=hist_buf,
+            leaf_g=leaf_g,
+            leaf_h=leaf_h,
+            leaf_cnt=leaf_cnt,
+            leaf_depth=leaf_depth,
+            leaf_parent=leaf_parent,
+            leaf_is_right=leaf_is_right,
+            leaf_lb=leaf_lb,
+            leaf_ub=leaf_ub,
+            leaf_allowed=leaf_allowed,
+            cand=cand,
+            split_feature=split_feature,
+            split_bin=split_bin,
+            split_gain=split_gain,
+            default_left=default_left,
+            split_is_cat=split_is_cat,
+            node_cat_mask=node_cat_mask,
+            left_child=left_child,
+            right_child=right_child,
+            internal_value=internal_value,
+            internal_weight=internal_weight,
+            internal_count=internal_count,
+            num_leaves=st.num_leaves + can_split.astype(jnp.int32),
+            done=done,
+            forced_ok=forced_ok_next,
+            cegb_used=cegb_used_new,
+        )
 
     with jax.named_scope("leaf_loop"):
         state = lax.fori_loop(0, L - 1, body, state)

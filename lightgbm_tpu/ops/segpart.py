@@ -58,7 +58,7 @@ def _go_left(colv, tbin, dl, nanb, iscat, catmask):
 @functools.partial(
     jax.jit, static_argnames=("f", "n_pad")
 )
-def sort_partition(
+def sort_partition_xla(
     seg: jnp.ndarray,  # [LANES, n_pad] i16 packed rows, PLANE-MAJOR — the
     #                    layout XLA assigns this loop carry anyway; storing it
     #                    that way avoids full-array relayout copies per split
@@ -133,6 +133,34 @@ def sort_partition(
     )
     nr = cnt - nl
     return seg_new, nl, nr
+
+
+def sort_partition(
+    seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask, *, f: int, n_pad: int
+):
+    """Platform dispatch for the segment partition: the Pallas streaming
+    kernel on TPU (ops/pallas/partition.py — exact window, in place, no
+    defensive copies), the stable-sort formulation elsewhere.  Both are
+    stable partitions with bit-identical results."""
+    from .pallas.partition import seg_partition_pallas
+
+    def _pallas(seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask):
+        bm = catmask.shape[0]
+        catm = jnp.zeros((1, 256), jnp.float32)
+        catm = catm.at[0, :bm].set(catmask.astype(jnp.float32))
+        scal = jnp.stack(
+            [sbegin, cnt, feat, tbin, dl, nanb, iscat, jnp.int32(0)]
+        ).astype(jnp.int32)
+        seg_new, nl = seg_partition_pallas(
+            seg, scal, catm, f=f, n_pad=n_pad, use_cat=bm > 1
+        )
+        return seg_new, nl, cnt - nl
+
+    return jax.lax.platform_dependent(
+        seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask,
+        tpu=_pallas,
+        default=functools.partial(sort_partition_xla, f=f, n_pad=n_pad),
+    )
 
 
 def leaf_of_positions(

@@ -1,0 +1,65 @@
+"""Oracle tests for the Pallas streaming partition kernel
+(ops/pallas/partition.py) against the stable-sort partition it replaces.
+
+The kernel must be BIT-IDENTICAL to ops/segpart.sort_partition (both are
+stable partitions of the same window), including untouched neighbors.
+Reference semantics: DataPartition::Split (src/treelearner/data_partition.hpp:101).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.pallas.partition import seg_partition_pallas
+from lightgbm_tpu.ops.pallas.seg import pack_rows, padded_rows
+from lightgbm_tpu.ops.segpart import sort_partition_xla
+
+
+@pytest.fixture(scope="module", params=[11, 28])
+def packed(request):
+    rng = np.random.default_rng(7)
+    f, n = request.param, 5000
+    n_pad = padded_rows(n)
+    bins = rng.integers(0, 256, size=(n, f)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.random(n).astype(np.float32) + 0.5
+    m = (rng.random(n) < 0.8).astype(np.float32)
+    seg = pack_rows(
+        jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m), n_pad
+    )
+    catmask = (rng.random(256) < 0.5).astype(np.float32)
+    return dict(f=f, n=n, n_pad=n_pad, seg=seg, catmask=catmask)
+
+
+@pytest.mark.parametrize(
+    "sb,cnt,feat,tbin,dl,nanb,iscat",
+    [
+        (0, 5000, 3, 120, 0, -1, 0),  # root, multi-tile
+        (17, 3000, 5, 80, 1, 200, 0),  # unaligned begin, NaN default-left
+        (1000, 37, 2, 128, 0, -1, 0),  # tiny segment within one tile
+        (513, 1029, 7, 30, 0, -1, 1),  # categorical
+        (5, 600, 1, 255, 0, -1, 0),  # all-left
+        (9, 600, 1, -1, 0, -1, 0),  # all-right
+        (4000, 1000, 10, 100, 0, -1, 0),  # tail of the array
+        (130, 255, 4, 100, 0, -1, 0),  # offset > 128 alignment fold
+        (333, 0, 0, 10, 0, -1, 0),  # empty window (done step)
+        (256, 512, 6, 100, 0, -1, 0),  # exactly tile-aligned window
+    ],
+)
+def test_partition_kernel_matches_sort(packed, sb, cnt, feat, tbin, dl, nanb, iscat):
+    p = packed
+    if feat >= p["f"]:
+        feat = feat % p["f"]
+    catm = jnp.asarray(p["catmask"]).reshape(1, 256)
+    scal = jnp.asarray([sb, cnt, feat, tbin, dl, nanb, iscat, 0], jnp.int32)
+    got, nl_k = seg_partition_pallas(
+        p["seg"], scal, catm, f=p["f"], n_pad=p["n_pad"],
+        use_cat=True, interpret=True,
+    )
+    want, nl_s, _ = sort_partition_xla(
+        p["seg"], jnp.int32(sb), jnp.int32(cnt), jnp.int32(feat),
+        jnp.int32(tbin), jnp.int32(dl), jnp.int32(nanb), jnp.int32(iscat),
+        jnp.asarray(p["catmask"]), f=p["f"], n_pad=p["n_pad"],
+    )
+    assert int(nl_k) == int(nl_s)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
