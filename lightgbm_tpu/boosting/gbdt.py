@@ -310,6 +310,8 @@ class Booster:
         # weight-0 rows so shards stay equal-sized (static shapes).
         self._mesh = None
         self._pad_rows = 0
+        self._multiproc = False  # process-local rows (pre_partition multi-host)
+        self._proc_row_offset = 0
         if cfg.tree_learner in ("data", "feature", "voting"):
             from jax.sharding import Mesh
 
@@ -323,17 +325,77 @@ class Booster:
                 devices = devices[:dn] if dn > 1 else None
             if devices is not None:
                 self._mesh = Mesh(np.array(devices), (DATA_AXIS,))
-                self._pad_rows = (-n) % len(devices)
+                nproc = jax.process_count()
+                if nproc > 1 and cfg.pre_partition:
+                    # ---- process-local data feeding (reference: each machine
+                    # loads only its partition under pre_partition,
+                    # src/io/dataset_loader.cpp:210; distributed binning sync
+                    # already ran at Dataset.construct).  Every per-row array
+                    # is built from LOCAL rows and placed with
+                    # make_array_from_process_local_data — no process ever
+                    # holds the global matrix.  Local rows are weight-0
+                    # padded to a common per-process width so shards stay
+                    # equal-sized (static shapes).
+                    from jax.experimental import multihost_utils
+
+                    self._multiproc = True
+                    if cfg.linear_tree:
+                        raise ValueError(
+                            "linear_tree is not supported with multi-process "
+                            "pre_partition training"
+                        )
+                    pidx = jax.process_index()
+                    nloc_dev = len(
+                        [d for d in devices if d.process_index == pidx]
+                    )
+                    counts = multihost_utils.process_allgather(
+                        np.asarray([n], np.int64)
+                    ).reshape(-1)
+                    if self.objective is not None and self.objective.need_query:
+                        if int(counts.max()) != int(counts.min()) or n % nloc_dev:
+                            raise ValueError(
+                                "ranking with pre_partition needs equal "
+                                "per-process row counts divisible by the "
+                                "local device count (queries cannot be "
+                                "weight-0 padded)"
+                            )
+                    lpad = -(-int(counts.max()) // nloc_dev) * nloc_dev
+                    self._pad_rows = lpad - n
+                    self._proc_row_counts = counts
+                    self._proc_row_offset = int(counts[:pidx].sum())
+                    self._n_global = int(counts.sum())
+                    self._n_dev_global = lpad * nproc
+                else:
+                    self._pad_rows = (-n) % len(devices)
         pad = self._pad_rows
-        n_dev = n + pad  # device-side row count (>= n)
+        n_dev = n + pad  # LOCAL device rows (== global when single-process)
 
         # the objective is initialized on the UNPADDED data so its host-side
         # statistics (class priors, is_unbalance weights, percentiles) are
         # exact; only its per-row DEVICE arrays get padded + mesh-placed below
         if self.objective is not None:
-            self.objective.init(
-                md.label, md.weight, md.query_boundaries, md.position
-            )
+            if self._multiproc and not self.objective.need_query:
+                # global host statistics (reference: Network::Allreduce inside
+                # ObtainAutomaticInitialScore / label-count sync): gather the
+                # label/weight COLUMNS across processes — O(8 bytes/row),
+                # negligible next to the bin matrix which stays local.  The
+                # per-row device arrays are re-sliced to local rows below.
+                # Ranking objectives skip this: their init statistics are
+                # per-query and queries never straddle processes.
+                from ..parallel import allgather_host_varlen
+
+                glabel = allgather_host_varlen(np.asarray(md.label))
+                gweight = (
+                    allgather_host_varlen(np.asarray(md.weight))
+                    if md.weight is not None
+                    else None
+                )
+                self._gathered_label = glabel  # reused by pos/neg bagging
+                self.objective.init(glabel, gweight, None, None)
+            else:
+                self.objective.init(
+                    md.label, md.weight, md.query_boundaries, md.position
+                )
             self.num_class = self.objective.num_class
         else:
             self.num_class = max(1, cfg.num_class)
@@ -358,8 +420,11 @@ class Booster:
         if self._mesh is not None:
             from ..parallel import pad_rows_np, shard_cols, shard_rows
 
-            self._score = shard_cols(init, self._mesh)
-            self._bins = shard_rows(pad_rows_np(train_set.bins, pad), self._mesh)
+            self._score = shard_cols(init, self._mesh, process_local=self._multiproc)
+            self._bins = shard_rows(
+                pad_rows_np(train_set.bins, pad), self._mesh,
+                process_local=self._multiproc,
+            )
             # the objective's per-row device arrays ride the same sharding as
             # the score (zero-padded; padded rows' gradients are zeroed
             # explicitly in _sample — NOT via synthetic weights, which would
@@ -371,6 +436,11 @@ class Booster:
                     if arr is None:
                         continue
                     a = np.asarray(arr, dtype=np.float32)
+                    if self._multiproc and a.shape[axis] == self._n_global:
+                        # global-statistics init left global-length arrays on
+                        # the objective: keep only this process's rows
+                        off = self._proc_row_offset
+                        a = np.take(a, np.arange(off, off + n), axis=axis)
                     if pad:
                         widths = [(0, 0)] * a.ndim
                         widths[axis] = (0, pad)
@@ -378,9 +448,9 @@ class Booster:
                     setattr(
                         holder,
                         name,
-                        shard_rows(a, self._mesh)
+                        shard_rows(a, self._mesh, process_local=self._multiproc)
                         if axis == 0
-                        else shard_cols(a, self._mesh),
+                        else shard_cols(a, self._mesh, process_local=self._multiproc),
                     )
         else:
             self._score = jnp.asarray(init)
@@ -413,7 +483,9 @@ class Booster:
 
             base = np.ones(n_dev, np.float32)
             base[n:] = 0.0
-            self._ones_mask = shard_rows(base, self._mesh)
+            self._ones_mask = shard_rows(
+                base, self._mesh, process_local=self._multiproc
+            )
             self._setup_sharded_grower()
         else:
             self._ones_mask = jnp.ones((n,), jnp.float32)
@@ -423,18 +495,47 @@ class Booster:
 
         from .sampling import create_sample_strategy
 
+        # the sampler draws GLOBAL-width masks (every process runs the same
+        # rng program, so the bagging subset is consistent across shards)
+        n_sampler = self._n_dev_global if self._multiproc else n_dev
         is_pos = None
         if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
-            ip = np.asarray(md.label) > 0
-            if pad:
-                ip = np.concatenate([ip, np.zeros(pad, bool)])
-            is_pos = jnp.asarray(ip)
-        self._sampler = create_sample_strategy(cfg, n_dev, is_pos)
+            if self._multiproc:
+                from ..parallel import allgather_host_varlen
 
-        # metrics for the training set
-        self._train_entry = _EvalEntry(
-            "training", train_set, self._create_metrics()
-        )
+                lpad = n_dev
+                gl = getattr(self, "_gathered_label", None)
+                if gl is None:
+                    gl = allgather_host_varlen(np.asarray(md.label))
+                gl = gl > 0
+                blocks, o = [], 0
+                for c in self._proc_row_counts:
+                    blocks.append(gl[o : o + int(c)])
+                    blocks.append(np.zeros(lpad - int(c), bool))
+                    o += int(c)
+                ip = np.concatenate(blocks)
+            else:
+                ip = np.asarray(md.label) > 0
+                if pad:
+                    ip = np.concatenate([ip, np.zeros(pad, bool)])
+            is_pos = jnp.asarray(ip)
+        self._sampler = create_sample_strategy(cfg, n_sampler, is_pos)
+        self._gathered_label = None  # free the init-time global label copy
+
+        # metrics for the training set.  Multi-process pre_partition: metric
+        # aggregation across processes is not wired yet — train with
+        # metric='none' and evaluate on a loaded model instead (the reference
+        # evaluates rank-locally too, metric.cpp is per-machine).
+        train_metrics = self._create_metrics()
+        if self._multiproc and train_metrics:
+            from ..utils.log import log_warning
+
+            log_warning(
+                "training metrics are disabled under multi-process "
+                "pre_partition training (per-process rows only)"
+            )
+            train_metrics = []
+        self._train_entry = _EvalEntry("training", train_set, train_metrics)
         for m in self._train_entry.metrics:
             m.init(md.label, md.weight, md.query_boundaries)
         self._class_need_train = [
@@ -855,6 +956,11 @@ class Booster:
         return out
 
     def add_valid(self, data: Dataset, name: str) -> "Booster":
+        if getattr(self, "_multiproc", False):
+            raise ValueError(
+                "validation sets are not supported under multi-process "
+                "pre_partition training; evaluate the saved model per process"
+            )
         data.construct()
         entry = _EvalEntry(name, data, self._create_metrics())
         md = data.metadata
@@ -940,14 +1046,19 @@ class Booster:
         Padded rows' gradients are forced to exact zeros FIRST — objectives
         compute unspecified (finite or NaN) values on the zero-filled padding
         labels, and a NaN would poison the masked histogram (nan*0=nan)."""
-        if self._pad_rows:
+        # the gate must be PROCESS-INVARIANT: under multi-process feeding a
+        # per-process `_pad_rows` test would make processes issue different
+        # op sequences on the same global arrays (SPMD violation — only some
+        # processes reaching the next collective deadlocks the cluster)
+        any_pad = bool(self._pad_rows) or getattr(self, "_multiproc", False)
+        if any_pad:
             live = self._ones_mask[None] > 0
             grad = jnp.where(live, grad, 0.0)
             hess = jnp.where(live, hess, 0.0)
         mask, grad, hess = self._sampler.sample(
             self._iter, grad, hess, self._next_rng()
         )
-        if self._pad_rows:
+        if any_pad:
             mask = mask * self._ones_mask
         return mask, grad, hess
 
@@ -1006,6 +1117,11 @@ class Booster:
                             entry.score = entry.score.at[kk].add(s)
             grad, hess = self.objective.get_gradients(self._score, self._next_rng())
         else:
+            if self._multiproc:
+                raise ValueError(
+                    "custom fobj is not supported under multi-process "
+                    "pre_partition training (scores are process-sharded)"
+                )
             g, h = fobj(
                 np.asarray(self._score)[:, :n].reshape(-1)
                 if k > 1

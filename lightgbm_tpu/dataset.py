@@ -426,7 +426,7 @@ class Dataset:
             return
         if nproc <= 1:
             return
-        from jax.experimental import multihost_utils
+        from .parallel import allgather_host_exact
 
         f = len(self.bin_mappers)
         rank = jax.process_index()
@@ -440,9 +440,11 @@ class Dataset:
         lo, hi = rank * per, min(f, (rank + 1) * per)
         for j in range(lo, hi):
             local[j] = self.bin_mappers[j].to_vector(width)
-        gathered = np.asarray(
-            multihost_utils.process_allgather(local)
-        )  # [nproc, F, W]
+        # bit-exact gather: boundaries are float64 and a lossy f32 roundtrip
+        # would bin train rows differently per... identically-wrong on every
+        # process, but differently from single-process binning of the same
+        # sample (observed: 1e-35 -> 1.00000002e-35)
+        gathered = allgather_host_exact(local)  # [nproc, F, W]
         mappers: List[BinMapper] = []
         for j in range(f):
             owner = min(j // per, nproc - 1)

@@ -76,7 +76,24 @@ class GrowerParams:
     # masked pass over all rows per split.
     hist_mode: str = "ordered"
     path_smooth: float = 0.0
-    use_monotone: bool = False  # monotone_constraints (basic method)
+    use_monotone: bool = False  # monotone_constraints
+    # "basic": children bounded by the split midpoint (BasicLeafConstraints,
+    # monotone_constraints.hpp:465).  "intermediate": bounds propagate to
+    # CONTIGUOUS leaves across the split plane and affected leaves' cached
+    # candidates are refreshed (IntermediateLeafConstraints, :516) — the
+    # recursive GoUp/GoDownToFindLeavesToUpdate tree walk becomes a
+    # vectorized box-adjacency test over per-leaf feature-range boxes
+    # [L, F, 2]: leaf b is updated from new child c iff their boxes TOUCH
+    # along exactly the one monotone feature separating them and intersect
+    # along every other feature (equivalent: the walk ascends to the lowest
+    # common ancestor — whose split feature is the unique separating one —
+    # and the descent pruning keeps exactly the box-intersecting leaves).
+    monotone_method: str = "basic"
+    # candidate refreshes per split for bound-tightened leaves (intermediate
+    # mode); leaves beyond the K stalest keep their cached candidate until
+    # their next natural refresh (outputs are still clamped to the live
+    # bounds, so monotonicity never depends on this)
+    monotone_recompute_k: int = 8
     use_interaction: bool = False  # interaction_constraints
     feature_fraction_bynode: float = 1.0
     extra_trees: bool = False  # one random threshold per feature (USE_RAND)

@@ -111,15 +111,74 @@ def make_mesh(n_devices: Optional[int] = None, axis_name: str = DATA_AXIS) -> Me
     return Mesh(np.array(devices), (axis_name,))
 
 
-def shard_rows(arr, mesh: Mesh, axis_name: str = DATA_AXIS):
-    """Place a host array with rows sharded over the mesh axis."""
+def shard_rows(
+    arr, mesh: Mesh, axis_name: str = DATA_AXIS, process_local: bool = False
+):
+    """Place a host array with rows sharded over the mesh axis.
+
+    ``process_local=True``: ``arr`` holds only THIS process's rows and the
+    global array is their concatenation in process order — the reference's
+    ``pre_partition`` contract (each machine loads its own partition,
+    src/io/dataset_loader.cpp:210) via
+    ``jax.make_array_from_process_local_data``; no process ever materializes
+    the global matrix."""
     spec = P(axis_name, *([None] * (np.ndim(arr) - 1)))
+    if process_local and jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), np.asarray(arr)
+        )
     return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, spec))
 
 
-def shard_cols(arr, mesh: Mesh, axis_name: str = DATA_AXIS):
+def shard_cols(
+    arr, mesh: Mesh, axis_name: str = DATA_AXIS, process_local: bool = False
+):
     """Place a host [K, N] array with COLUMNS (rows of the data) sharded."""
+    if process_local and jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P(None, axis_name)), np.asarray(arr)
+        )
     return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, P(None, axis_name)))
+
+
+def allgather_host_varlen(arr: np.ndarray) -> np.ndarray:
+    """Allgather variable-length per-process host rows; returns the global
+    concatenation (process order) on every process.
+
+    The reference syncs init statistics with Network::Allreduce
+    (objective_function.cpp ObtainAutomaticInitialScore); here the full
+    label/weight columns are gathered instead — O(8 bytes/row), negligible
+    next to the bin matrix that stays process-local."""
+    from jax.experimental import multihost_utils
+
+    arr = np.asarray(arr)
+    counts = multihost_utils.process_allgather(
+        np.asarray([arr.shape[0]], np.int32)
+    ).reshape(-1)
+    mx = int(counts.max())
+    padded = np.zeros((mx,) + arr.shape[1:], arr.dtype)
+    padded[: arr.shape[0]] = arr
+    gathered = allgather_host_exact(padded)  # [nproc, mx, ...]
+    return np.concatenate(
+        [gathered[i, : int(c)] for i, c in enumerate(counts)], axis=0
+    )
+
+
+def allgather_host_exact(arr: np.ndarray) -> np.ndarray:
+    """process_allgather that preserves 64-bit payloads bit-exactly.
+
+    ``multihost_utils.process_allgather`` routes through jax arrays, which
+    (with x64 disabled) silently truncate float64/int64 to 32 bits — fatal
+    for bin boundaries and label statistics.  64-bit inputs ride through as
+    uint32 pairs instead."""
+    from jax.experimental import multihost_utils
+
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype.itemsize == 8:
+        as32 = arr.view(np.uint32)  # [..., 2 * last]
+        out = np.asarray(multihost_utils.process_allgather(as32))
+        return out.view(arr.dtype)
+    return np.asarray(multihost_utils.process_allgather(arr))
 
 
 def replicate(arr, mesh: Mesh):

@@ -17,10 +17,12 @@ span all processes' devices. This module provides
     each host runs one process and the coordinator address is shared).
 
 Single-host TPU training does NOT need any of this: a Mesh over the local
-chips (tree_learner=data) already scales there. Multi-host data feeding —
-each process holding only its local rows — is the remaining integration
-(jax.make_array_from_process_local_data); until then multi-process runs
-replicate the dataset per process.
+chips (tree_learner=data) already scales there. With ``pre_partition=true``
+each process loads/bins ONLY its own rows (mappers are synced at construct,
+dataset.py) and the Booster feeds them process-locally via
+``jax.make_array_from_process_local_data`` — no process materializes the
+global bin matrix (reference: rank-partitioned loading,
+src/io/dataset_loader.cpp:210).
 """
 
 from __future__ import annotations

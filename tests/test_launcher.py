@@ -130,3 +130,116 @@ def test_two_process_binning_sync(tmp_path):
                 digests.append(line.split()[1][:64])
     assert len(digests) == 2, f"expected a digest per worker: {outputs}"
     assert len(set(digests)) == 1, f"mappers differ across processes: {digests}"
+
+
+PRE_PARTITION_TMPL = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, "__REPO__")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    import hashlib
+    import numpy as np
+    from lightgbm_tpu.parallel import init_distributed
+
+    init_distributed()
+    rank = jax.process_index()
+    rng = np.random.default_rng(99)
+    # integer-valued features: quantile binning is partition-invariant, so
+    # the mappers match the single-process run exactly and the test isolates
+    # the process-local FEEDING + psum path (real-valued distributed binning
+    # is rank-local by design, matching dataset_loader.cpp:1079)
+    X = rng.integers(0, 63, size=(8000, 6)).astype(np.float64)
+    y = X[:, 0] * 0.2 + np.sin(X[:, 1]) + rng.normal(scale=0.3, size=8000)
+    lo, hi = rank * 4000, (rank + 1) * 4000
+    import lightgbm_tpu as lgb
+
+    params = dict(
+        objective="regression", num_leaves=31, min_data_in_leaf=20,
+        tree_learner="data", pre_partition=True, verbosity=-1, metric="none",
+        max_bin=63,
+    )
+    d = lgb.Dataset(X[lo:hi], y[lo:hi], params=params)
+    b = lgb.train(params, d, 5)
+    # the global bin matrix spans both processes but THIS process only
+    # holds its own rows
+    bins = b._bins
+    assert bins.shape[0] == 8000, bins.shape
+    local_rows = sum(s.data.shape[0] for s in bins.addressable_shards)
+    assert local_rows == 4000, local_rows
+    ms = b.model_to_string()
+    digest = hashlib.sha256(ms.encode()).hexdigest()
+    if rank == 0 and os.environ.get("LGBM_TEST_OUT"):
+        open(os.environ["LGBM_TEST_OUT"], "w").write(ms)
+    print(f"MODELHASH {digest}")
+    """
+)
+
+
+def test_two_process_pre_partition_training(tmp_path):
+    """Process-local data feeding (reference: rank-partitioned loading,
+    src/io/dataset_loader.cpp:210): two processes train on disjoint halves,
+    each holding only its rows on its devices.  The two processes must be
+    BIT-IDENTICAL to each other; against a single-process run over the same
+    8-shard mesh the tree STRUCTURE must match exactly and leaf values to
+    f32 reduction-order tolerance (XLA's cross-process psum reduces in a
+    different order than the single-process all-reduce — observed ~1 ulp)."""
+    script = tmp_path / "prepart_worker.py"
+    script.write_text(PRE_PARTITION_TMPL.replace("__REPO__", REPO_ROOT))
+    from lightgbm_tpu.parallel.launcher import launch_collect
+
+    rc, outputs = launch_collect(
+        2,
+        [sys.executable, str(script)],
+        extra_env={
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "LGBM_TEST_OUT": str(tmp_path / "worker_model.txt"),
+        },
+    )
+    assert rc == 0, outputs
+    digests = []
+    for out in outputs:
+        for line in out.splitlines():
+            if line.startswith("MODELHASH"):
+                digests.append(line.split()[1][:64])
+    assert len(digests) == 2, f"expected a digest per worker: {outputs}"
+    assert len(set(digests)) == 1, f"models differ across processes: {digests}"
+
+    # single-process run over the same global data and mesh width
+    import hashlib
+
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(99)
+    X = rng.integers(0, 63, size=(8000, 6)).astype(np.float64)
+    y = X[:, 0] * 0.2 + np.sin(X[:, 1]) + rng.normal(scale=0.3, size=8000)
+    params = dict(
+        objective="regression", num_leaves=31, min_data_in_leaf=20,
+        tree_learner="data", verbosity=-1, metric="none", max_bin=63,
+    )
+    b = lgb.train(params, lgb.Dataset(X, y, params=params), 5)
+
+    def structure_and_values(model_str):
+        struct, values = [], []
+        for line in model_str.splitlines():
+            if line.startswith(
+                ("split_feature=", "threshold=", "decision_type=",
+                 "left_child=", "right_child=", "num_leaves=")
+            ):
+                struct.append(line)
+            elif line.startswith("leaf_value="):
+                values.extend(float(v) for v in line.split("=")[1].split())
+        return struct, np.asarray(values)
+
+    # the worker saved its model text next to its hash
+    wmodel = (tmp_path / "worker_model.txt").read_text()
+    ws, wv = structure_and_values(wmodel)
+    ss, sv = structure_and_values(b.model_to_string())
+    assert ws == ss, "multi-process split structure != single-process"
+    np.testing.assert_allclose(wv, sv, rtol=1e-4, atol=1e-5)
