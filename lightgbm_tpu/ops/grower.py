@@ -51,6 +51,11 @@ class GrowerParams:
     max_delta_step: float = 0.0
     hist_method: str = "auto"
     axis_name: Optional[str] = None
+    # voting-parallel (PV-Tree, tree_learner=voting): local top-k election,
+    # psum only the elected 2k features' histogram slices; 0 = off.  Active
+    # only when F > 2*top_k (see voting_active) — below that dense psum is
+    # exact and cheaper, so voting aliases onto the data-parallel path.
+    voting_top_k: int = 0
     # categorical split search (sorted-subset scan, feature_histogram.cpp:147);
     # False keeps every cat-related array at width 1 (static no-op)
     use_cat: bool = False
@@ -174,6 +179,8 @@ class _State(NamedTuple):
     leaf_is_right: jnp.ndarray
     leaf_lb: jnp.ndarray  # [L] monotone output lower bound
     leaf_ub: jnp.ndarray  # [L] monotone output upper bound
+    leaf_box: jnp.ndarray  # [L, F, 2] bin-space feature ranges (intermediate
+    #                        monotone mode; [L, 0, 2] otherwise)
     leaf_allowed: jnp.ndarray  # [L, F] interaction-constraint feature mask
     cand: SplitCandidate  # arrays of shape [L]
     split_feature: jnp.ndarray
@@ -193,19 +200,27 @@ class _State(NamedTuple):
     cegb_used: jnp.ndarray  # [F] bool — feature bought (use_cegb)
 
 
+def voting_active(p: "GrowerParams", f: int) -> bool:
+    """Voting-parallel engages only when the elected subset is actually
+    smaller than F — below that, the dense psum is both exact and cheaper
+    (the documented cutover: F <= 2*top_k aliases onto tree_learner=data)."""
+    return (
+        p.axis_name is not None and p.voting_top_k > 0 and f > 2 * p.voting_top_k
+    )
+
+
 def _candidate_for_leaf(
     hist, g, h, c, num_bins, nan_bins, feature_mask, p: GrowerParams,
     monotone=None, lb=None, ub=None, parent_output=0.0, is_cat=None,
     cegb_penalty=None, rand_bins=None,
 ):
-    return best_split(
-        hist,
-        g,
-        h,
-        c,
-        num_bins,
-        nan_bins,
-        feature_mask,
+    """Best split for one leaf.  ``hist`` is the GLOBAL (psummed) histogram
+    normally; under voting-parallel it is the LOCAL histogram and only the
+    globally-elected top-2k features' slices are psummed (PV-Tree,
+    reference voting_parallel_tree_learner.cpp:152 GlobalVoting + :396
+    elected-feature ReduceScatter)."""
+    f = hist.shape[0]
+    common = dict(
         lambda_l1=p.lambda_l1,
         lambda_l2=p.lambda_l2,
         min_data_in_leaf=p.min_data_in_leaf,
@@ -213,16 +228,60 @@ def _candidate_for_leaf(
         min_gain_to_split=p.min_gain_to_split,
         max_delta_step=p.max_delta_step,
         path_smooth=p.path_smooth,
-        monotone=monotone,
         leaf_lb=lb,
         leaf_ub=ub,
         parent_output=parent_output,
-        is_cat=is_cat if p.use_cat else None,
         cat_params=p.cat_params,
-        cegb_penalty=cegb_penalty if p.use_cegb else None,
         cegb_split_penalty=p.cegb_split_penalty if p.use_cegb else 0.0,
-        rand_bins=rand_bins if p.extra_trees else None,
     )
+    if not voting_active(p, f):
+        return best_split(
+            hist, g, h, c, num_bins, nan_bins, feature_mask,
+            monotone=monotone,
+            is_cat=is_cat if p.use_cat else None,
+            cegb_penalty=cegb_penalty if p.use_cegb else None,
+            rand_bins=rand_bins if p.extra_trees else None,
+            **common,
+        )
+    # ---- PV-Tree election.  1) local per-feature best gains from the LOCAL
+    # histogram (local parent stats derive from it: feature 0's bins cover
+    # every local row)
+    loc = hist[0].sum(axis=0)  # [3] local (g, h, cnt)
+    _, gains_f = best_split(
+        hist, loc[0], loc[1], loc[2], num_bins, nan_bins, feature_mask,
+        monotone=monotone,
+        is_cat=is_cat if p.use_cat else None,
+        cegb_penalty=cegb_penalty if p.use_cegb else None,
+        rand_bins=rand_bins if p.extra_trees else None,
+        per_feature_gains=True,
+        **common,
+    )
+    # 2) weighted gain (GlobalVoting: gain * leaf_count / mean_num_data) on
+    # the local top-k only; pmax is the allgather-of-top-k + per-feature max
+    nsh = lax.psum(jnp.float32(1.0), p.axis_name)
+    w = loc[2] * nsh / jnp.maximum(c, 1.0)
+    wg = jnp.where(jnp.isfinite(gains_f) & (loc[2] > 0), gains_f * w, -jnp.inf)
+    kth = lax.top_k(wg, min(p.voting_top_k, f))[0][-1]
+    masked = jnp.where(wg >= kth, wg, -jnp.inf)
+    glob = lax.pmax(masked, p.axis_name)
+    # 3) elect top-2k features globally; every shard elects the SAME ids
+    _, ids = lax.top_k(glob, min(2 * p.voting_top_k, f))
+    # 4) aggregate ONLY the elected slices ([2k, B, 3] over ICI instead of
+    # [F, B, 3]) and scan them with GLOBAL parent stats
+    sub = lax.psum(hist[ids], p.axis_name)
+    cand = best_split(
+        sub, g, h, c, num_bins[ids], nan_bins[ids], feature_mask[ids],
+        monotone=monotone[ids] if monotone is not None else None,
+        is_cat=is_cat[ids] if (p.use_cat and is_cat is not None) else None,
+        cegb_penalty=(
+            cegb_penalty[ids] if (p.use_cegb and cegb_penalty is not None) else None
+        ),
+        rand_bins=(
+            rand_bins[ids] if (p.extra_trees and rand_bins is not None) else None
+        ),
+        **common,
+    )
+    return cand._replace(feature=ids[cand.feature])
 
 
 def _set_cand(
@@ -346,6 +405,7 @@ def grow_tree(
     n, f = bins.shape
     L, B = p.num_leaves, p.max_bin
     use_mono = p.use_monotone and monotone is not None
+    use_inter_mono = use_mono and p.monotone_method in ("intermediate", "advanced")
     mono_arr = monotone if use_mono else None
     use_cat = p.use_cat and is_cat is not None
     Bm = B if use_cat else 1  # cat-mask width (1 = static no-op)
@@ -371,15 +431,21 @@ def grow_tree(
         u = jax.random.uniform(key, (f,))
         return (u * hi).astype(jnp.int32)
 
-    def node_feature_mask(node_seed, used_row):
-        """Per-node usable features: feature_fraction_bynode sampling
-        (col_sampler.hpp by-node) + interaction constraints (allowed = union
-        of constraint sets containing every feature used on the path)."""
+    def _leaf_feature_mask(used_row):
+        """Deterministic part of the per-node feature mask: bytree sampling +
+        interaction constraints (allowed = union of constraint sets
+        containing every feature used on the path)."""
         m = feature_mask
         if p.use_interaction and interaction_sets is not None:
             contains = (interaction_sets | ~used_row[None, :]).all(axis=1)  # [S]
             allowed = (contains[:, None] & interaction_sets).any(axis=0)  # [F]
             m = m & allowed
+        return m
+
+    def node_feature_mask(node_seed, used_row):
+        """Per-node usable features: feature_fraction_bynode sampling
+        (col_sampler.hpp by-node) + the deterministic mask."""
+        m = _leaf_feature_mask(used_row)
         if p.feature_fraction_bynode < 1.0 and rng is not None:
             key = jax.random.fold_in(rng, node_seed)
             m = m & (jax.random.uniform(key, (f,)) < p.feature_fraction_bynode)
@@ -388,6 +454,10 @@ def grow_tree(
     use_seg = p.hist_mode == "seg" and f > 0 and n > 1
     use_ordered = p.hist_mode == "ordered" and f > 0 and n > 1
     use_gather = p.hist_mode == "gather" and f > 0 and n > 1
+    # voting-parallel: histograms stay LOCAL; only elected slices are
+    # psummed inside _candidate_for_leaf (scalar stats still psum globally)
+    use_voting = voting_active(p, f)
+    hist_axis = None if use_voting else p.axis_name
 
     if use_seg:
         from .pallas.seg import pack_rows, padded_rows, seg_hist, stat_lanes
@@ -411,8 +481,8 @@ def grow_tree(
                 num_bins=B,
                 n_pad=n_pad_seg,
             )
-            if p.axis_name is not None:
-                hist = lax.psum(hist, p.axis_name)
+            if hist_axis is not None:
+                hist = lax.psum(hist, hist_axis)
             return hist
     if use_ordered or use_gather:
         caps = sorted(
@@ -438,7 +508,7 @@ def grow_tree(
                     mask_pad[idx],
                     B,
                     method=p.hist_method,
-                    axis_name=p.axis_name,
+                    axis_name=hist_axis,
                     quant_scales=quant_scales,
                 )
 
@@ -508,7 +578,7 @@ def grow_tree(
                     mask_pad[cidx] * vmask,
                     B,
                     method=p.hist_method,
-                    axis_name=p.axis_name,
+                    axis_name=hist_axis,
                     quant_scales=quant_scales,
                 )
 
@@ -527,9 +597,11 @@ def grow_tree(
         else:
             hist0 = leaf_histogram(
                 bins, grad, hess, count_mask, B, method=p.hist_method,
-                axis_name=p.axis_name, quant_scales=quant_scales,
+                axis_name=hist_axis, quant_scales=quant_scales,
             )
     totals = hist0[0].sum(axis=0)  # every row lands in exactly one bin of feature 0
+    if use_voting:
+        totals = lax.psum(totals, p.axis_name)  # global root stats
     root_used = jnp.zeros((f,), bool)
     neg_inf_s = jnp.float32(-jnp.inf)
     pos_inf_s = jnp.float32(jnp.inf)
@@ -598,6 +670,12 @@ def grow_tree(
         leaf_is_right=jnp.zeros((L,), bool),
         leaf_lb=jnp.full((L,), -jnp.inf, jnp.float32),
         leaf_ub=jnp.full((L,), jnp.inf, jnp.float32),
+        # root box spans the whole bin space of every feature
+        leaf_box=(
+            jnp.zeros((L, f, 2), jnp.int32).at[:, :, 1].set(B - 1)
+            if use_inter_mono
+            else jnp.zeros((L, 0, 2), jnp.int32)
+        ),
         leaf_allowed=jnp.zeros((L, f), bool),  # stores USED features per path
         cand=cand,
         split_feature=jnp.zeros((L - 1,), jnp.int32),
@@ -651,6 +729,10 @@ def grow_tree(
             f_bin = f_bin_a[tf]
             f_iscat = f_iscat_a[tf]
             hrow = st.hist_buf[f_leaf, f_feat]  # [B, 3]
+            if use_voting:
+                # voting keeps hist_buf LOCAL; a forced split needs the
+                # global row for this one feature (tiny psum)
+                hrow = lax.psum(hrow, p.axis_name)
             nbv = nan_bins[f_feat]
             has_nb = nbv >= 0
             nan_s = jnp.where(has_nb, hrow[jnp.maximum(nbv, 0)], 0.0)
@@ -860,7 +942,7 @@ def grow_tree(
             mask = count_mask * (leaf_id == target) * can_split
             sm = leaf_histogram(
                 bins, grad, hess, mask, B, method=p.hist_method,
-                axis_name=p.axis_name, quant_scales=quant_scales,
+                axis_name=hist_axis, quant_scales=quant_scales,
             )
 
         def _set1(arr, idx, val):
@@ -916,11 +998,20 @@ def grow_tree(
             jnp.where(can_split, right_hist, st.hist_buf[nl])
         )
 
-        # ---- monotone bounds for the children (BasicConstraint,
-        # monotone_constraints.hpp:465 — split midpoint partitions the
-        # parent's output interval)
+        # ---- monotone bounds for the children.
+        # basic: split midpoint partitions the parent's output interval
+        # (BasicLeafConstraints, monotone_constraints.hpp:465).
+        # intermediate (:516): children are bounded by each other's ACTUAL
+        # outputs, and the new outputs propagate to every CONTIGUOUS leaf
+        # across the split plane — the reference's recursive GoUp/GoDown tree
+        # walk is replaced by a vectorized box-adjacency test (see
+        # GrowerParams.monotone_method); bound-tightened leaves get their
+        # cached candidate refreshed below (top-K, = leaves_to_update_).
         leaf_lb, leaf_ub = st.leaf_lb, st.leaf_ub
+        leaf_box = st.leaf_box
         lb_par, ub_par = st.leaf_lb[l], st.leaf_ub[l]
+        inter_idxs = None
+        inter_valid = None
         if use_mono:
             out_l_c = jnp.clip(
                 leaf_output(lg, lh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
@@ -931,13 +1022,88 @@ def grow_tree(
                 lb_par, ub_par,
             )
             mc_f = mono_arr[feat]
-            mid = 0.5 * (out_l_c + out_r_c)
-            lb_l = jnp.where(mc_f < 0, mid, lb_par)
-            ub_l = jnp.where(mc_f > 0, mid, ub_par)
-            lb_r = jnp.where(mc_f > 0, mid, lb_par)
-            ub_r = jnp.where(mc_f < 0, mid, ub_par)
-            leaf_lb = _set1(_set1(st.leaf_lb, l, lb_l), nl, lb_r)
-            leaf_ub = _set1(_set1(st.leaf_ub, l, ub_l), nl, ub_r)
+            if use_inter_mono:
+                num_split = ~cis  # categorical splits carry no interval order
+                # sibling bounds from actual outputs
+                # (UpdateConstraintsWithOutputs, :548)
+                ub_l = jnp.where(
+                    num_split & (mc_f > 0), jnp.minimum(ub_par, out_r_c), ub_par
+                )
+                lb_l = jnp.where(
+                    num_split & (mc_f < 0), jnp.maximum(lb_par, out_r_c), lb_par
+                )
+                ub_r = jnp.where(
+                    num_split & (mc_f < 0), jnp.minimum(ub_par, out_l_c), ub_par
+                )
+                lb_r = jnp.where(
+                    num_split & (mc_f > 0), jnp.maximum(lb_par, out_l_c), lb_par
+                )
+                # children feature boxes (categorical: inherit unchanged)
+                pbox = st.leaf_box[l]  # [F, 2]
+                box_l = pbox.at[feat, 1].set(
+                    jnp.where(num_split, tbin, pbox[feat, 1])
+                )
+                box_r = pbox.at[feat, 0].set(
+                    jnp.where(num_split, tbin + 1, pbox[feat, 0])
+                )
+                leaf_box = st.leaf_box.at[l].set(
+                    jnp.where(can_split, box_l, pbox)
+                )
+                leaf_box = leaf_box.at[nl].set(
+                    jnp.where(can_split, box_r, st.leaf_box[nl])
+                )
+                # propagate new outputs to contiguous leaves: b is updated
+                # from child c iff their boxes TOUCH along a monotone feature
+                # g and intersect along every other feature (== the leaves
+                # GoDownToFindLeavesToUpdate reaches, :700)
+                leaf_ids_r = jnp.arange(L, dtype=jnp.int32)
+                valid_b = (
+                    (leaf_ids_r <= t) & (leaf_ids_r != l)
+                    & can_split & num_split
+                )
+                blo = leaf_box[:, :, 0]
+                bhi = leaf_box[:, :, 1]
+                mpos = (mono_arr > 0)[None, :]
+                mneg = (mono_arr < 0)[None, :]
+
+                def _prop(cbox, out_c, lb, ub, changed):
+                    clo, chi = cbox[:, 0], cbox[:, 1]
+                    ov = (blo <= chi[None, :]) & (clo[None, :] <= bhi)  # [L,F]
+                    others = (ov.sum(axis=1) == f - 1)[:, None] & ~ov
+                    b_right = blo == chi[None, :] + 1  # b just right of c
+                    b_left = bhi == clo[None, :] - 1
+                    need_lb = (
+                        others & ((b_right & mpos) | (b_left & mneg))
+                    ).any(axis=1) & valid_b
+                    need_ub = (
+                        others & ((b_left & mpos) | (b_right & mneg))
+                    ).any(axis=1) & valid_b
+                    lb2 = jnp.where(need_lb, jnp.maximum(lb, out_c), lb)
+                    ub2 = jnp.where(need_ub, jnp.minimum(ub, out_c), ub)
+                    return lb2, ub2, changed | (lb2 > lb) | (ub2 < ub)
+
+                ch0 = jnp.zeros((L,), bool)
+                nlb, nub, ch0 = _prop(box_l, out_l_c, st.leaf_lb, st.leaf_ub, ch0)
+                nlb, nub, ch0 = _prop(box_r, out_r_c, nlb, nub, ch0)
+                leaf_lb = _set1(_set1(nlb, l, lb_l), nl, lb_r)
+                leaf_ub = _set1(_set1(nub, l, ub_l), nl, ub_r)
+                # leaves_to_update_: refresh the K highest-gain tightened
+                # candidates (others keep stale-but-clamped candidates until
+                # their next refresh; reference recomputes all, :717)
+                inter_changed = ch0 & (st.cand.gain > 0)
+                scores = jnp.where(inter_changed, st.cand.gain, -jnp.inf)
+                top_vals, inter_idxs = lax.top_k(
+                    scores, min(p.monotone_recompute_k, L)
+                )
+                inter_valid = top_vals > -jnp.inf
+            else:
+                mid = 0.5 * (out_l_c + out_r_c)
+                lb_l = jnp.where(mc_f < 0, mid, lb_par)
+                ub_l = jnp.where(mc_f > 0, mid, ub_par)
+                lb_r = jnp.where(mc_f > 0, mid, lb_par)
+                ub_r = jnp.where(mc_f < 0, mid, ub_par)
+                leaf_lb = _set1(_set1(st.leaf_lb, l, lb_l), nl, lb_r)
+                leaf_ub = _set1(_set1(st.leaf_ub, l, ub_l), nl, ub_r)
         else:
             lb_l = ub_l = lb_r = ub_r = None
 
@@ -959,7 +1125,9 @@ def grow_tree(
         )
 
         # ---- refresh split candidates for the two children in ONE vmapped
-        # best_split (halves the per-split fixed scan cost vs two calls)
+        # best_split (halves the per-split fixed scan cost vs two calls);
+        # intermediate monotone mode appends the K bound-tightened leaves to
+        # the same batch (the reference's leaves_to_update_ recompute)
         hist2 = jnp.stack([left_hist, right_hist])
         g2 = jnp.stack([lg, rg])
         h2 = jnp.stack([lh, rh])
@@ -968,17 +1136,37 @@ def grow_tree(
             [node_feature_mask(2 * t + 1, used_l),
              node_feature_mask(2 * t + 2, used_r)]
         )
+        lb2 = ub2 = None
+        if use_mono:
+            lb2 = jnp.stack([lb_l, lb_r])
+            ub2 = jnp.stack([ub_l, ub_r])
+        seeds2 = jnp.stack([2 * t + 1, 2 * t + 2])
+        if use_inter_mono:
+            hist2 = jnp.concatenate([hist2, hist_buf[inter_idxs]])
+            g2 = jnp.concatenate([g2, leaf_g[inter_idxs]])
+            h2 = jnp.concatenate([h2, leaf_h[inter_idxs]])
+            c2 = jnp.concatenate([c2, leaf_cnt[inter_idxs]])
+            lb2 = jnp.concatenate([lb2, leaf_lb[inter_idxs]])
+            ub2 = jnp.concatenate([ub2, leaf_ub[inter_idxs]])
+            if p.use_interaction:
+                # per-leaf usable features reconstructed from the path-used
+                # sets; the feature_fraction_bynode random draw is NOT
+                # replayed for refreshes (the original node seed is gone) —
+                # refreshed candidates see the deterministic mask only
+                fm_k = jax.vmap(_leaf_feature_mask)(leaf_allowed[inter_idxs])
+            else:
+                fm_k = jnp.broadcast_to(
+                    feature_mask, (inter_idxs.shape[0], f)
+                )
+            fm2 = jnp.concatenate([fm2, fm_k])
+            seeds2 = jnp.concatenate([seeds2, 7 * L + inter_idxs])
         po2 = leaf_output(g2, h2, p.lambda_l1, p.lambda_l2, p.max_delta_step)
         opt2 = []
         if use_mono:
-            opt2 += [jnp.stack([lb_l, lb_r]), jnp.stack([ub_l, ub_r])]
+            opt2 += [lb2, ub2]
         use_rand = p.extra_trees and rng is not None
         if use_rand:
-            opt2 += [
-                jnp.stack(
-                    [node_rand_bins(2 * t + 1), node_rand_bins(2 * t + 2)]
-                )
-            ]
+            opt2 += [jax.vmap(node_rand_bins)(seeds2)]
         cpen = _cegb_pen(cegb_used_new)
 
         def _child_cand(hist, g_, h_, c_, fm, po, *rest):
@@ -1012,6 +1200,14 @@ def grow_tree(
             cand, nl, cand_r,
             jnp.where(depth_ok, cand_r.gain, -jnp.inf), pred=can_split,
         )
+        if use_inter_mono:
+            # write back the refreshed candidates of bound-tightened leaves
+            for kk in range(inter_idxs.shape[0]):
+                row = SplitCandidate(*[a[2 + kk] for a in cand2])
+                cand = _set_cand(
+                    cand, inter_idxs[kk], row,
+                    pred=can_split & inter_valid[kk],
+                )
 
         if use_ordered or use_seg:
             leaf_begin = _set1(st.leaf_begin, nl, begin_l + nleft)
@@ -1033,6 +1229,7 @@ def grow_tree(
             leaf_is_right=leaf_is_right,
             leaf_lb=leaf_lb,
             leaf_ub=leaf_ub,
+            leaf_box=leaf_box,
             leaf_allowed=leaf_allowed,
             cand=cand,
             split_feature=split_feature,

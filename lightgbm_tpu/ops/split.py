@@ -130,6 +130,7 @@ def best_split(
     cegb_penalty: Optional[jnp.ndarray] = None,  # [F] f32 per-feature penalty
     cegb_split_penalty: float = 0.0,  # tradeoff * cegb_penalty_split
     rand_bins: Optional[jnp.ndarray] = None,  # [F] extra_trees random bin
+    per_feature_gains: bool = False,  # also return max gain per feature [F]
 ) -> SplitCandidate:
     """cegb_*: Cost-Effective Gradient Boosting (reference:
     cost_effective_gradient_boosting.hpp DeltaGain — gain is reduced by
@@ -350,7 +351,7 @@ def best_split(
         improvement = improvement - cegb_split_penalty * parent[2]
     improvement = jnp.where(jnp.isfinite(best_gain_raw), improvement, -jnp.inf)
 
-    return SplitCandidate(
+    cand_out = SplitCandidate(
         gain=improvement.astype(jnp.float32),
         feature=feat,
         bin=tbin,
@@ -364,3 +365,9 @@ def best_split(
         is_cat=is_cat_win,
         cat_mask=cat_mask,
     )
+    if per_feature_gains:
+        # raw best gain per feature (same parent offset for every feature,
+        # so the ranking equals improvement ranking) — the voting-parallel
+        # learner's LightSplitInfo gains (voting_parallel_tree_learner.cpp:152)
+        return cand_out, gains.max(axis=(0, 2))
+    return cand_out
