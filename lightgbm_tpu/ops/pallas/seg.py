@@ -32,6 +32,15 @@ relayout copies per split (~0.3 ms each at 1M rows, measured).  The
 histogram kernel DMAs [LANES, T] column tiles (minor-dim starts 128-aligned,
 misalignment folded into the validity mask) and transposes each tile in
 VMEM.
+
+Precision contract (ADVICE r2): the histogram accumulates grad/hess as a
+TWO-TERM bf16 hi/lo split (~17 mantissa bits per addend, f32 accumulators),
+vs f32 addends in the other modes and double histograms in the reference.
+Oracle tests pin the error at <2e-3 relative; near-tie split decisions can
+flip vs the f64 reference, which golden-model parity tests tolerate by
+comparing structure with that epsilon in mind.  If parity ever drifts, add
+a third residual term (exact f32 needs only one more matmul row) before
+touching tolerances.
 """
 
 from __future__ import annotations

@@ -191,3 +191,23 @@ def test_feature_importance():
     assert imp_split.sum() > 0
     # features 0 and 1 carry the signal
     assert imp_gain[0] + imp_gain[1] > imp_gain[2:].sum()
+
+
+def test_constructed_dataset_rejects_conflicting_binning_params():
+    """Dataset params freeze at construction (reference basic.py
+    _update_params 'Cannot change ... after constructed'); a second booster
+    with a conflicting binning param must error, including when the first
+    booster's merge already wrote the key into the dataset (ADVICE r3)."""
+    import pytest as _pytest
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 4))
+    y = X[:, 0] + rng.normal(size=300)
+    d = lgb.Dataset(X, y)
+    lgb.train({"objective": "regression", "verbosity": -1, "max_bin": 63}, d, 2)
+    with _pytest.raises(ValueError, match="max_bin"):
+        lgb.train(
+            {"objective": "regression", "verbosity": -1, "max_bin": 127}, d, 2
+        )
+    # same params re-train is fine
+    lgb.train({"objective": "regression", "verbosity": -1, "max_bin": 63}, d, 2)

@@ -83,31 +83,77 @@ def test_walk_eligibility_gates():
     assert not walk_eligible(
         b._bin_records, np.asarray(b._nan_bins), X.shape[1], 512
     )
-    # categorical splits fall back
-    Xc = X.copy()
-    Xc[:, 3] = rng.integers(0, 6, size=3000)
-    yc = (Xc[:, 3] >= 3).astype(float) + X[:, 0] * 0.1
-    bc = _train(
-        Xc, yc, {"objective": "regression", "categorical_feature": [3]}, 3
-    )
+    # > 128 features falls back (lane-gather plane budget)
     assert not walk_eligible(
-        bc._bin_records, np.asarray(bc._nan_bins), Xc.shape[1],
-        bc._max_bin_padded,
+        b._bin_records, np.asarray(b._nan_bins), 200, b._max_bin_padded
     )
 
 
-def test_predict_fast_path_k_guard():
-    # num_class > KPAD must not take the kernel path (classes would be lost)
-    rng = np.random.default_rng(3)
-    X = rng.normal(size=(1500, 4))
-    y = rng.integers(0, KPAD + 2, size=1500).astype(float)
+def test_forest_walk_categorical_matches_xla_walker():
+    """Categorical splits walk through the in-kernel bitset test
+    (tree_avx512.hpp:112-168 handles categorical inline; here it is the
+    vectorized FindInBitset over per-node 256-bit masks)."""
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(2500, 5))
+    X[:, 3] = rng.integers(0, 9, size=2500)
+    X[:, 4] = rng.integers(0, 4, size=2500)
+    y = (
+        np.isin(X[:, 3], [1, 4, 7]).astype(float) * 2
+        + (X[:, 4] == 2) * 1.5
+        + X[:, 0] * 0.3
+        + rng.normal(size=2500) * 0.05
+    )
     b = _train(
         X, y,
-        {"objective": "multiclass", "num_class": KPAD + 2, "num_leaves": 7},
+        {"objective": "regression", "categorical_feature": [3, 4],
+         "num_leaves": 31, "min_data_in_leaf": 5, "max_cat_to_onehot": 2},
+        10,
+    )
+    recs = b._bin_records
+    assert any(np.any(np.asarray(r.get("split_is_cat"))) for r in recs)
+    assert walk_eligible(
+        recs, np.asarray(b._nan_bins), X.shape[1], b._max_bin_padded
+    )
+    got = _walk_raw(b, X, 1)[:, 0]
+    exp = _xla_raw(b, X, 1)[:, 0]
+    assert np.allclose(got, exp, atol=1e-5)
+
+
+def test_forest_walk_wide_tree_four_half_lookup():
+    """Trees with > 256 nodes use the 4-half lane-gather (up to 512)."""
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(20000, 6))
+    y = np.sin(2 * X[:, 0]) * np.cos(X[:, 1]) + 0.3 * X[:, 2] + rng.normal(
+        size=20000
+    ) * 0.05
+    b = _train(
+        X, y,
+        {"objective": "regression", "num_leaves": 400, "min_data_in_leaf": 5},
+        3,
+    )
+    n_nodes = max(len(r["split_feature"]) for r in b._bin_records)
+    assert n_nodes > 256, n_nodes
+    got = _walk_raw(b, X[:3000], 1)[:, 0]
+    exp = _xla_raw(b, X[:3000], 1)[:, 0]
+    assert np.allclose(got, exp, atol=1e-5)
+
+
+def test_forest_walk_many_classes():
+    # num_class > 8 pads the output class columns to a multiple of 8
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(1500, 4))
+    k = KPAD + 2
+    y = rng.integers(0, k, size=1500).astype(float)
+    b = _train(
+        X, y,
+        {"objective": "multiclass", "num_class": k, "num_leaves": 7},
         2,
     )
+    got = _walk_raw(b, X, k)
+    exp = _xla_raw(b, X, k)
+    assert np.allclose(got, exp, atol=1e-5)
     p = b.predict(X)
-    assert p.shape == (1500, KPAD + 2)
+    assert p.shape == (1500, k)
     assert np.allclose(p.sum(axis=1), 1.0, atol=1e-5)
 
 
@@ -133,7 +179,13 @@ def test_device_binning_matches_host():
     X[::5, 0] = np.nan
     X[::9, 1] = 0.0
     tabs = build_devbin_tables(mappers, [0, 1])
-    dev = np.asarray(bin_numeric_device(jnp.asarray(X, jnp.float32), *tabs))
+    dev_b, suspect = bin_numeric_device(jnp.asarray(X, jnp.float32), *tabs)
+    dev = np.asarray(dev_b)
+    # random values are never near a boundary; exact-boundary rows must flag
+    edge = X.copy()
+    edge[0, 0] = float(np.asarray(tabs[0])[0, 3])  # exactly on a boundary
+    _, sus2 = bin_numeric_device(jnp.asarray(edge, jnp.float32), *tabs)
+    assert bool(np.asarray(sus2)[0])
     host = np.stack(
         [m.values_to_bins(X[:, i]) for i, m in enumerate(mappers)], axis=1
     )
@@ -169,7 +221,7 @@ def test_device_binned_walk_matches_slow_path():
     tabs = build_devbin_tables(ds.bin_mappers, ds.used_features)
     assert tabs is not None
     xs = np.ascontiguousarray(X[:, ds.used_features], dtype=np.float32)
-    mat_dev = bin_numeric_device(jnp.asarray(xs), *tabs)
+    mat_dev, _ = bin_numeric_device(jnp.asarray(xs), *tabs)
     n = X.shape[0]
     n_pad = (n + ROW_TILE - 1) // ROW_TILE * ROW_TILE
     packed = _pack_bins_device(mat_dev, n_pad)
@@ -181,3 +233,51 @@ def test_device_binned_walk_matches_slow_path():
     got = unpack_walk_scores(np.asarray(out), n, 1)[:, 0]
     exp = _xla_raw(b, X, 1)[:, 0]
     assert np.allclose(got, exp, atol=1e-5)
+
+
+def test_bin_edge_rows_rebinned_exactly():
+    """VERDICT r2 #9: rows at (or within f32-eps of) bin boundaries must
+    predict identically to the host-binned path.  The device binning flags
+    them suspect and the booster re-bins exactly those rows on host."""
+    from lightgbm_tpu.ops.pallas.forest_walk import (
+        bin_numeric_device,
+        build_devbin_tables,
+    )
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(4000, 5))
+    y = X[:, 0] * 2 + X[:, 1] + rng.normal(size=4000) * 0.1
+    b = _train(X, y, {"objective": "regression", "num_leaves": 31}, 8)
+    ds = b.train_set
+    tabs = build_devbin_tables(ds.bin_mappers, ds.used_features)
+    ub0 = np.asarray(tabs[0], np.float64)  # f32 boundaries
+
+    # craft rows sitting exactly on boundaries and one-ulp around them
+    rows = X[:32].copy()
+    f32 = np.float32
+    for i in range(16):
+        bidx = 1 + (i % 40)
+        base = ub0[i % rows.shape[1], min(bidx, ub0.shape[1] - 2)]
+        if not np.isfinite(base):
+            base = ub0[i % rows.shape[1], 0]
+        v = f32(base)
+        rows[i, i % rows.shape[1]] = float(v)
+        rows[16 + i // 2, i % rows.shape[1]] = float(
+            np.nextafter(v, f32(np.inf))
+        )
+    xs = jnp.asarray(
+        np.ascontiguousarray(rows[:, ds.used_features], np.float32)
+    )
+    bins_dev, suspect = bin_numeric_device(xs, *tabs)
+    assert bool(np.asarray(suspect).any())
+    # simulate the booster's patch step: suspect rows host-binned
+    sidx = np.flatnonzero(np.asarray(suspect))
+    patch = b._bin_input_host(rows[sidx])
+    fixed = np.asarray(bins_dev.at[jnp.asarray(sidx)].set(
+        jnp.asarray(patch.astype(np.int32))
+    ))
+    host = b._bin_input_host(rows)
+    # EVERY row must now equal host binning: suspects were patched with the
+    # exact path, and non-suspects are provably safe (their distance to any
+    # boundary exceeds the f32/f64 rounding gap the tolerance covers)
+    assert np.array_equal(fixed, host)
