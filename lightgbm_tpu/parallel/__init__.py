@@ -16,11 +16,15 @@ free.  Multi-host: initialize ``jax.distributed`` and build the same Mesh over
 all processes; the same shard_map then spans hosts (DCN) — the analog of the
 reference's machine-list TCP setup (src/network/linkers_socket.cpp:25).
 
-``tree_learner='feature'`` (features sharded, all rows everywhere) and
-``'voting'`` (top-k histogram exchange) are comm optimizations of the same
-semantics; on ICI bandwidth the plain psum is usually fastest, so they are
-accepted and mapped onto the same path (reference behavior is preserved:
-results are identical regardless of tree_learner).
+``tree_learner='feature'`` (features sharded, all rows everywhere) is a comm
+optimization of the same semantics; on ICI bandwidth the plain psum is
+usually fastest, so it is accepted and mapped onto the same path (results
+are identical regardless).  ``tree_learner='voting'`` implements the real
+PV-Tree election (ops/grower._candidate_for_leaf): histograms stay LOCAL,
+each shard's top-``top_k`` weighted gains are pmax-merged, and only the
+elected 2k features' ``[2k, B, 3]`` slices are psummed — engaged only when
+``F > 2 * top_k`` (below that the dense psum is exact and cheaper, the
+documented cutover; reference voting_parallel_tree_learner.cpp:152).
 """
 
 from __future__ import annotations
@@ -44,12 +48,20 @@ def choose_devices(min_devices: int = 2):
     when it has a single chip (e.g. tests on a 1-chip host with a virtual CPU
     mesh) — the CPU backend's. Returns None when no multi-device backend
     exists, signalling serial training (the reference likewise degrades
-    ``tree_learner=data`` to serial when num_machines==1, config.cpp)."""
-    devices = jax.devices()
+    ``tree_learner=data`` to serial when num_machines==1, config.cpp).
+    ``LGBM_TPU_FORCE_NDEV`` caps the mesh width (scaling experiments)."""
+    import os
+
+    cap = int(os.environ.get("LGBM_TPU_FORCE_NDEV", "0"))
+
+    def _cap(devs):
+        return devs[:cap] if cap > 0 else devs
+
+    devices = _cap(jax.devices())
     if len(devices) >= min_devices:
         return devices
     try:
-        cpu = jax.devices("cpu")
+        cpu = _cap(jax.devices("cpu"))
     except RuntimeError:
         cpu = []
     if len(cpu) >= min_devices:

@@ -1,0 +1,105 @@
+"""Voting-parallel (PV-Tree) tests over the 8-device CPU mesh.
+
+Reference: src/treelearner/voting_parallel_tree_learner.cpp —
+GlobalVoting (:152) elects top-2k features from per-machine top-k weighted
+gains; only elected histogram slices are aggregated (:396 ReduceScatter).
+Here the election is pmax over local top-k masks and the aggregation a psum
+of the elected [2k, B, 3] slices (ops/grower._candidate_for_leaf).
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make_wide(n, f, seed=0, informative=6):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    w = np.zeros(f)
+    w[:informative] = rng.normal(size=informative) + 1.0
+    y = X @ w + rng.normal(scale=0.3, size=n)
+    return X, y
+
+
+def test_voting_trains_and_learns_high_f():
+    """F=64 >> 2*top_k: the election path is live and must still learn."""
+    X, y = _make_wide(4000, 64, informative=5)
+    params = {
+        "objective": "regression",
+        "num_leaves": 15,
+        "verbosity": -1,
+        "metric": "none",
+        "tree_learner": "voting",
+        "top_k": 4,
+        "max_bin": 63,
+    }
+    b = lgb.train(params, lgb.Dataset(X, y, params=params), 10)
+    mse = float(np.mean((b.predict(X) - y) ** 2))
+    base = float(np.var(y))
+    assert mse < 0.35 * base, (mse, base)
+    # informative features dominate the elected splits
+    imp = b.feature_importance()
+    assert imp[:5].sum() >= 0.6 * imp.sum()
+
+
+def test_voting_aliases_to_data_below_cutover():
+    """F <= 2*top_k: voting must produce the EXACT data-parallel model
+    (the documented cutover: dense psum is cheaper and exact there)."""
+    X, y = _make_wide(3000, 10, informative=4, seed=1)
+    models = {}
+    for tl in ("data", "voting"):
+        params = {
+            "objective": "regression",
+            "num_leaves": 15,
+            "verbosity": -1,
+            "metric": "none",
+            "tree_learner": tl,
+            "top_k": 20,  # 2k = 40 >= F=10
+            "max_bin": 63,
+        }
+        b = lgb.train(params, lgb.Dataset(X, y, params=params), 5)
+        # compare the trees, not the embedded parameters section (that one
+        # records tree_learner itself)
+        models[tl] = b.model_to_string().split("\nparameters:")[0]
+    assert models["data"] == models["voting"]
+
+
+def test_voting_quality_near_data_parallel():
+    """Election is approximate but with informative features sparse it
+    should land within a modest factor of the exact learner."""
+    X, y = _make_wide(4000, 64, informative=5, seed=2)
+    mses = {}
+    for tl, k in (("data", 20), ("voting", 4)):
+        params = {
+            "objective": "regression",
+            "num_leaves": 15,
+            "verbosity": -1,
+            "metric": "none",
+            "tree_learner": tl,
+            "top_k": k,
+            "max_bin": 63,
+        }
+        b = lgb.train(params, lgb.Dataset(X, y, params=params), 10)
+        mses[tl] = float(np.mean((b.predict(X) - y) ** 2))
+    assert mses["voting"] <= mses["data"] * 1.25, mses
+
+
+@pytest.mark.slow
+def test_voting_f1024_smoke():
+    """VERDICT r2 #7: the high-F regime voting exists for — F=1024 must
+    compile and learn on the 8-shard mesh with [2k, B, 3] slice exchange."""
+    X, y = _make_wide(2048, 1024, informative=4, seed=3)
+    params = {
+        "objective": "regression",
+        "num_leaves": 7,
+        "verbosity": -1,
+        "metric": "none",
+        "tree_learner": "voting",
+        "top_k": 8,
+        "max_bin": 15,
+        "min_data_in_leaf": 5,
+    }
+    b = lgb.train(params, lgb.Dataset(X, y, params=params), 3)
+    mse = float(np.mean((b.predict(X) - y) ** 2))
+    assert mse < 0.9 * float(np.var(y))
