@@ -1,0 +1,86 @@
+"""Collective-cost model for the data-parallel grower (VERDICT r2 #6).
+
+Measures step time vs mesh size (1/2/4/8 virtual CPU devices) at
+Higgs-shaped histograms and computes the psum BYTES each split exchanges,
+then projects v5e-16 behavior from published ICI numbers.  Run:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/collective_model.py [rows]
+
+Writes a markdown table to stdout (paste into BENCH_NOTES.md).
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    f, B, L = 28, 256, 255
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(rows, f)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float64)
+
+    import lightgbm_tpu as lgb
+
+    # psum volume per split (the analytical part of the model):
+    # data-parallel exchanges the smaller child's full [F, B, 3] f32
+    # histogram; with Higgs shapes that is F*B*3*4 bytes.
+    hist_bytes = f * B * 3 * 4
+    print(f"per-split psum payload: [F={f}, B={B}, 3] f32 = {hist_bytes/2**20:.2f} MiB")
+    print(f"per-tree ({L - 1} splits): {(L - 1) * hist_bytes / 2**20:.1f} MiB\n")
+    print("| mesh | iters/s | step ms | vs 1-dev |")
+    print("|---|---|---|---|")
+
+    base = None
+    for ndev in (1, 2, 4, 8):
+        os.environ["LGBM_TPU_FORCE_NDEV"] = str(ndev)
+        params = {
+            "objective": "binary",
+            "num_leaves": L,
+            "max_bin": 255,
+            "min_data_in_leaf": 100,
+            "verbosity": -1,
+            "metric": "none",
+            "tree_learner": "data" if ndev > 1 else "serial",
+        }
+        d = lgb.Dataset(X, y, params=params)
+        b = lgb.Booster(params, d)
+        if ndev > 1 and b._mesh is not None:
+            assert len(b._mesh.devices.ravel()) >= 1
+        b.update()  # compile + warmup
+        jax.block_until_ready(b._score)
+        t0 = time.perf_counter()
+        iters = 3
+        for _ in range(iters):
+            b.update()
+        jax.block_until_ready(b._score)
+        dt = (time.perf_counter() - t0) / iters
+        if base is None:
+            base = dt
+        print(
+            f"| {ndev} | {1/dt:.3f} | {dt*1e3:.0f} | {base/dt:.2f}x |",
+            flush=True,
+        )
+
+    print(
+        "\nProjection: on v5e ICI (~100 GB/s/link bidirectional ring), the "
+        f"{hist_bytes/2**20:.2f} MiB all-reduce costs ~"
+        f"{2 * hist_bytes / 100e9 * 1e6:.0f} us/split -> "
+        f"{(L-1) * 2 * hist_bytes / 100e9 * 1e3:.1f} ms/tree at any mesh "
+        "size (ring all-reduce is bandwidth-bound per chip); "
+        "DCN (multi-host, ~25 GB/s) multiplies that by ~4."
+    )
+
+
+if __name__ == "__main__":
+    main()

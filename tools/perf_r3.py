@@ -1,0 +1,178 @@
+"""Round-3 TPU perf harness: partition kernel vs XLA sort, seg_hist, and
+end-to-end training at configurable rows.
+
+Usage (real TPU):
+    python tools/perf_r3.py part      # partition kernel vs sort, by window
+    python tools/perf_r3.py train [rows] [iters]   # e2e iters/s
+    python tools/perf_r3.py profile [rows]         # per-phase decomposition
+
+All timings use the marginal-rep method (two loop lengths) per the round-2
+measurement notes: axon result caching + 30-300 ms dispatch variance make
+naive single-call timings lie.
+"""
+
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def marginal(fn, r1=3, r2=9):
+    """Marginal per-rep cost of fn(i) via two loop lengths."""
+    def run(reps):
+        t0 = time.perf_counter()
+        out = None
+        for i in range(reps):
+            out = fn(i)
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    run(1)  # compile/warm
+    t1 = run(r1)
+    t2 = run(r2)
+    return (t2 - t1) / (r2 - r1)
+
+
+def bench_partition():
+    from lightgbm_tpu.ops.pallas.partition import seg_partition_pallas
+    from lightgbm_tpu.ops.pallas.seg import pack_rows, padded_rows
+    from lightgbm_tpu.ops.segpart import sort_partition_xla
+
+    f, n = 28, 10_500_000
+    n_pad = padded_rows(n)
+    rng = np.random.default_rng(0)
+    bins = rng.integers(0, 256, size=(n, f)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = np.ones(n, np.float32)
+    m = np.ones(n, np.float32)
+    seg = pack_rows(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m), n_pad)
+    seg = jax.device_put(seg)
+    catm = jnp.zeros((1, 256), jnp.float32)
+    print("| window | kernel ms | ns/row | sort ms | ns/row | speedup |")
+    print("|---|---|---|---|---|---|")
+    for cnt in (8192, 65536, 262144, 1 << 20, 1 << 22, n):
+        sb = 12345
+
+        def k_call(i, cnt=cnt, sb=sb):
+            scal = jnp.asarray([sb, cnt, i % f, 120, 0, -1, 0, 0], jnp.int32)
+            s2, nl = seg_partition_pallas(
+                seg, scal, catm, f=f, n_pad=n_pad, use_cat=False
+            )
+            return nl
+
+        def s_call(i, cnt=cnt, sb=sb):
+            s2, nl, nr = sort_partition_xla(
+                seg, jnp.int32(sb), jnp.int32(cnt), jnp.int32(i % f),
+                jnp.int32(120), jnp.int32(0), jnp.int32(-1), jnp.int32(0),
+                jnp.zeros((1,), jnp.float32), f=f, n_pad=n_pad,
+            )
+            return nl
+
+        tk = marginal(k_call)
+        ts = marginal(s_call)
+        print(
+            f"| {cnt} | {tk*1e3:.2f} | {tk/cnt*1e9:.2f} | "
+            f"{ts*1e3:.2f} | {ts/cnt*1e9:.2f} | {ts/tk:.1f}x |",
+            flush=True,
+        )
+
+
+def _make_booster(rows):
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(rows, 28)).astype(np.float32)
+    w = rng.normal(size=28)
+    y = ((X @ w * 0.5 + rng.normal(scale=1.0, size=rows)) > 0).astype(np.float64)
+    params = {
+        "objective": "binary",
+        "num_leaves": 255,
+        "max_bin": 255,
+        "learning_rate": 0.1,
+        "min_data_in_leaf": 100,
+        "verbosity": -1,
+        "metric": "none",
+    }
+    d = lgb.Dataset(X, y, params=params)
+    return lgb.Booster(params, d)
+
+
+def bench_train(rows, iters=8):
+    b = _make_booster(rows)
+    for _ in range(2):
+        b.update()
+    jax.block_until_ready(b._score)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        b.update()
+    jax.block_until_ready(b._score)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"rows={rows}: {1/dt:.3f} iters/s ({dt*1e3:.0f} ms/tree)")
+
+
+def bench_profile(rows):
+    """Decompose one tree: grow vs score-update vs host bookkeeping."""
+    b = _make_booster(rows)
+    b.update(); b.update()
+    jax.block_until_ready(b._score)
+    grad, hess = b.objective.get_gradients(b._score, b._next_rng())
+    mask, grad, hess = b._sample(grad, hess)
+    fm = b._feature_mask_for_iter()
+
+    def grow_only(i):
+        ta, leaf_id = b._grow_one(grad[0] + i * 1e-12, hess[0], mask, fm, None)
+        return leaf_id
+
+    tg = marginal(grow_only, 2, 5)
+    print(f"grow_tree alone: {tg*1e3:.0f} ms/tree")
+
+    def full(i):
+        b.update()
+        return b._score
+
+    tf = marginal(full, 2, 5)
+    print(f"full update:     {tf*1e3:.0f} ms/iter (pipeline overhead {100*(tf-tg)/tf:.0f}%)")
+
+    from lightgbm_tpu.ops.pallas.seg import padded_rows, seg_hist
+
+    n_pad = padded_rows(b._bins.shape[0])
+    seg = b._grow_one  # noqa: placeholder to keep flake quiet
+
+
+def bench_predict(rows=500_000):
+    b = _make_booster(max(rows, 1_000_000))
+    for _ in range(6):
+        b.update()
+    # replicate to 376 trees like bench.py
+    orig_models = list(b.models_)
+    orig_recs = list(b._bin_records)
+    while len(b.models_) < 376:
+        b.models_.extend(orig_models)
+        b._bin_records.extend(orig_recs)
+    del b.models_[376:]
+    del b._bin_records[376:]
+    b._bump_model_version()
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(rows, 28)).astype(np.float32)
+    b.predict(X[:1000])  # compile
+    for tag, xs in (("cold", X), ("warm", X)):
+        t0 = time.perf_counter()
+        b.predict(xs)
+        dt = time.perf_counter() - t0
+        print(f"predict {tag}: {rows/dt:,.0f} preds/s ({dt*1e3:.0f} ms)")
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "part"
+    if mode == "part":
+        bench_partition()
+    elif mode == "train":
+        bench_train(int(sys.argv[2]) if len(sys.argv) > 2 else 1_000_000,
+                    int(sys.argv[3]) if len(sys.argv) > 3 else 8)
+    elif mode == "profile":
+        bench_profile(int(sys.argv[2]) if len(sys.argv) > 2 else 10_500_000)
+    elif mode == "predict":
+        bench_predict()
