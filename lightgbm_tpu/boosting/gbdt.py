@@ -1727,6 +1727,11 @@ class Booster:
         return cum[np.arange(n), first]
 
     def _coerce_predict_input(self, data):
+        from ..dataset import _arrow_to_numpy, _is_arrow
+
+        if _is_arrow(data):
+            maps = getattr(self.train_set, "arrow_categories", None)
+            data = _arrow_to_numpy(data, maps if maps else {})[0]
         try:
             import pandas as pd  # type: ignore
 

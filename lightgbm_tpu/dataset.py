@@ -136,6 +136,97 @@ def _parse_libsvm(lines, path: str) -> Dict[str, Any]:
     return out
 
 
+def _is_arrow(data) -> bool:
+    """True for pyarrow Table/RecordBatch (duck-typed so pyarrow stays an
+    optional dependency, like the reference's header-only arrow ingestion,
+    include/LightGBM/arrow.h)."""
+    t = type(data).__module__
+    return t.startswith("pyarrow") and hasattr(data, "schema") and hasattr(
+        data, "column"
+    )
+
+
+def _arrow_to_numpy(data, category_maps=None):
+    """pyarrow Table/RecordBatch -> (float64 matrix with nulls as NaN,
+    feature names, categorical column names, category_maps).
+
+    Reference analog: the Arrow C-data ingestion
+    (include/LightGBM/arrow.h + c_api LGBM_DatasetCreateFromArrow) — numeric
+    and boolean columns bin as floats, dictionary-encoded columns become
+    categorical features via integer codes.  Codes are made STABLE across
+    tables the way the reference's ``pandas_categorical`` remap is: the
+    training call records each column's dictionary values in
+    ``category_maps``; later tables (predict) remap their codes through the
+    recorded value order, and unseen categories become NaN (routed like
+    missing, matching the reference's unseen-category handling)."""
+    import pyarrow as pa  # deferred; _is_arrow guaranteed pyarrow is loaded
+
+    if isinstance(data, pa.RecordBatch):
+        data = pa.Table.from_batches([data])
+    data = data.combine_chunks()
+    names = [str(c) for c in data.schema.names]
+    record = category_maps is None
+    if record:
+        category_maps = {}
+    cats = []
+    cols = []
+    for i, field in enumerate(data.schema):
+        col = data.column(i)
+        name = names[i]
+        if pa.types.is_dictionary(field.type):
+            cats.append(name)
+            cc = col.combine_chunks()
+            values = [v.as_py() for v in cc.dictionary]
+            codes = cc.indices.to_numpy(zero_copy_only=False).astype(np.float64)
+            mask = col.is_null().to_numpy(zero_copy_only=False)
+            if record:
+                category_maps[name] = values
+            else:
+                train_vals = category_maps.get(name)
+                if train_vals is not None and train_vals != values:
+                    # remap this table's codes onto the TRAIN dictionary
+                    # order; unseen categories -> NaN (missing)
+                    lut = {v: float(j) for j, v in enumerate(train_vals)}
+                    remap = np.array(
+                        [lut.get(v, np.nan) for v in values] or [np.nan]
+                    )
+                    codes = remap[
+                        np.clip(codes, 0, len(values) - 1).astype(np.int64)
+                    ]
+            arr = np.where(mask, np.nan, codes)
+        elif pa.types.is_boolean(field.type) or pa.types.is_floating(
+            field.type
+        ) or pa.types.is_integer(field.type):
+            arr = col.to_numpy(zero_copy_only=False).astype(np.float64)
+        else:
+            raise ValueError(
+                f"Arrow column {name!r} has unsupported type "
+                f"{field.type} (numeric, boolean, or dictionary expected)"
+            )
+        cols.append(arr)
+    mat = (
+        np.stack(cols, axis=1)
+        if cols
+        else np.zeros((data.num_rows, 0), np.float64)
+    )
+    return mat, names, cats, category_maps
+
+
+def _arrow_column_to_numpy(arr):
+    """A pyarrow Array/ChunkedArray — or single-column Table/RecordBatch —
+    as a 1-D numpy array (labels/weights)."""
+    import pyarrow as pa
+
+    if isinstance(arr, (pa.Table, pa.RecordBatch)):
+        if arr.num_columns != 1:
+            raise ValueError(
+                f"expected a single-column Arrow table for a label/weight, "
+                f"got {arr.num_columns} columns"
+            )
+        arr = arr.column(0)
+    return arr.to_numpy(zero_copy_only=False)
+
+
 def _load_text_file(path: str, config: Config) -> Dict[str, Any]:
     """Parse a CSV/TSV/LibSVM training file (reference src/io/parser.cpp);
     LibSVM rows load into a CSR matrix (sparse path), dense CSV/TSV into a
@@ -219,6 +310,7 @@ class Dataset:
         self.metadata: Optional[Metadata] = None
         self.feature_names: List[str] = []
         self.num_total_features: int = 0
+        self.arrow_categories: Optional[Dict[str, list]] = None
         self._device_cache: Dict[str, Any] = {}
 
     # ----------------------------------------------------------- properties
@@ -271,6 +363,19 @@ class Dataset:
             isinstance(d, Sequence) for d in data
         ):
             data = _materialize_sequences(data)
+        if _is_arrow(data):
+            # reuse a reference dataset's dictionaries so valid sets bin
+            # categories consistently with the train set
+            ref_maps = getattr(self.reference, "arrow_categories", None)
+            data, names, cats, self.arrow_categories = _arrow_to_numpy(
+                data, ref_maps
+            )
+            if self._feature_name == "auto" and names is not None:
+                self._feature_name = names
+            if self._categorical_feature == "auto":
+                self._categorical_feature = cats
+        if label is not None and type(label).__module__.startswith("pyarrow"):
+            label = _arrow_column_to_numpy(label)
         if pd is not None and isinstance(data, pd.DataFrame):
             if self._feature_name == "auto":
                 self._feature_name = [str(c) for c in data.columns]
