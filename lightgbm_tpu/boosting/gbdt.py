@@ -341,6 +341,7 @@ class Booster:
         self._mesh = None
         self._pad_rows = 0
         self._multiproc = False  # process-local rows (pre_partition multi-host)
+        self._featpar = 0  # feature-parallel shard count (rows replicated)
         self._proc_row_offset = 0
         if cfg.tree_learner in ("data", "feature", "voting"):
             from jax.sharding import Mesh
@@ -348,7 +349,28 @@ class Booster:
             from ..parallel import DATA_AXIS, choose_devices
 
             devices = choose_devices()
-            if devices is not None and self.objective is not None and self.objective.need_query:
+            if devices is not None and cfg.tree_learner == "feature":
+                # feature-parallel: rows replicated, features sliced
+                # (reference feature_parallel_tree_learner.cpp:37 — every
+                # machine holds the full data).  The mesh shrinks to the
+                # largest device count dividing the used-feature count.
+                f_used_cnt = len(train_set.used_features)
+                dn = 0
+                for d in range(min(len(devices), max(f_used_cnt, 1)), 0, -1):
+                    if f_used_cnt % d == 0:
+                        dn = d
+                        break
+                if dn > 1:
+                    self._featpar = dn
+                    devices = devices[:dn]
+                else:
+                    devices = None  # degenerate: serial
+            if (
+                devices is not None
+                and not self._featpar  # rows replicated: no padding at all
+                and self.objective is not None
+                and self.objective.need_query
+            ):
                 dn = len(devices)
                 while dn > 1 and n % dn != 0:
                     dn -= 1  # ranking rows can't be weight-0 padded
@@ -356,6 +378,13 @@ class Booster:
             if devices is not None:
                 self._mesh = Mesh(np.array(devices), (DATA_AXIS,))
                 nproc = jax.process_count()
+                if nproc > 1 and cfg.pre_partition and self._featpar:
+                    raise ValueError(
+                        "tree_learner='feature' needs the full data on every "
+                        "process (feature_parallel_tree_learner.cpp:37) — it "
+                        "cannot combine with pre_partition row partitioning; "
+                        "use tree_learner='data' for multi-host training"
+                    )
                 if nproc > 1 and cfg.pre_partition:
                     # ---- process-local data feeding (reference: each machine
                     # loads only its partition under pre_partition,
@@ -395,7 +424,7 @@ class Booster:
                     self._proc_row_offset = int(counts[:pidx].sum())
                     self._n_global = int(counts.sum())
                     self._n_dev_global = lpad * nproc
-                else:
+                elif not self._featpar:
                     self._pad_rows = (-n) % len(devices)
         pad = self._pad_rows
         n_dev = n + pad  # LOCAL device rows (== global when single-process)
@@ -447,7 +476,24 @@ class Booster:
             self._has_init_score = False
 
         # device data
-        if self._mesh is not None:
+        if self._mesh is not None and self._featpar:
+            # feature-parallel: every shard holds all rows; the grower
+            # slices features by axis_index internally
+            from ..parallel import replicate
+
+            self._score = replicate(init, self._mesh)
+            self._bins = replicate(train_set.bins, self._mesh)
+            if self.objective is not None:
+                for holder, name, axis in self.objective.per_row_device_arrays():
+                    arr = getattr(holder, name, None)
+                    if arr is None:
+                        continue
+                    setattr(
+                        holder,
+                        name,
+                        replicate(np.asarray(arr, dtype=np.float32), self._mesh),
+                    )
+        elif self._mesh is not None:
             from ..parallel import pad_rows_np, shard_cols, shard_rows
 
             self._score = shard_cols(init, self._mesh, process_local=self._multiproc)
@@ -513,9 +559,14 @@ class Booster:
 
             base = np.ones(n_dev, np.float32)
             base[n:] = 0.0
-            self._ones_mask = shard_rows(
-                base, self._mesh, process_local=self._multiproc
-            )
+            if self._featpar:
+                from ..parallel import replicate
+
+                self._ones_mask = replicate(base, self._mesh)
+            else:
+                self._ones_mask = shard_rows(
+                    base, self._mesh, process_local=self._multiproc
+                )
             self._setup_sharded_grower()
         else:
             self._ones_mask = jnp.ones((n,), jnp.float32)
@@ -620,7 +671,10 @@ class Booster:
         from ..parallel import make_sharded_grow
 
         f_used = self._bins.shape[1]
-        self._sharded_grow = make_sharded_grow(self._mesh, self._grower_params)
+        self._sharded_grow = make_sharded_grow(
+            self._mesh, self._grower_params,
+            feature_parallel=bool(self._featpar),
+        )
         self._mono_arg = (
             self._monotone
             if self._monotone is not None
@@ -860,7 +914,8 @@ class Booster:
         import jax as _jax
 
         seg_ok = (
-            self._max_bin_padded <= 256
+            not self._featpar  # feature-parallel partitions via leaf-id
+            and self._max_bin_padded <= 256
             and 0 < n_used <= 242
             # an explicitly chosen histogram kernel keeps the ordered path
             # (the seg path has its own fixed kernel)
@@ -871,6 +926,7 @@ class Booster:
         )
         if (
             not seg_ok
+            and not self._featpar
             and _jax.default_backend() == "tpu"
             and hist_method == "auto"
             and n_used > 0
@@ -892,7 +948,10 @@ class Booster:
                 "scale). Consider max_bin<=255 or feature selection."
             )
         hist_mode = str(
-            self.params.get("hist_mode", "seg" if seg_ok else "ordered")
+            self.params.get(
+                "hist_mode",
+                "gather" if self._featpar else ("seg" if seg_ok else "ordered"),
+            )
         )
         return GrowerParams(
             num_leaves=cfg.num_leaves,
@@ -917,6 +976,7 @@ class Booster:
                 if (cfg.tree_learner == "voting" and self._mesh is not None)
                 else 0
             ),
+            feature_shard=self._featpar,
             use_interaction=self._interaction_sets is not None,
             feature_fraction_bynode=cfg.feature_fraction_bynode,
             extra_trees=cfg.extra_trees,

@@ -190,9 +190,12 @@ def _arrow_to_numpy(data, category_maps=None):
                     remap = np.array(
                         [lut.get(v, np.nan) for v in values] or [np.nan]
                     )
-                    codes = remap[
-                        np.clip(codes, 0, len(values) - 1).astype(np.int64)
-                    ]
+                    # null slots surface as NaN indices: substitute 0 before
+                    # indexing (the null mask overwrites them below anyway)
+                    safe_idx = np.clip(
+                        np.nan_to_num(codes, nan=0.0), 0, len(values) - 1
+                    ).astype(np.int64)
+                    codes = remap[safe_idx]
             arr = np.where(mask, np.nan, codes)
         elif pa.types.is_boolean(field.type) or pa.types.is_floating(
             field.type

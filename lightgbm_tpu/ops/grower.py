@@ -56,6 +56,16 @@ class GrowerParams:
     # only when F > 2*top_k (see voting_active) — below that dense psum is
     # exact and cheaper, so voting aliases onto the data-parallel path.
     voting_top_k: int = 0
+    # feature-parallel (tree_learner=feature with rows REPLICATED,
+    # reference feature_parallel_tree_learner.cpp:37): every shard holds all
+    # rows, histograms/split-finding cover only its axis_index'th feature
+    # slice (F must divide the shard count), the winning candidate is
+    # all-reduced, and the partition runs locally on the full columns — the
+    # reference's "every machine has full data" design, so no split-result
+    # broadcast is needed.  Requires hist_mode gather/full (the leaf-id
+    # formulation keeps full columns addressable).  Value = number of
+    # feature shards; 0 = off.
+    feature_shard: int = 0
     # categorical split search (sorted-subset scan, feature_histogram.cpp:147);
     # False keeps every cat-related array at width 1 (static no-op)
     use_cat: bool = False
@@ -457,7 +467,92 @@ def grow_tree(
     # voting-parallel: histograms stay LOCAL; only elected slices are
     # psummed inside _candidate_for_leaf (scalar stats still psum globally)
     use_voting = voting_active(p, f)
-    hist_axis = None if use_voting else p.axis_name
+    # feature-parallel: rows replicated, features sliced per shard — no
+    # histogram psum at all; the only collective is the winner all-reduce
+    use_featpar = (
+        p.feature_shard > 1 and p.axis_name is not None and f > 0
+    )
+    if use_featpar:
+        if p.hist_mode not in ("gather", "full"):
+            raise ValueError(
+                "feature-parallel training needs hist_mode='gather' or "
+                "'full' (full columns stay addressable for the partition)"
+            )
+        if f % p.feature_shard:
+            raise ValueError(
+                f"feature count {f} must divide feature_shard="
+                f"{p.feature_shard}"
+            )
+        if p.n_forced > 0:
+            raise ValueError(
+                "forced splits are not supported with feature-parallel "
+                "training (histogram rows live on the owning shard)"
+            )
+        f_loc = f // p.feature_shard
+        sh_lo = lax.axis_index(p.axis_name) * f_loc
+
+        def _fslice(arr, axis=0):
+            return lax.dynamic_slice_in_dim(arr, sh_lo, f_loc, axis=axis)
+
+        def _featpar_reduce(cand: SplitCandidate) -> SplitCandidate:
+            """All-reduce the best candidate across feature shards
+            (reference SyncUpGlobalBestSplit, feature_parallel_tree_learner
+            .cpp:74 — here a pmax + owner-selected psum broadcast)."""
+            gmax = lax.pmax(cand.gain, p.axis_name)
+            idx = lax.axis_index(p.axis_name)
+            owner = lax.pmin(
+                jnp.where(cand.gain >= gmax, idx, p.feature_shard),
+                p.axis_name,
+            )
+            mine = (idx == owner) & jnp.isfinite(gmax)
+
+            def bc(x):
+                xf = jnp.where(mine, x, jnp.zeros_like(x))
+                return lax.psum(xf, p.axis_name)
+
+            return SplitCandidate(
+                gain=gmax,
+                feature=bc(cand.feature + sh_lo),
+                bin=bc(cand.bin),
+                default_left=bc(cand.default_left.astype(jnp.int32)) != 0,
+                left_g=bc(cand.left_g),
+                left_h=bc(cand.left_h),
+                left_cnt=bc(cand.left_cnt),
+                right_g=bc(cand.right_g),
+                right_h=bc(cand.right_h),
+                right_cnt=bc(cand.right_cnt),
+                is_cat=bc(cand.is_cat.astype(jnp.int32)) != 0,
+                cat_mask=bc(cand.cat_mask.astype(jnp.int32)) != 0,
+            )
+    else:
+        f_loc = f
+
+        def _fslice(arr, axis=0):
+            return arr
+
+    hist_axis = None if (use_voting or use_featpar) else p.axis_name
+
+    def cand_for_leaf(hist, g, h, c, fm, lb=None, ub=None, pout=0.0,
+                      rand=None, cpen=None):
+        """Leaf candidate with the distributed-mode plumbing: per-feature
+        operand slicing + winner all-reduce under feature-parallel; voting
+        election happens inside _candidate_for_leaf."""
+        if not use_featpar:
+            return _candidate_for_leaf(
+                hist, g, h, c, num_bins, nan_bins, fm, p,
+                monotone=mono_arr, lb=lb, ub=ub, parent_output=pout,
+                is_cat=is_cat_arr, cegb_penalty=cpen, rand_bins=rand,
+            )
+        cand = _candidate_for_leaf(
+            hist, g, h, c, _fslice(num_bins), _fslice(nan_bins),
+            _fslice(fm), p,
+            monotone=_fslice(mono_arr) if mono_arr is not None else None,
+            lb=lb, ub=ub, parent_output=pout,
+            is_cat=_fslice(is_cat_arr) if is_cat_arr is not None else None,
+            cegb_penalty=_fslice(cpen) if cpen is not None else None,
+            rand_bins=_fslice(rand) if rand is not None else None,
+        )
+        return _featpar_reduce(cand)
 
     if use_seg:
         from .pallas.seg import pack_rows, padded_rows, seg_hist, stat_lanes
@@ -486,11 +581,19 @@ def grow_tree(
             return hist
     if use_ordered or use_gather:
         caps = sorted(
-            _hist_caps(n, full_range=p.axis_name is not None)
+            _hist_caps(
+                n,
+                full_range=(p.axis_name is not None and p.feature_shard <= 1),
+            )
         )  # ascending child-histogram capacities
         caps_arr = jnp.asarray(caps, dtype=jnp.int32)
         # one zero padding row so fill indices contribute nothing
         bins_pad = jnp.concatenate([bins, jnp.zeros((1, f), bins.dtype)], axis=0)
+        # feature-parallel: slice ONCE here — slicing inside the per-leaf
+        # branch would gather rows at full F width first, negating the /D
+        # data-volume split (gathers serialize on TPU)
+        bins_pad_loc = _fslice(bins_pad, axis=1)
+        bins_loc = _fslice(bins, axis=1)
         grad_pad = jnp.concatenate([grad, jnp.zeros((1,), grad.dtype)])
         hess_pad = jnp.concatenate([hess, jnp.zeros((1,), hess.dtype)])
         mask_pad = jnp.concatenate([count_mask, jnp.zeros((1,), count_mask.dtype)])
@@ -502,7 +605,7 @@ def grow_tree(
             def branch(member):
                 (idx,) = jnp.nonzero(member, size=cap, fill_value=n)
                 return leaf_histogram(
-                    bins_pad[idx],
+                    bins_pad_loc[idx],
                     grad_pad[idx],
                     hess_pad[idx],
                     mask_pad[idx],
@@ -596,7 +699,10 @@ def grow_tree(
             hist0 = _seg_hist(seg0, jnp.int32(0), jnp.int32(n))
         else:
             hist0 = leaf_histogram(
-                bins, grad, hess, count_mask, B, method=p.hist_method,
+                bins_loc if (use_ordered or use_gather or p.hist_mode == "full")
+                else _fslice(bins, axis=1),
+                grad, hess, count_mask, B,
+                method=p.hist_method,
                 axis_name=hist_axis, quant_scales=quant_scales,
             )
     totals = hist0[0].sum(axis=0)  # every row lands in exactly one bin of feature 0
@@ -605,16 +711,14 @@ def grow_tree(
     root_used = jnp.zeros((f,), bool)
     neg_inf_s = jnp.float32(-jnp.inf)
     pos_inf_s = jnp.float32(jnp.inf)
-    cand0 = _candidate_for_leaf(
-        hist0, totals[0], totals[1], totals[2], num_bins, nan_bins,
-        node_feature_mask(0, root_used), p,
-        monotone=mono_arr,
+    cand0 = cand_for_leaf(
+        hist0, totals[0], totals[1], totals[2],
+        node_feature_mask(0, root_used),
         lb=neg_inf_s if use_mono else None,
         ub=pos_inf_s if use_mono else None,
-        parent_output=leaf_output(totals[0], totals[1], p.lambda_l1, p.lambda_l2, p.max_delta_step),
-        is_cat=is_cat_arr,
-        cegb_penalty=_cegb_pen(cegb_used0),
-        rand_bins=node_rand_bins(0),
+        pout=leaf_output(totals[0], totals[1], p.lambda_l1, p.lambda_l2, p.max_delta_step),
+        cpen=_cegb_pen(cegb_used0),
+        rand=node_rand_bins(0),
     )
 
     neg_inf = jnp.full((L,), -jnp.inf, dtype=jnp.float32)
@@ -661,7 +765,7 @@ def grow_tree(
         order=order0,
         leaf_begin=leaf_begin0,
         leaf_nrows=leaf_nrows0,
-        hist_buf=jnp.zeros((L, f, B, 3), jnp.float32).at[0].set(hist0),
+        hist_buf=jnp.zeros((L, f_loc, B, 3), jnp.float32).at[0].set(hist0),
         leaf_g=jnp.zeros((L,), jnp.float32).at[0].set(totals[0]),
         leaf_h=jnp.zeros((L,), jnp.float32).at[0].set(totals[1]),
         leaf_cnt=jnp.zeros((L,), jnp.float32).at[0].set(totals[2]),
@@ -900,7 +1004,7 @@ def grow_tree(
             rows_l = jnp.sum(in_leaf & go_left).astype(jnp.int32)
             rows_in = jnp.sum(in_leaf).astype(jnp.int32)
             rows_r = rows_in - rows_l
-            if p.axis_name is not None:
+            if p.axis_name is not None and not use_featpar:
                 # the smaller-child choice must be GLOBAL: if shards chose
                 # locally, some would histogram the left child and others
                 # the right, and the psum would mix the two (the reference
@@ -941,7 +1045,8 @@ def grow_tree(
             target = jnp.where(left_smaller, l, nl)
             mask = count_mask * (leaf_id == target) * can_split
             sm = leaf_histogram(
-                bins, grad, hess, mask, B, method=p.hist_method,
+                bins_loc, grad, hess, mask, B,
+                method=p.hist_method,
                 axis_name=hist_axis, quant_scales=quant_scales,
             )
 
@@ -1177,15 +1282,9 @@ def grow_tree(
                 i = 2
             if use_rand:
                 rbv = rest[i]
-            return _candidate_for_leaf(
-                hist, g_, h_, c_, num_bins, nan_bins, fm, p,
-                monotone=mono_arr,
-                lb=lbv,
-                ub=ubv,
-                parent_output=po,
-                is_cat=is_cat_arr,
-                cegb_penalty=cpen,
-                rand_bins=rbv,
+            return cand_for_leaf(
+                hist, g_, h_, c_, fm,
+                lb=lbv, ub=ubv, pout=po, cpen=cpen, rand=rbv,
             )
 
         cand2 = jax.vmap(_child_cand)(hist2, g2, h2, c2, fm2, po2, *opt2)

@@ -77,16 +77,28 @@ def pad_rows_np(arr: np.ndarray, pad: int, fill=0):
     return np.pad(arr, widths, constant_values=fill)
 
 
-def make_sharded_grow(mesh: Mesh, params: GrowerParams, axis_name: str = DATA_AXIS):
+def make_sharded_grow(
+    mesh: Mesh,
+    params: GrowerParams,
+    axis_name: str = DATA_AXIS,
+    feature_parallel: bool = False,
+):
     """shard_map'd grow_tree over the mesh's data axis.
 
-    Every shard runs the identical leaf loop on its local rows; histograms and
-    root totals are psummed inside (ops/grower.py) so all shards compute the
-    IDENTICAL tree — the reference's histogram ReduceScatter + SplitInfo
-    Allreduce (src/treelearner/data_parallel_tree_learner.cpp:225-302) as XLA
+    Data-parallel (default): every shard runs the identical leaf loop on its
+    local rows; histograms and root totals are psummed inside
+    (ops/grower.py) so all shards compute the IDENTICAL tree — the
+    reference's histogram ReduceScatter + SplitInfo Allreduce
+    (src/treelearner/data_parallel_tree_learner.cpp:225-302) as XLA
     collectives. Inputs: row-sharded (bins, grad, hess, mask), replicated
     (num_bins, nan_bins, feature_mask, monotone, interaction_sets, rng).
-    Returns (TreeArrays replicated, leaf_id row-sharded)."""
+    Returns (TreeArrays replicated, leaf_id row-sharded).
+
+    Feature-parallel (``feature_parallel=True``): every operand is
+    REPLICATED (each shard holds all rows) and the grower slices features by
+    axis_index internally; the only collective is the winner all-reduce
+    (reference feature_parallel_tree_learner.cpp:74).  leaf_id comes back
+    replicated (every shard partitions identically)."""
     p = dataclasses.replace(params, axis_name=axis_name)
 
     def local(bins, grad, hess, mask, num_bins, nan_bins, feature_mask,
@@ -99,16 +111,21 @@ def make_sharded_grow(mesh: Mesh, params: GrowerParams, axis_name: str = DATA_AX
             cegb_used=cegb_used, quant_scales=quant_scales,
         )
 
-    sh = P(axis_name)
-    sh2 = P(axis_name, None)
     rep = P()
+    if feature_parallel:
+        sh = sh2 = rep  # rows replicated; features sliced inside grow_tree
+        leaf_out = rep
+    else:
+        sh = P(axis_name)
+        sh2 = P(axis_name, None)
+        leaf_out = sh
     fn = jax.shard_map(
         local,
         mesh=mesh,
         in_specs=(sh2, sh, sh, sh, rep, rep, rep, rep, rep, rep, rep, rep, rep, rep, rep),
         out_specs=(
             jax.tree.map(lambda _: rep, TreeArrays(*([0] * len(TreeArrays._fields)))),
-            sh,
+            leaf_out,
         ),
         check_vma=False,
     )

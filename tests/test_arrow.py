@@ -92,3 +92,22 @@ def test_arrow_single_column_table_label():
     d = lgb.Dataset(t, pa.table({"y": pa.array(y)}), params=params)
     b = lgb.train(params, d, 3)
     assert np.isfinite(b.predict(t)).all()
+
+
+def test_arrow_dictionary_remap_with_nulls():
+    """Nulls in a reordered-dictionary predict table must stay missing, not
+    crash the remap (ADVICE r3)."""
+    t, y, _ = _table(600, seed=5)
+    params = {"objective": "regression", "verbosity": -1, "min_data_in_leaf": 5}
+    b = lgb.train(params, lgb.Dataset(t, y, params=params), 5)
+    strings = t.column("cat").combine_chunks().cast(pa.string())
+    idxs = [
+        None if i % 7 == 0 else list("tsrqp").index(s.as_py())
+        for i, s in enumerate(strings)
+    ]
+    rev = pa.DictionaryArray.from_arrays(
+        pa.array(idxs, pa.int32()), pa.array(list("tsrqp"))
+    )
+    t2 = pa.table({"a": t.column("a"), "b": t.column("b"), "cat": rev})
+    p = b.predict(t2)
+    assert np.isfinite(p).all()

@@ -1,0 +1,100 @@
+"""Feature-parallel learner tests (reference:
+src/treelearner/feature_parallel_tree_learner.cpp — every machine holds the
+full data, features are partitioned for histogram/split-finding, the best
+split is all-reduced, partitioning is local).
+
+The TPU formulation (ops/grower.py feature_shard) slices features by mesh
+axis_index and all-reduces the winner; results must equal serial training
+EXACTLY (same histograms, same scan, deterministic tie-break by shard
+order = feature order)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _data(n=3000, f=16, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (
+        X[:, 0] * 2 + np.sin(X[:, 5]) - X[:, min(11, f - 1)]
+        + rng.normal(scale=0.3, size=n)
+    )
+    return X, y
+
+
+def _trees(model_str):
+    return model_str.split("\nparameters:")[0]
+
+
+def test_feature_parallel_matches_serial():
+    X, y = _data()
+    out = {}
+    for tl in ("serial", "feature"):
+        params = {
+            "objective": "regression",
+            "num_leaves": 31,
+            "verbosity": -1,
+            "metric": "none",
+            "tree_learner": tl,
+            "max_bin": 63,
+        }
+        b = lgb.train(params, lgb.Dataset(X, y, params=params), 5)
+        if tl == "feature":
+            assert b._featpar > 1, "feature-parallel mesh did not engage"
+        out[tl] = _trees(b.model_to_string())
+    assert out["serial"] == out["feature"]
+
+
+def test_feature_parallel_non_divisible_feature_count():
+    # 13 features: the mesh shrinks to a divisor (13 devices unavailable ->
+    # 1) and training falls back to serial without error
+    X, y = _data(f=13, seed=1)
+    params = {
+        "objective": "regression",
+        "num_leaves": 15,
+        "verbosity": -1,
+        "metric": "none",
+        "tree_learner": "feature",
+        "max_bin": 63,
+    }
+    b = lgb.train(params, lgb.Dataset(X, y, params=params), 10)
+    p = b.predict(X)
+    assert float(np.mean((p - y) ** 2)) < 0.6 * float(np.var(y))
+
+
+def test_feature_parallel_multiclass_and_nan():
+    X, y = _data(f=8, seed=2)
+    X[::7, 3] = np.nan
+    yc = np.digitize(y, np.quantile(y[np.isfinite(y)], [0.33, 0.66]))
+    out = {}
+    for tl in ("serial", "feature"):
+        params = {
+            "objective": "multiclass",
+            "num_class": 3,
+            "num_leaves": 15,
+            "verbosity": -1,
+            "metric": "none",
+            "tree_learner": tl,
+            "max_bin": 63,
+        }
+        b = lgb.train(params, lgb.Dataset(X, yc, params=params), 3)
+        out[tl] = _trees(b.model_to_string())
+    assert out["serial"] == out["feature"]
+
+
+def test_feature_parallel_non_divisible_rows():
+    """Rows are replicated (never padded): n not divisible by the shard
+    count must work (ADVICE r3 — padding was computed but bins unpadded)."""
+    X, y = _data(n=2999, f=16, seed=4)
+    params = {
+        "objective": "regression",
+        "num_leaves": 15,
+        "verbosity": -1,
+        "metric": "none",
+        "tree_learner": "feature",
+        "max_bin": 63,
+    }
+    b = lgb.train(params, lgb.Dataset(X, y, params=params), 3)
+    assert b._featpar > 1
+    assert np.isfinite(b.predict(X)).all()
