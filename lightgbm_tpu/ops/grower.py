@@ -469,6 +469,7 @@ def grow_tree(
     use_voting = voting_active(p, f)
     # feature-parallel: rows replicated, features sliced per shard — no
     # histogram psum at all; the only collective is the winner all-reduce
+    # (plus the root-totals broadcast below)
     use_featpar = (
         p.feature_shard > 1 and p.axis_name is not None and f > 0
     )
@@ -531,6 +532,9 @@ def grow_tree(
             return arr
 
     hist_axis = None if (use_voting or use_featpar) else p.axis_name
+    # per-shard feature slice of the bin matrix (identity when not
+    # feature-parallel) — used by the full-mode and root histograms
+    bins_loc = _fslice(bins, axis=1) if f > 0 else bins
 
     def cand_for_leaf(hist, g, h, c, fm, lb=None, ub=None, pout=0.0,
                       rand=None, cpen=None):
@@ -593,7 +597,6 @@ def grow_tree(
         # branch would gather rows at full F width first, negating the /D
         # data-volume split (gathers serialize on TPU)
         bins_pad_loc = _fslice(bins_pad, axis=1)
-        bins_loc = _fslice(bins, axis=1)
         grad_pad = jnp.concatenate([grad, jnp.zeros((1,), grad.dtype)])
         hess_pad = jnp.concatenate([hess, jnp.zeros((1,), hess.dtype)])
         mask_pad = jnp.concatenate([count_mask, jnp.zeros((1,), count_mask.dtype)])
@@ -699,15 +702,20 @@ def grow_tree(
             hist0 = _seg_hist(seg0, jnp.int32(0), jnp.int32(n))
         else:
             hist0 = leaf_histogram(
-                bins_loc if (use_ordered or use_gather or p.hist_mode == "full")
-                else _fslice(bins, axis=1),
-                grad, hess, count_mask, B,
+                bins_loc, grad, hess, count_mask, B,
                 method=p.hist_method,
                 axis_name=hist_axis, quant_scales=quant_scales,
             )
     totals = hist0[0].sum(axis=0)  # every row lands in exactly one bin of feature 0
     if use_voting:
         totals = lax.psum(totals, p.axis_name)  # global root stats
+    if use_featpar:
+        # every shard derives totals from a DIFFERENT local feature's bins:
+        # the values agree only up to summation order, and downstream gains
+        # must be bit-identical across shards (out_specs declare the tree
+        # replicated) — broadcast shard 0's totals
+        idx0 = lax.axis_index(p.axis_name) == 0
+        totals = lax.psum(jnp.where(idx0, totals, jnp.zeros_like(totals)), p.axis_name)
     root_used = jnp.zeros((f,), bool)
     neg_inf_s = jnp.float32(-jnp.inf)
     pos_inf_s = jnp.float32(jnp.inf)

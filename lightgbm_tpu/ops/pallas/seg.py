@@ -33,14 +33,12 @@ histogram kernel DMAs [LANES, T] column tiles (minor-dim starts 128-aligned,
 misalignment folded into the validity mask) and transposes each tile in
 VMEM.
 
-Precision contract (ADVICE r2): the histogram accumulates grad/hess as a
-TWO-TERM bf16 hi/lo split (~17 mantissa bits per addend, f32 accumulators),
-vs f32 addends in the other modes and double histograms in the reference.
-Oracle tests pin the error at <2e-3 relative; near-tie split decisions can
-flip vs the f64 reference, which golden-model parity tests tolerate by
-comparing structure with that epsilon in mind.  If parity ever drifts, add
-a third residual term (exact f32 needs only one more matmul row) before
-touching tolerances.
+Precision contract (ADVICE r2, tightened r3): the histogram accumulates
+grad/hess as a THREE-TERM bf16 split (~26 mantissa bits per addend — i.e.
+f32-accurate for all practical gradients, the extra rows ride the matmul's
+6->8 sublane padding for free) with f32 accumulators, vs double histograms
+in the reference.  Near-tie split decisions can still flip vs the f64
+reference within f32 epsilon, which golden-model parity tests tolerate.
 """
 
 from __future__ import annotations
@@ -174,14 +172,15 @@ def _seg_hist_kernel(
     scal_ref,  # SMEM [2] i32: start, cnt
     seg_any,  # ANY [LANES, n_pad] i16 (plane-major)
     out_ref,  # VMEM [3, F * bpad] f32
-    in_stage,  # VMEM [LANES, TILE] i16
-    acc,  # VMEM [6, F * bpad] f32
+    in_stage,  # VMEM [SUB, TILE] i16 — only the used planes are DMA'd
+    acc,  # VMEM [8, F * bpad] f32
     onehot,  # VMEM [TILE, group * bpad] bf16
     sem_in,
     *,
     f: int,
     bpad: int,
     group: int,
+    sub: int,
 ):
     start = scal_ref[0]
     cnt = scal_ref[1]
@@ -196,7 +195,8 @@ def _seg_hist_kernel(
     def body(t, _):
         dma = pltpu.make_async_copy(
             seg_any.at[
-                :, pl.ds(pl.multiple_of(abegin + t * TILE, COL_ALIGN), TILE)
+                pl.ds(0, sub),
+                pl.ds(pl.multiple_of(abegin + t * TILE, COL_ALIGN), TILE),
             ],
             in_stage,
             sem_in,
@@ -204,7 +204,7 @@ def _seg_hist_kernel(
         dma.start()
         dma.wait()
         # transpose the plane-major tile to row-major for the one-hot matmul
-        xu = (in_stage[...].astype(jnp.int32) & 0xFFFF).T  # [TILE, LANES]
+        xu = (in_stage[...].astype(jnp.int32) & 0xFFFF).T  # [TILE, SUB]
         pos = iota_rows + t * TILE
         valid = ((pos >= off) & (pos < off + cnt)).astype(jnp.float32)
         g = lax.bitcast_convert_type(
@@ -216,10 +216,18 @@ def _seg_hist_kernel(
         m = xu[:, M].astype(jnp.float32) * valid
         gm = g * m
         hm = h * m
+        # THREE-term bf16 split of each f32 addend (~26 mantissa bits) —
+        # the matmul M-dim pads 6 -> 8 sublanes anyway, so the two extra
+        # residual rows are free MXU work (ADVICE r2: tighter precision
+        # contract at zero cost)
         g_hi = gm.astype(jnp.bfloat16)
-        g_lo = (gm - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        g_r1 = gm - g_hi.astype(jnp.float32)
+        g_lo = g_r1.astype(jnp.bfloat16)
+        g_lo2 = (g_r1 - g_lo.astype(jnp.float32)).astype(jnp.bfloat16)
         h_hi = hm.astype(jnp.bfloat16)
-        h_lo = (hm - h_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        h_r1 = hm - h_hi.astype(jnp.float32)
+        h_lo = h_r1.astype(jnp.bfloat16)
+        h_lo2 = (h_r1 - h_lo.astype(jnp.float32)).astype(jnp.bfloat16)
         ghc6 = jnp.concatenate(
             [
                 g_hi[:, None],
@@ -228,9 +236,11 @@ def _seg_hist_kernel(
                 g_lo[:, None],
                 h_lo[:, None],
                 jnp.zeros((TILE, 1), jnp.bfloat16),
+                g_lo2[:, None],
+                h_lo2[:, None],
             ],
             axis=1,
-        )  # [TILE, 6]
+        )  # [TILE, 8]
         ngroups = (f + group - 1) // group
         for gi in range(ngroups):
             basef = gi * group
@@ -245,18 +255,21 @@ def _seg_hist_kernel(
                 onehot[:, nf * bpad :] = jnp.zeros(
                     (TILE, (group - nf) * bpad), jnp.bfloat16
                 )
-            part6 = jax.lax.dot_general(
+            part8 = jax.lax.dot_general(
                 ghc6,
                 onehot[...],
                 dimension_numbers=(((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )  # [6, group * bpad]
+            )  # [8, group * bpad]
             width = nf * bpad
-            acc[:, basef * bpad : basef * bpad + width] += part6[:, :width]
+            acc[:, basef * bpad : basef * bpad + width] += part8[:, :width]
         return 0
 
     lax.fori_loop(0, nt, body, 0)
-    out_ref[...] = acc[:3, :] + acc[3:, :]
+    # rows: 0 g_hi, 1 h_hi, 2 count, 3 g_lo, 4 h_lo, 5 zero, 6 g_lo2, 7 h_lo2
+    out_ref[...] = acc[:3, :] + acc[3:6, :]
+    out_ref[0, :] += acc[6, :]
+    out_ref[1, :] += acc[7, :]
 
 
 @functools.partial(jax.jit, static_argnames=("f", "num_bins", "n_pad", "interpret"))
@@ -272,7 +285,12 @@ def seg_hist_pallas(
     """Histogram [F, B, 3] (g, h, count) of packed rows [start, start+cnt)."""
     bpad = (max(num_bins, 1) + 127) // 128 * 128
     group = min(max(1, _TARGET_LANES // bpad), f)
-    kernel = functools.partial(_seg_hist_kernel, f=f, bpad=bpad, group=group)
+    # DMA only the used planes (bins + stats), padded to an i16 sublane
+    # multiple — at F=28 this cuts tile DMA volume ~6x vs all 128 planes
+    sub = min(LANES, (used_lanes(f) + 15) // 16 * 16)
+    kernel = functools.partial(
+        _seg_hist_kernel, f=f, bpad=bpad, group=group, sub=sub
+    )
     out = pl.pallas_call(
         kernel,
         grid=(1,),
@@ -283,8 +301,8 @@ def seg_hist_pallas(
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((3, f * bpad), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((LANES, TILE), jnp.int16),
-            pltpu.VMEM((6, f * bpad), jnp.float32),
+            pltpu.VMEM((sub, TILE), jnp.int16),
+            pltpu.VMEM((8, f * bpad), jnp.float32),
             pltpu.VMEM((TILE, group * bpad), jnp.bfloat16),
             pltpu.SemaphoreType.DMA,
         ],
