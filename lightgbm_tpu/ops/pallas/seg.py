@@ -17,7 +17,8 @@ keep the training rows PHYSICALLY in leaf-segment order, so that:
   * the histogram of any leaf is one contiguous DMA stream over the packed
     rows — the kernel below — with zero gathers.
 
-Storage layout: one PLANE-MAJOR i16 matrix ``[LANES=128, n_pad]`` — plane p,
+Storage layout: one PLANE-MAJOR i16 matrix ``[storage_lanes(F), n_pad]``
+(used planes rounded to a 32-sublane tile; 128 is the hard cap) — plane p,
 data-row r.  Planes [0, ceil(F/2)) hold bins byte-packed two features per
 plane (feature j lives in byte j&1 of plane j>>1); then 7 stat planes:
 g_lo16, g_hi16, h_lo16, h_hi16 (the EXACT f32 bit patterns of grad/hess
@@ -29,9 +30,9 @@ Plane-major is the layout XLA itself assigns this loop-carried matrix (the
 sort-partition reads whole planes); storing it that way keeps every consumer
 layout-native — the row-major alternative made XLA insert TWO full-array
 relayout copies per split (~0.3 ms each at 1M rows, measured).  The
-histogram kernel DMAs [LANES, T] column tiles (minor-dim starts 128-aligned,
-misalignment folded into the validity mask) and transposes each tile in
-VMEM.
+histogram kernel DMAs [sub, T] column tiles covering only the used planes
+(minor-dim starts 128-aligned, misalignment folded into the validity mask)
+and transposes each tile in VMEM.
 
 Precision contract (ADVICE r2, tightened r3): the histogram accumulates
 grad/hess as a THREE-TERM bf16 split (~26 mantissa bits per addend — i.e.
@@ -56,7 +57,7 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
-LANES = 128
+LANES = 128  # hard cap on packed planes (128 i16 sublane budget)
 TILE = 512  # rows per DMA tile in seg_hist
 N_STAT_LANES = 7
 MAX_SEG_BIN = 256  # byte-packed bins: values must fit u8
@@ -75,6 +76,13 @@ def stat_lanes(f: int) -> Tuple[int, int, int, int, int, int, int]:
 
 def used_lanes(f: int) -> int:
     return bin_lanes(f) + N_STAT_LANES
+
+
+def storage_lanes(f: int) -> int:
+    """Allocated planes: used planes rounded to an i16 sublane-tile multiple
+    (32).  Storing only these — not the full 128 cap — cuts the segment
+    matrix HBM footprint 4x at F=28 (2.7 GB -> 0.7 GB at 10.5M rows)."""
+    return min(LANES, -(-used_lanes(f) // 32) * 32)
 
 
 COL_ALIGN = 128  # minor-dim DMA starts must be 128-lane aligned
@@ -129,7 +137,9 @@ def pack_rows(
         _u16(ridx >> 16)[None, :],
     ]
     packed = jnp.concatenate(planes, axis=0)
-    packed = jnp.pad(packed, ((0, LANES - packed.shape[0]), (0, n_pad - n)))
+    packed = jnp.pad(
+        packed, ((0, storage_lanes(f) - packed.shape[0]), (0, n_pad - n))
+    )
     return packed
 
 
@@ -228,7 +238,7 @@ def _seg_hist_kernel(
         h_r1 = hm - h_hi.astype(jnp.float32)
         h_lo = h_r1.astype(jnp.bfloat16)
         h_lo2 = (h_r1 - h_lo.astype(jnp.float32)).astype(jnp.bfloat16)
-        ghc6 = jnp.concatenate(
+        ghc8 = jnp.concatenate(
             [
                 g_hi[:, None],
                 h_hi[:, None],
@@ -256,7 +266,7 @@ def _seg_hist_kernel(
                     (TILE, (group - nf) * bpad), jnp.bfloat16
                 )
             part8 = jax.lax.dot_general(
-                ghc6,
+                ghc8,
                 onehot[...],
                 dimension_numbers=(((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -286,8 +296,8 @@ def seg_hist_pallas(
     bpad = (max(num_bins, 1) + 127) // 128 * 128
     group = min(max(1, _TARGET_LANES // bpad), f)
     # DMA only the used planes (bins + stats), padded to an i16 sublane
-    # multiple — at F=28 this cuts tile DMA volume ~6x vs all 128 planes
-    sub = min(LANES, (used_lanes(f) + 15) // 16 * 16)
+    # multiple — 32 planes at F=28, 4x less tile traffic than the 128 cap
+    sub = min(storage_lanes(f), (used_lanes(f) + 15) // 16 * 16)
     kernel = functools.partial(
         _seg_hist_kernel, f=f, bpad=bpad, group=group, sub=sub
     )

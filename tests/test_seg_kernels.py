@@ -109,7 +109,7 @@ def test_seg_hist_vs_oracle(packed, st, cnt):
     ref = leaf_histogram_segment(bo, go, ho, mo, 256)
     d = np.abs(np.asarray(hs) - np.asarray(ref)).max()
     rel = d / max(1e-9, np.abs(np.asarray(ref)).max())
-    assert rel < 2e-3
+    assert rel < 5e-6  # three-term bf16 split: ~26-bit addends (r3)
 
 
 @pytest.mark.parametrize("st,cnt", [(0, 5000), (17, 3000), (1000, 37)])
@@ -128,7 +128,7 @@ def test_seg_hist_pallas_kernel_interpret(packed, st, cnt):
     ref = leaf_histogram_segment(bo, go, ho, mo, 256)
     d = np.abs(np.asarray(hs) - np.asarray(ref)).max()
     rel = d / max(1e-9, np.abs(np.asarray(ref)).max())
-    assert rel < 2e-3
+    assert rel < 5e-6  # three-term bf16 split: ~26-bit addends (r3)
 
 
 def test_leaf_mapping_roundtrip(packed):
