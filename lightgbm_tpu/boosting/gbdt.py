@@ -906,20 +906,27 @@ class Booster:
 
         cfg = self.config
         hist_method = str(self.params.get("hist_method", "auto"))
-        # segment-resident mode (sort-partition + streaming histograms,
-        # ops/segpart.py) is the fast path on TPU: eligible whenever bins fit
-        # a byte and the packed row fits 128 i16 lanes; the quantized int8
-        # kernel keeps the ordered path (it histograms int8 grad pairs)
+        # segment-resident mode (streaming partition + histogram kernels,
+        # ops/pallas/) is the fast path on TPU: eligible whenever bins fit
+        # a byte and the packed row fits 128 i16 lanes; hist_method
+        # 'pallas_int8' rides the seg path's own int8 grid kernel (r3)
         n_used = len(self.train_set.used_features) if self.train_set else 0
         import jax as _jax
+
+        if hist_method.startswith("pallas_int8") and not cfg.use_quantized_grad:
+            raise ValueError(
+                "hist_method='pallas_int8' needs quantized gradients "
+                "(use_quantized_grad=True provides the scales)"
+            )
 
         seg_ok = (
             not self._featpar  # feature-parallel partitions via leaf-id
             and self._max_bin_padded <= 256
             and 0 < n_used <= 242
-            # an explicitly chosen histogram kernel keeps the ordered path
-            # (the seg path has its own fixed kernel)
-            and hist_method == "auto"
+            # the seg path has its own kernels: the default bf16 three-term
+            # one and (r3) an int8 grid variant for quantized training;
+            # other explicit kernel choices keep the ordered path
+            and hist_method in ("auto", "pallas_int8")
             # off-TPU the seg histogram falls back to a masked full-N pass
             # per split — ordered mode's O(parent segment) wins there
             and _jax.default_backend() == "tpu"

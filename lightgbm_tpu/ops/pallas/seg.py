@@ -180,17 +180,19 @@ _TARGET_LANES = 2048
 
 def _seg_hist_kernel(
     scal_ref,  # SMEM [2] i32: start, cnt
+    scales_ref,  # SMEM [2] f32: g_scale, h_scale (quantized mode; else 1s)
     seg_any,  # ANY [LANES, n_pad] i16 (plane-major)
     out_ref,  # VMEM [3, F * bpad] f32
     in_stage,  # VMEM [SUB, TILE] i16 — only the used planes are DMA'd
-    acc,  # VMEM [8, F * bpad] f32
-    onehot,  # VMEM [TILE, group * bpad] bf16
+    acc,  # VMEM [8 | 4, F * bpad] f32 | i32
+    onehot,  # VMEM [TILE, group * bpad] bf16 | i8
     sem_in,
     *,
     f: int,
     bpad: int,
     group: int,
     sub: int,
+    quantized: bool,
 ):
     start = scal_ref[0]
     cnt = scal_ref[1]
@@ -226,6 +228,52 @@ def _seg_hist_kernel(
         m = xu[:, M].astype(jnp.float32) * valid
         gm = g * m
         hm = h * m
+        def _accumulate(stats_mat, oh_dtype, pref):
+            """Shared group loop: build the one-hot block per feature group
+            and contract rows on the MXU into acc."""
+            ngroups = (f + group - 1) // group
+            for gi in range(ngroups):
+                basef = gi * group
+                nf = min(group, f - basef)
+                for j in range(nf):
+                    fj = basef + j
+                    col = (xu[:, fj >> 1] >> (8 * (fj & 1))) & 0xFF
+                    onehot[:, j * bpad : (j + 1) * bpad] = (
+                        col[:, None] == iota_b
+                    ).astype(oh_dtype)
+                if nf < group:
+                    onehot[:, nf * bpad :] = jnp.zeros(
+                        (TILE, (group - nf) * bpad), oh_dtype
+                    )
+                part = jax.lax.dot_general(
+                    stats_mat,
+                    onehot[...],
+                    dimension_numbers=(((0,), (0,)), ((), ())),
+                    preferred_element_type=pref,
+                )
+                width = nf * bpad
+                acc[:, basef * bpad : basef * bpad + width] += part[:, :width]
+
+        if quantized:
+            # quantized-gradient training: gm/hm are integer multiples of
+            # the grid scales (gradient_discretizer.cpp:70) — accumulate
+            # the small integers EXACTLY in i32 on the int8 MXU path (2x
+            # bf16 throughput) and dequantize once at the end.  The clip
+            # guards foreign (off-grid) inputs from int8 wrap, like
+            # histogram_int8.py.
+            qg = jnp.clip(jnp.round(gm / scales_ref[0]), -127, 127).astype(jnp.int8)
+            qh = jnp.clip(jnp.round(hm / scales_ref[1]), -127, 127).astype(jnp.int8)
+            ghcq = jnp.concatenate(
+                [
+                    qg[:, None],
+                    qh[:, None],
+                    m.astype(jnp.int8)[:, None],
+                    jnp.zeros((TILE, 1), jnp.int8),
+                ],
+                axis=1,
+            )  # [TILE, 4]
+            _accumulate(ghcq, jnp.int8, jnp.int32)
+            return 0
         # THREE-term bf16 split of each f32 addend (~26 mantissa bits) —
         # the matmul M-dim pads 6 -> 8 sublanes anyway, so the two extra
         # residual rows are free MXU work (ADVICE r2: tighter precision
@@ -251,60 +299,56 @@ def _seg_hist_kernel(
             ],
             axis=1,
         )  # [TILE, 8]
-        ngroups = (f + group - 1) // group
-        for gi in range(ngroups):
-            basef = gi * group
-            nf = min(group, f - basef)
-            for j in range(nf):
-                fj = basef + j
-                col = (xu[:, fj >> 1] >> (8 * (fj & 1))) & 0xFF
-                onehot[:, j * bpad : (j + 1) * bpad] = (
-                    col[:, None] == iota_b
-                ).astype(jnp.bfloat16)
-            if nf < group:
-                onehot[:, nf * bpad :] = jnp.zeros(
-                    (TILE, (group - nf) * bpad), jnp.bfloat16
-                )
-            part8 = jax.lax.dot_general(
-                ghc8,
-                onehot[...],
-                dimension_numbers=(((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # [8, group * bpad]
-            width = nf * bpad
-            acc[:, basef * bpad : basef * bpad + width] += part8[:, :width]
+        _accumulate(ghc8, jnp.bfloat16, jnp.float32)
         return 0
 
     lax.fori_loop(0, nt, body, 0)
-    # rows: 0 g_hi, 1 h_hi, 2 count, 3 g_lo, 4 h_lo, 5 zero, 6 g_lo2, 7 h_lo2
-    out_ref[...] = acc[:3, :] + acc[3:6, :]
-    out_ref[0, :] += acc[6, :]
-    out_ref[1, :] += acc[7, :]
+    if quantized:
+        out_ref[0, :] = acc[0, :].astype(jnp.float32) * scales_ref[0]
+        out_ref[1, :] = acc[1, :].astype(jnp.float32) * scales_ref[1]
+        out_ref[2, :] = acc[2, :].astype(jnp.float32)
+    else:
+        # rows: 0 g_hi, 1 h_hi, 2 count, 3 g_lo, 4 h_lo, 5 zero,
+        # 6 g_lo2, 7 h_lo2
+        out_ref[...] = acc[:3, :] + acc[3:6, :]
+        out_ref[0, :] += acc[6, :]
+        out_ref[1, :] += acc[7, :]
 
 
-@functools.partial(jax.jit, static_argnames=("f", "num_bins", "n_pad", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("f", "num_bins", "n_pad", "quantized", "interpret")
+)
 def seg_hist_pallas(
     seg: jnp.ndarray,
     scal: jnp.ndarray,  # [2] i32: start, cnt
+    scales: Optional[jnp.ndarray] = None,  # [2] f32 grid scales (quantized)
     *,
     f: int,
     num_bins: int,
     n_pad: int,
+    quantized: bool = False,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Histogram [F, B, 3] (g, h, count) of packed rows [start, start+cnt)."""
+    """Histogram [F, B, 3] (g, h, count) of packed rows [start, start+cnt).
+
+    ``quantized=True`` (requires ``scales``): integer grid accumulation on
+    the int8 MXU path — exact and ~2x the bf16 throughput."""
     bpad = (max(num_bins, 1) + 127) // 128 * 128
     group = min(max(1, _TARGET_LANES // bpad), f)
     # DMA only the used planes (bins + stats), padded to an i16 sublane
     # multiple — 32 planes at F=28, 4x less tile traffic than the 128 cap
     sub = min(storage_lanes(f), (used_lanes(f) + 15) // 16 * 16)
     kernel = functools.partial(
-        _seg_hist_kernel, f=f, bpad=bpad, group=group, sub=sub
+        _seg_hist_kernel, f=f, bpad=bpad, group=group, sub=sub,
+        quantized=quantized,
     )
+    if scales is None:
+        scales = jnp.ones((2,), jnp.float32)
     out = pl.pallas_call(
         kernel,
         grid=(1,),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -312,12 +356,17 @@ def seg_hist_pallas(
         out_shape=jax.ShapeDtypeStruct((3, f * bpad), jnp.float32),
         scratch_shapes=[
             pltpu.VMEM((sub, TILE), jnp.int16),
-            pltpu.VMEM((8, f * bpad), jnp.float32),
-            pltpu.VMEM((TILE, group * bpad), jnp.bfloat16),
+            pltpu.VMEM(
+                (4, f * bpad) if quantized else (8, f * bpad),
+                jnp.int32 if quantized else jnp.float32,
+            ),
+            pltpu.VMEM(
+                (TILE, group * bpad), jnp.int8 if quantized else jnp.bfloat16
+            ),
             pltpu.SemaphoreType.DMA,
         ],
         interpret=interpret,
-    )(scal, seg)
+    )(scal, scales.astype(jnp.float32), seg)
     return out.reshape(3, f, bpad)[:, :, :num_bins].transpose(1, 2, 0)
 
 
@@ -333,8 +382,27 @@ def seg_hist_ref(seg: jnp.ndarray, scal: jnp.ndarray, *, f: int, num_bins: int, 
     return leaf_histogram_segment(bins, g, h, m * window.astype(jnp.float32), num_bins)
 
 
-def seg_hist(seg, scal, *, f: int, num_bins: int, n_pad: int):
-    """Platform dispatch: Pallas on TPU, masked full pass elsewhere."""
+def seg_hist(seg, scal, *, f: int, num_bins: int, n_pad: int,
+             quant_scales=None):
+    """Platform dispatch: Pallas on TPU (int8 grid accumulation when
+    ``quant_scales`` is given — quantized training), masked full pass
+    elsewhere."""
+    if quant_scales is not None:
+        scales = jnp.stack(
+            [quant_scales[0], quant_scales[1]]
+        ).astype(jnp.float32)
+        return jax.lax.platform_dependent(
+            seg,
+            scal,
+            scales,
+            tpu=functools.partial(
+                seg_hist_pallas, f=f, num_bins=num_bins, n_pad=n_pad,
+                quantized=True,
+            ),
+            default=lambda seg, scal, _s: seg_hist_ref(
+                seg, scal, f=f, num_bins=num_bins, n_pad=n_pad
+            ),
+        )
     return jax.lax.platform_dependent(
         seg,
         scal,

@@ -145,3 +145,35 @@ def test_leaf_mapping_roundtrip(packed):
     exp = np.empty(n, np.int32)
     exp[perm] = lp
     assert np.array_equal(lid, exp)
+
+
+def test_seg_hist_int8_quantized_exact(packed):
+    """Quantized-gradient int8 variant: grid multiples accumulate EXACTLY
+    in i32 (gradient_discretizer.cpp grid), so the kernel must match the
+    f32 oracle bit-for-bit at these magnitudes."""
+    from lightgbm_tpu.ops.pallas.seg import seg_hist_pallas
+
+    p = packed
+    rng = np.random.default_rng(13)
+    gs, hs = np.float32(0.037), np.float32(0.0021)
+    kq = rng.integers(-63, 64, size=p["n"]).astype(np.float32)
+    hq = rng.integers(0, 64, size=p["n"]).astype(np.float32)
+    seg = pack_rows(
+        jnp.asarray(p["bins"]),
+        jnp.asarray(kq * gs),
+        jnp.asarray(hq * hs),
+        jnp.asarray(p["m"]),
+        p["n_pad"],
+    )
+    hs_out = seg_hist_pallas(
+        seg, jnp.asarray([17, 3000], jnp.int32),
+        jnp.asarray([gs, hs], jnp.float32),
+        f=p["f"], num_bins=256, n_pad=p["n_pad"],
+        quantized=True, interpret=True,
+    )
+    bo, go, ho, mo, _ = unpack_stats(seg[:, 17 : 17 + 3000], p["f"])
+    ref = leaf_histogram_segment(bo, go, ho, mo, 256)
+    got = np.asarray(hs_out)
+    # counts exact; g/h equal to the integer sums times the scales
+    assert np.array_equal(got[:, :, 2], np.asarray(ref)[:, :, 2])
+    assert np.allclose(got, np.asarray(ref), rtol=1e-6, atol=1e-6)
