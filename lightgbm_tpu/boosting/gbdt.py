@@ -734,16 +734,11 @@ class Booster:
         return ta._replace(leaf_value=lv)
 
     def _quant_scales_arg(self):
-        """Concrete scales operand for shard_map; raise early when an int8
-        histogram method is configured without quantized gradients (matches
-        leaf_histogram's serial-path validation)."""
+        """Concrete scales operand for shard_map (the int8-without-
+        quantized-gradients config error is raised once at
+        _make_grower_params time)."""
         scales = getattr(self, "_quant_scales", None)
         if scales is None:
-            if self._grower_params.hist_method.startswith("pallas_int8"):
-                raise ValueError(
-                    "hist_method='pallas_int8' needs quantized gradients "
-                    "(use_quantized_grad=True provides the scales)"
-                )
             return (jnp.float32(1.0), jnp.float32(1.0))  # unused dummy
         return scales
 
@@ -913,6 +908,8 @@ class Booster:
         n_used = len(self.train_set.used_features) if self.train_set else 0
         import jax as _jax
 
+        # the ONE config-time validation for int8 kernels (both seg and
+        # ordered paths; _quant_scales_arg relies on this running first)
         if hist_method.startswith("pallas_int8") and not cfg.use_quantized_grad:
             raise ValueError(
                 "hist_method='pallas_int8' needs quantized gradients "
@@ -926,6 +923,8 @@ class Booster:
             # the seg path has its own kernels: the default bf16 three-term
             # one and (r3) an int8 grid variant for quantized training;
             # other explicit kernel choices keep the ordered path
+            # (pallas_int8_interpret stays on the ordered path: the seg
+            # dispatcher has no interpret plumbing)
             and hist_method in ("auto", "pallas_int8")
             # off-TPU the seg histogram falls back to a masked full-N pass
             # per split — ordered mode's O(parent segment) wins there

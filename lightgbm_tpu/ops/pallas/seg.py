@@ -200,6 +200,11 @@ def _seg_hist_kernel(
     off = start - abegin
     nt = (off + cnt + TILE - 1) // TILE
     acc[...] = jnp.zeros_like(acc)
+    # hoisted out of the tile loop: reciprocal-multiply instead of two
+    # full-width divides per tile (quotients round to integers, so the
+    # rounding difference cannot change the result)
+    inv_g = 1.0 / scales_ref[0]
+    inv_h = 1.0 / scales_ref[1]
     GLO, GHI, HLO, HHI, M, _, _ = stat_lanes(f)
     iota_rows = jax.lax.broadcasted_iota(jnp.int32, (TILE, 1), 0)[:, 0]
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (TILE, bpad), 1)
@@ -260,9 +265,14 @@ def _seg_hist_kernel(
             # the small integers EXACTLY in i32 on the int8 MXU path (2x
             # bf16 throughput) and dequantize once at the end.  The clip
             # guards foreign (off-grid) inputs from int8 wrap, like
-            # histogram_int8.py.
-            qg = jnp.clip(jnp.round(gm / scales_ref[0]), -127, 127).astype(jnp.int8)
-            qh = jnp.clip(jnp.round(hm / scales_ref[1]), -127, 127).astype(jnp.int8)
+            # histogram_int8.py.  Exactness bound: per-bin integer sums
+            # stay exact up to 2^31/|q|max rows per bin (~16.9M at the
+            # |q|=127 extreme, ~1e9 at the default 4-bin grid) and the f32
+            # dequantize is exact below 2^24 — beyond that the path is
+            # approximate like the bf16 one, not wrong (clip keeps
+            # per-addend magnitudes sane).
+            qg = jnp.clip(jnp.round(gm * inv_g), -127, 127).astype(jnp.int8)
+            qh = jnp.clip(jnp.round(hm * inv_h), -127, 127).astype(jnp.int8)
             ghcq = jnp.concatenate(
                 [
                     qg[:, None],
@@ -387,25 +397,21 @@ def seg_hist(seg, scal, *, f: int, num_bins: int, n_pad: int,
     """Platform dispatch: Pallas on TPU (int8 grid accumulation when
     ``quant_scales`` is given — quantized training), masked full pass
     elsewhere."""
-    if quant_scales is not None:
-        scales = jnp.stack(
-            [quant_scales[0], quant_scales[1]]
-        ).astype(jnp.float32)
-        return jax.lax.platform_dependent(
-            seg,
-            scal,
-            scales,
-            tpu=functools.partial(
-                seg_hist_pallas, f=f, num_bins=num_bins, n_pad=n_pad,
-                quantized=True,
-            ),
-            default=lambda seg, scal, _s: seg_hist_ref(
-                seg, scal, f=f, num_bins=num_bins, n_pad=n_pad
-            ),
-        )
+    quantized = quant_scales is not None
+    scales = (
+        jnp.stack([quant_scales[0], quant_scales[1]]).astype(jnp.float32)
+        if quantized
+        else jnp.ones((2,), jnp.float32)
+    )
     return jax.lax.platform_dependent(
         seg,
         scal,
-        tpu=functools.partial(seg_hist_pallas, f=f, num_bins=num_bins, n_pad=n_pad),
-        default=functools.partial(seg_hist_ref, f=f, num_bins=num_bins, n_pad=n_pad),
+        scales,
+        tpu=functools.partial(
+            seg_hist_pallas, f=f, num_bins=num_bins, n_pad=n_pad,
+            quantized=quantized,
+        ),
+        default=lambda seg, scal, _s: seg_hist_ref(
+            seg, scal, f=f, num_bins=num_bins, n_pad=n_pad
+        ),
     )

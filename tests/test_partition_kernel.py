@@ -63,3 +63,46 @@ def test_partition_kernel_matches_sort(packed, sb, cnt, feat, tbin, dl, nanb, is
     )
     assert int(nl_k) == int(nl_s)
     assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_partition_kernel_sequential_tree_stress():
+    """Drive the kernel through a leaf-wise tree's partition SEQUENCE
+    (windows shrink and nest, state carries forward) and require bit-equal
+    state vs the sort path after every step — errors would compound."""
+    rng = np.random.default_rng(42)
+    f, n = 14, 20000
+    n_pad = padded_rows(n)
+    bins = rng.integers(0, 256, size=(n, f)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = np.ones(n, np.float32)
+    m = np.ones(n, np.float32)
+    seg_k = pack_rows(
+        jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m), n_pad
+    )
+    seg_s = seg_k
+    catm = jnp.asarray(np.zeros(256, np.float32)).reshape(1, 256)
+    # maintain (begin, cnt) segments like the grower does
+    segments = [(0, n)]
+    for step in range(12):
+        # split the largest segment on a pseudo-random feature/threshold
+        segments.sort(key=lambda t: -t[1])
+        sb, cnt = segments.pop(0)
+        if cnt < 2:
+            break
+        feat = int(rng.integers(0, f))
+        tbin = int(rng.integers(20, 236))
+        scal = jnp.asarray([sb, cnt, feat, tbin, 0, -1, 0, 0], jnp.int32)
+        seg_k, nl_k = seg_partition_pallas(
+            seg_k, scal, catm, f=f, n_pad=n_pad, use_cat=False, interpret=True
+        )
+        seg_s, nl_s, _ = sort_partition_xla(
+            seg_s, jnp.int32(sb), jnp.int32(cnt), jnp.int32(feat),
+            jnp.int32(tbin), jnp.int32(0), jnp.int32(-1), jnp.int32(0),
+            jnp.zeros((1,), jnp.float32), f=f, n_pad=n_pad,
+        )
+        assert int(nl_k) == int(nl_s), f"step {step}: nl {nl_k} != {nl_s}"
+        assert np.array_equal(np.asarray(seg_k), np.asarray(seg_s)), (
+            f"state diverged at step {step}"
+        )
+        nl = int(nl_k)
+        segments += [(sb, nl), (sb + nl, cnt - nl)]
