@@ -464,6 +464,12 @@ class Booster:
         self.feature_names = list(train_set.feature_names)
         self.feature_infos = [m.feature_info_str() for m in train_set.bin_mappers]
         self.max_feature_idx = train_set.num_total_features - 1
+        # recorded category orders (pandas categoricals / Arrow dictionary
+        # columns) so predict on a fresh frame remaps codes identically
+        self.pandas_categorical = (
+            train_set.pandas_categorical
+            or getattr(train_set, "arrow_categories", None)
+        )
         self.average_output = cfg.boosting == "rf"
 
         k = self.num_tree_per_iteration
@@ -1792,19 +1798,57 @@ class Booster:
         first = np.where(any_stop, stop.argmax(axis=1), iters - 1)
         return cum[np.arange(n), first]
 
+    def _predict_category_maps(self, cat_names):
+        """Recorded train-time category orders as a {name: values} dict.
+
+        ``pandas_categorical`` loaded from a reference-produced model file is
+        a list-of-lists ordered like the frame's categorical columns
+        (reference: basic.py ``_data_from_pandas`` zips them in column
+        order); ours is already a dict keyed by column name."""
+        maps = self.pandas_categorical or getattr(
+            self.train_set, "arrow_categories", None
+        ) or getattr(self.train_set, "pandas_categorical", None)
+        if isinstance(maps, list):
+            maps = dict(zip(cat_names, maps))
+        if not maps and cat_names:
+            from ..utils.log import log_warning
+
+            log_warning(
+                "predict input has categorical columns but the Booster has "
+                "no recorded category order (model trained on pre-coded "
+                "data?); raw dictionary codes will be used and may not "
+                "match training"
+            )
+        return maps or {}
+
     def _coerce_predict_input(self, data):
-        from ..dataset import _arrow_to_numpy, _is_arrow
+        from ..dataset import (
+            _arrow_to_numpy,
+            _is_arrow,
+            _is_cat_dtype,
+            _pandas_to_numpy,
+        )
 
         if _is_arrow(data):
-            maps = getattr(self.train_set, "arrow_categories", None)
-            data = _arrow_to_numpy(data, maps if maps else {})[0]
+            import pyarrow as pa  # _is_arrow guaranteed pyarrow is loaded
+
+            dict_cols = [
+                str(f.name)
+                for f in data.schema
+                if pa.types.is_dictionary(f.type)
+            ]
+            data = _arrow_to_numpy(data, self._predict_category_maps(dict_cols))[0]
         try:
             import pandas as pd  # type: ignore
-
-            if isinstance(data, pd.DataFrame):
-                data = data.to_numpy(dtype=np.float64, na_value=np.nan)
         except Exception:
-            pass
+            pd = None
+        if pd is not None and isinstance(data, pd.DataFrame):
+            cat_cols = [
+                str(c) for c in data.columns if _is_cat_dtype(data[c].dtype)
+            ]
+            data = _pandas_to_numpy(
+                data, self._predict_category_maps(cat_cols)
+            )[0]
         if hasattr(data, "tocsc") and hasattr(data, "nnz"):
             # scipy sparse stays sparse: the bin path bins per-column from
             # CSC; paths that need dense values densify themselves
@@ -1937,6 +1981,14 @@ class Booster:
         for key, val in (self.params or {}).items():
             out += f"[{key}: {val}]\n"
         out += "end of parameters\n"
+        # trailing category-order record, same slot as the reference model
+        # file (python-package/lightgbm/basic.py save_model appends
+        # ``pandas_categorical:<json>`` after the parameters block)
+        import json as _json
+
+        out += "\npandas_categorical:%s\n" % _json.dumps(
+            self.pandas_categorical, default=str
+        )
         return out
 
     def save_model(
@@ -1952,6 +2004,22 @@ class Booster:
 
     def _load_model_string(self, s: str) -> None:
         """Reference: GBDT::LoadModelFromString (gbdt_model_text.cpp:468)."""
+        # trailing category-order record; ours is a {name: values} dict, the
+        # reference python package writes a list-of-lists (kept as-is and
+        # zipped with the frame's categorical columns at predict time).
+        # Reset first: a model string without the trailer (e.g. produced by
+        # the reference CLI) must not inherit a previous model's maps.
+        self.pandas_categorical = None
+        for line in s.rsplit("\n", 8)[1:]:
+            if line.startswith("pandas_categorical:"):
+                import json as _json
+
+                try:
+                    self.pandas_categorical = _json.loads(
+                        line[len("pandas_categorical:"):]
+                    )
+                except ValueError:
+                    pass
         header, _, rest = s.partition("Tree=")
         kv = {}
         for line in header.splitlines():

@@ -215,6 +215,63 @@ def _arrow_to_numpy(data, category_maps=None):
     return mat, names, cats, category_maps
 
 
+def _is_cat_dtype(dtype) -> bool:
+    """Column dtypes that carry non-numeric category values: classic
+    object/category plus pandas 2.x (arrow-backed) string dtypes."""
+    s = str(dtype)
+    return s in ("category", "object", "str") or s.startswith(
+        ("string", "large_string")
+    )
+
+
+def _pandas_to_numpy(df, category_maps=None):
+    """DataFrame -> (float64 matrix with NaN missing, categorical column
+    names, category_maps).
+
+    category/object columns become float codes through a recorded category
+    order, exactly like the reference's ``pandas_categorical`` machinery
+    (python-package/lightgbm/basic.py ``_data_from_pandas``): the training
+    call records each column's category values; later frames (valid sets,
+    predict) remap their values through the recorded order and unseen
+    categories become NaN (routed like missing)."""
+    import pandas as pd  # caller guaranteed pandas is importable
+
+    record = category_maps is None
+    if record:
+        category_maps = {}
+    cats: List[str] = []
+    cols = []
+    for name in df.columns:
+        col = df[name]
+        sname = str(name)
+        if _is_cat_dtype(col.dtype):
+            cats.append(sname)
+            cc = col.astype("category")
+            if record and sname not in category_maps:
+                # native python values (np.int64 -> int, …) so the maps
+                # survive a JSON model-file round trip without stringifying
+                category_maps[sname] = [
+                    v.item() if hasattr(v, "item") else v
+                    for v in cc.cat.categories
+                ]
+            train_vals = category_maps.get(sname)
+            if train_vals is not None and list(cc.cat.categories) != list(
+                train_vals
+            ):
+                cc = cc.cat.set_categories(train_vals)
+            codes = cc.cat.codes.to_numpy().astype(np.float64)
+            codes[codes < 0] = np.nan  # pandas NaN / unseen category -> -1
+            cols.append(codes)
+        else:
+            cols.append(col.to_numpy(dtype=np.float64, na_value=np.nan))
+    mat = (
+        np.column_stack(cols)
+        if cols
+        else np.zeros((len(df), 0), np.float64)
+    )
+    return mat, cats, category_maps
+
+
 def _arrow_column_to_numpy(arr):
     """A pyarrow Array/ChunkedArray — or single-column Table/RecordBatch —
     as a 1-D numpy array (labels/weights)."""
@@ -314,6 +371,7 @@ class Dataset:
         self.feature_names: List[str] = []
         self.num_total_features: int = 0
         self.arrow_categories: Optional[Dict[str, list]] = None
+        self.pandas_categorical: Optional[Dict[str, list]] = None
         self._device_cache: Dict[str, Any] = {}
 
     # ----------------------------------------------------------- properties
@@ -369,7 +427,9 @@ class Dataset:
         if _is_arrow(data):
             # reuse a reference dataset's dictionaries so valid sets bin
             # categories consistently with the train set
-            ref_maps = getattr(self.reference, "arrow_categories", None)
+            ref_maps = getattr(
+                self.reference, "arrow_categories", None
+            ) or getattr(self.reference, "pandas_categorical", None)
             data, names, cats, self.arrow_categories = _arrow_to_numpy(
                 data, ref_maps
             )
@@ -382,14 +442,17 @@ class Dataset:
         if pd is not None and isinstance(data, pd.DataFrame):
             if self._feature_name == "auto":
                 self._feature_name = [str(c) for c in data.columns]
+            # category/object columns -> stable float codes; valid sets reuse
+            # the train set's recorded category order (reference:
+            # pandas_categorical in basic.py _data_from_pandas)
+            ref_maps = getattr(
+                self.reference, "pandas_categorical", None
+            ) or getattr(self.reference, "arrow_categories", None)
+            data, cats, self.pandas_categorical = _pandas_to_numpy(
+                data, ref_maps
+            )
             if self._categorical_feature == "auto":
-                cats = [
-                    str(c)
-                    for c in data.columns
-                    if str(data[c].dtype) in ("category", "object")
-                ]
                 self._categorical_feature = cats
-            data = data.to_numpy(dtype=np.float64, na_value=np.nan)
         if data is None:
             raise ValueError("Dataset has no data")
         sparse_csc = None

@@ -270,6 +270,10 @@ def _candidate_for_leaf(
     # the local top-k only; pmax is the allgather-of-top-k + per-feature max
     nsh = lax.psum(jnp.float32(1.0), p.axis_name)
     w = loc[2] * nsh / jnp.maximum(c, 1.0)
+    # gains_f is the per-feature IMPROVEMENT (split.gain in GlobalVoting,
+    # voting_parallel_tree_learner.cpp:166) — best_split subtracts its own
+    # (possibly constrained) local parent gain, so no shard-local offset
+    # skews the cross-shard pmax merge
     wg = jnp.where(jnp.isfinite(gains_f) & (loc[2] > 0), gains_f * w, -jnp.inf)
     kth = lax.top_k(wg, min(p.voting_top_k, f))[0][-1]
     masked = jnp.where(wg >= kth, wg, -jnp.inf)

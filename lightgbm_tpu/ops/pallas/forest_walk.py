@@ -248,6 +248,12 @@ def _walk_kernel(
         pk1 = pk1_ref[t]  # [H, 128]
         pk2 = pk2_ref[t]
         lv = leaf_ref[t]
+        if has_cat:
+            # mostly-numeric models: only trees that actually contain a
+            # categorical node pay the 8-word bitset lookup per level (one
+            # vector reduce per tree buys a lax.cond skip of ~8H gathers +
+            # selects per level for the all-numeric trees)
+            tree_cat = jnp.any(((pk1 >> 26) & 1) != 0)
 
         def level(_, cur):
             curc = jnp.maximum(cur, 0)  # [8, 128]
@@ -260,25 +266,30 @@ def _walk_kernel(
             fval = (packed >> ((feat & 3) * 8)) & 0xFF
             gl = (fval <= thr) | ((dl != 0) & (nb >= 0) & (fval == nb))
             if has_cat:
-                # one bitset word per row: 8 word-tables gathered by node,
-                # selected by fval>>5, tested at bit fval&31 (the vectorized
-                # CategoricalDecision, tree.h:346; bins >= the mask width
-                # have zero bits and route right like unseen categories)
-                words = [
-                    _lookup(catw_ref[t, w], curc, h) for w in range(CAT_WORDS)
-                ]
-                wi = fval >> 5
-                bit = 0
-                while len(words) > 1:
-                    b = (wi >> bit) & 1
+                def cat_gl(g):
+                    # one bitset word per row: 8 word-tables gathered by
+                    # node, selected by fval>>5, tested at bit fval&31 (the
+                    # vectorized CategoricalDecision, tree.h:346; bins >= the
+                    # mask width have zero bits and route right like unseen
+                    # categories)
                     words = [
-                        jnp.where(b != 0, words[2 * i + 1], words[2 * i])
-                        for i in range(len(words) // 2)
+                        _lookup(catw_ref[t, w], curc, h)
+                        for w in range(CAT_WORDS)
                     ]
-                    bit += 1
-                catgo = ((words[0] >> (fval & 31)) & 1) != 0
-                isc = (p1 >> 26) & 1
-                gl = jnp.where(isc != 0, catgo, gl)
+                    wi = fval >> 5
+                    bit = 0
+                    while len(words) > 1:
+                        b = (wi >> bit) & 1
+                        words = [
+                            jnp.where(b != 0, words[2 * i + 1], words[2 * i])
+                            for i in range(len(words) // 2)
+                        ]
+                        bit += 1
+                    catgo = ((words[0] >> (fval & 31)) & 1) != 0
+                    isc = (p1 >> 26) & 1
+                    return jnp.where(isc != 0, catgo, g)
+
+                gl = lax.cond(tree_cat, cat_gl, lambda g: g, gl)
             p2 = _lookup(pk2, curc, h)
             child = jnp.where(gl, p2 & 0xFFFF, (p2 >> 16) & 0xFFFF) - m_nodes
             return jnp.where(cur >= 0, child, cur)

@@ -366,8 +366,9 @@ def best_split(
         cat_mask=cat_mask,
     )
     if per_feature_gains:
-        # raw best gain per feature (same parent offset for every feature,
-        # so the ranking equals improvement ranking) — the voting-parallel
+        # best IMPROVEMENT per feature (raw gain minus the same parent/
+        # min_gain offset the winning candidate uses — including the
+        # constrained-parent form under use_full_gain) — the voting-parallel
         # learner's LightSplitInfo gains (voting_parallel_tree_learner.cpp:152)
-        return cand_out, gains.max(axis=(0, 2))
+        return cand_out, gains.max(axis=(0, 2)) - parent_gain - min_gain_to_split
     return cand_out

@@ -125,9 +125,6 @@ def _tree_shap_recurse(
     path = [p.copy() for p in parent_path[:unique_depth]] + [
         _PathElement() for _ in range(2)
     ]
-    # ensure capacity: depth+1 elements used
-    while len(path) < unique_depth + 2:
-        path.append(_PathElement())
     _extend_path(path, unique_depth, parent_zero_fraction, parent_one_fraction, parent_feature_index)
 
     if node < 0:  # leaf

@@ -1,0 +1,164 @@
+"""pandas categorical (string) columns: stable code recording + predict
+remap + model-file persistence.
+
+Reference analog: python-package/lightgbm/basic.py ``_data_from_pandas`` /
+``pandas_categorical`` (category orders recorded at train, appended to the
+model file, and used to remap predict-time frames)."""
+
+import numpy as np
+import pytest
+
+pd = pytest.importorskip("pandas")
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def cat_model():
+    rng = np.random.default_rng(7)
+    n = 600
+    df = pd.DataFrame(
+        {
+            "a": rng.normal(size=n),
+            "c": pd.Categorical(rng.choice(["x", "y", "z"], n)),
+        }
+    )
+    y = df["a"].to_numpy() + (df["c"] == "y") * 2.0
+    ds = lgb.Dataset(df, label=y)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 7, "verbose": -1},
+        ds,
+        num_boost_round=20,
+    )
+    return df, y, ds, bst
+
+
+def test_train_learns_category(cat_model):
+    df, y, _, bst = cat_model
+    p = bst.predict(df)
+    assert np.sqrt(np.mean((p - y) ** 2)) < 0.5
+
+
+def test_predict_reordered_categories_identical(cat_model):
+    df, _, _, bst = cat_model
+    p = bst.predict(df)
+    df2 = df.copy()
+    df2["c"] = df2["c"].cat.reorder_categories(["z", "x", "y"])
+    assert np.array_equal(p, bst.predict(df2))
+
+
+def test_predict_object_dtype_identical(cat_model):
+    df, _, _, bst = cat_model
+    df5 = df.copy()
+    df5["c"] = df["c"].astype(str)
+    assert np.array_equal(bst.predict(df), bst.predict(df5))
+
+
+def test_unseen_category_routes_like_missing(cat_model):
+    df, _, _, bst = cat_model
+    n = len(df)
+    df3 = df.copy()
+    df3["c"] = pd.Categorical(
+        np.where(np.arange(n) % 7 == 0, "w", df["c"].astype(str))
+    )
+    p3 = bst.predict(df3)
+    assert np.isfinite(p3).all()
+    # rows with seen categories are unaffected
+    keep = np.arange(n) % 7 != 0
+    assert np.array_equal(bst.predict(df)[keep], p3[keep])
+
+
+def test_model_file_roundtrip_preserves_maps(cat_model, tmp_path):
+    df, _, _, bst = cat_model
+    f = str(tmp_path / "m.txt")
+    bst.save_model(f)
+    bst2 = lgb.Booster(model_file=f)
+    assert bst2.pandas_categorical == {"c": ["x", "y", "z"]}
+    df2 = df.copy()
+    df2["c"] = df2["c"].cat.reorder_categories(["z", "x", "y"])
+    assert np.array_equal(bst.predict(df), bst2.predict(df2))
+
+
+def test_valid_set_reuses_train_maps(cat_model):
+    df, y, ds, _ = cat_model
+    df2 = df.copy()
+    df2["c"] = df2["c"].cat.reorder_categories(["z", "x", "y"])
+    res = {}
+    lgb.train(
+        {"objective": "regression", "num_leaves": 7, "verbose": -1},
+        ds,
+        num_boost_round=5,
+        valid_sets=[
+            lgb.Dataset(df, label=y, reference=ds),
+            lgb.Dataset(df2, label=y, reference=ds),
+        ],
+        valid_names=["orig", "reordered"],
+        callbacks=[lgb.record_evaluation(res)],
+    )
+    # identical rows (modulo category order) -> identical eval series
+    assert res["orig"]["l2"] == res["reordered"]["l2"]
+
+
+def test_numeric_categories_survive_model_file(tmp_path):
+    """int-valued categoricals must round-trip as ints, not strings."""
+    rng = np.random.default_rng(5)
+    n = 400
+    df = pd.DataFrame(
+        {
+            "a": rng.normal(size=n),
+            "c": pd.Categorical(rng.choice([10, 20, 30], n)),
+        }
+    )
+    y = df["a"].to_numpy() + (df["c"] == 20) * 2.0
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 7, "verbose": -1},
+        lgb.Dataset(df, label=y),
+        num_boost_round=10,
+    )
+    p = bst.predict(df)
+    f = str(tmp_path / "m.txt")
+    bst.save_model(f)
+    bst2 = lgb.Booster(model_file=f)
+    assert bst2.pandas_categorical == {"c": [10, 20, 30]}
+    assert np.array_equal(p, bst2.predict(df))
+
+
+def test_model_without_trailer_resets_maps(cat_model):
+    df, _, _, bst = cat_model
+    s = bst.model_to_string()
+    bare = s[: s.index("pandas_categorical:")].rstrip() + "\n"
+    bst2 = lgb.Booster(model_str=s)
+    assert bst2.pandas_categorical
+    bst2.model_from_string(bare)
+    assert bst2.pandas_categorical is None
+
+
+def test_reference_style_list_maps_predict():
+    """A model file with the reference python package's list-of-lists
+    pandas_categorical still remaps (zipped with the frame's categorical
+    columns in order)."""
+    rng = np.random.default_rng(3)
+    n = 400
+    df = pd.DataFrame(
+        {
+            "a": rng.normal(size=n),
+            "c": pd.Categorical(rng.choice(["x", "y", "z"], n)),
+        }
+    )
+    y = df["a"].to_numpy() + (df["c"] == "y") * 2.0
+    ds = lgb.Dataset(df, label=y)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 7, "verbose": -1},
+        ds,
+        num_boost_round=10,
+    )
+    s = bst.model_to_string()
+    s = s.replace(
+        'pandas_categorical:{"c": ["x", "y", "z"]}',
+        'pandas_categorical:[["x", "y", "z"]]',
+    )
+    bst2 = lgb.Booster(model_str=s)
+    assert bst2.pandas_categorical == [["x", "y", "z"]]
+    df2 = df.copy()
+    df2["c"] = df2["c"].cat.reorder_categories(["y", "z", "x"])
+    assert np.array_equal(bst.predict(df), bst2.predict(df2))

@@ -1,0 +1,13 @@
+#!/bin/bash
+# Probe the axon TPU tunnel every 3 minutes; touch /tmp/tpu_up when alive.
+# Runs until killed. Logs to /tmp/tpu_probe.log.
+while true; do
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'" 2>/dev/null; then
+    date -u +"%FT%TZ up" >> /tmp/tpu_probe.log
+    touch /tmp/tpu_up
+  else
+    date -u +"%FT%TZ down" >> /tmp/tpu_probe.log
+    rm -f /tmp/tpu_up
+  fi
+  sleep 180
+done
