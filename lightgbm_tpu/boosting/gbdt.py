@@ -637,15 +637,6 @@ class Booster:
         used = ds.used_features
         self._monotone = None
         if cfg.monotone_constraints and any(v != 0 for v in cfg.monotone_constraints):
-            if cfg.monotone_constraints_method == "advanced":
-                from ..utils.log import log_warning
-
-                log_warning(
-                    "monotone_constraints_method='advanced' (per-threshold "
-                    "feature constraints) is not implemented; using "
-                    "'intermediate' (outputs are still guaranteed monotone, "
-                    "bounds are just slightly more conservative)"
-                )
             mc = np.zeros(len(used), dtype=np.int8)
             for ci, j in enumerate(used):
                 if j < len(cfg.monotone_constraints):

@@ -247,6 +247,15 @@ def _pandas_to_numpy(df, category_maps=None):
         if _is_cat_dtype(col.dtype):
             cats.append(sname)
             cc = col.astype("category")
+            if not record and category_maps and sname not in category_maps:
+                # this column was NOT categorical at train time — its codes
+                # would be this frame's own arbitrary order (reference:
+                # "train and valid dataset categorical_feature do not match")
+                raise ValueError(
+                    f"column {sname!r} is categorical but the train-time "
+                    "category record has no entry for it (categorical "
+                    "features must match between train and later frames)"
+                )
             if record and sname not in category_maps:
                 # native python values (np.int64 -> int, …) so the maps
                 # survive a JSON model-file round trip without stringifying

@@ -219,10 +219,94 @@ def voting_active(p: "GrowerParams", f: int) -> bool:
     )
 
 
+def _adv_constrainers(box, boxes, mono, valid):
+    """Which leaves bound a leaf with box ``box`` (advanced monotone mode).
+
+    The reference finds constraining leaves by recursing up the tree and
+    down opposite branches of monotone ancestor splits
+    (AdvancedLeafConstraints::GoUpToFindConstrainingLeaves,
+    monotone_constraints.hpp:1082).  The TPU formulation is a box test over
+    all leaves at once: leaf b constrains this leaf iff the two boxes are
+    ordered-DISJOINT along exactly ONE monotone feature and overlap along
+    every other feature (points of the two leaves can then differ only in
+    that monotone coordinate).
+
+    box: [F, 2] bin-space box; boxes: [L, F, 2]; mono: [F] int8; valid: [L].
+    Returns (lb_con [L], ub_con [L], ov [L, F])."""
+    lo, hi = box[:, 0], box[:, 1]
+    blo, bhi = boxes[:, :, 0], boxes[:, :, 1]
+    ov = (blo <= hi[None, :]) & (lo[None, :] <= bhi)  # [L, F]
+    nonov = ~ov
+    one_nonov = nonov.sum(axis=1) == 1
+    below = bhi < lo[None, :]  # leaf b strictly below this leaf along f
+    above = blo > hi[None, :]
+    mpos = (mono > 0)[None, :]
+    mneg = (mono < 0)[None, :]
+    lb_con = (
+        one_nonov & valid
+        & (nonov & ((below & mpos) | (above & mneg))).any(axis=1)
+    )
+    ub_con = (
+        one_nonov & valid
+        & (nonov & ((above & mpos) | (below & mneg))).any(axis=1)
+    )
+    return lb_con, ub_con, ov
+
+
+def adv_scalar_bounds(box, boxes, outs, mono, valid):
+    """Whole-box output bounds for one leaf from every constraining leaf's
+    current output (the advanced analog of a recomputed BasicConstraint —
+    RightToBasicConstraint/LeftToBasicConstraint after the cumulative
+    update, monotone_constraints.hpp:286)."""
+    lb_con, ub_con, _ = _adv_constrainers(box, boxes, mono, valid)
+    lb = jnp.max(jnp.where(lb_con, outs, -jnp.inf))
+    ub = jnp.min(jnp.where(ub_con, outs, jnp.inf))
+    return lb, ub
+
+
+def adv_planes(box, boxes, outs, mono, valid, b: int):
+    """Per-THRESHOLD child bounds [F, B] for scanning one leaf (advanced
+    monotone mode).
+
+    Each constraining leaf bounds only the SLICE of the scan feature's bin
+    axis where its box overlaps this leaf (reference: per-threshold
+    FeatureMinOrMaxConstraints entries, monotone_constraints.hpp:99 +
+    UpdateConstraints :871); when the scan feature IS the separating
+    monotone feature, both children stay fully ordered against the
+    constraining leaf, so the slice is the whole range.  Cumulative extrema
+    over the bin axis then give, for every candidate threshold t, the bound
+    on the left child (bins <= t) and right child (bins > t) — the
+    reference's CumulativeFeatureConstraint (:146) as two cummax/cummin
+    sweeps."""
+    lb_con, ub_con, ov = _adv_constrainers(box, boxes, mono, valid)
+    lo, hi = box[:, 0], box[:, 1]
+    blo, bhi = boxes[:, :, 0], boxes[:, :, 1]
+    s = jnp.where(ov, jnp.maximum(blo, lo[None, :]), lo[None, :])  # [L, F]
+    e = jnp.where(ov, jnp.minimum(bhi, hi[None, :]), hi[None, :])
+    bin_ids = jnp.arange(b, dtype=jnp.int32)[None, None, :]
+    in_sl = (bin_ids >= s[:, :, None]) & (bin_ids <= e[:, :, None])  # [L,F,B]
+    minp = jnp.max(
+        jnp.where(in_sl & lb_con[:, None, None], outs[:, None, None], -jnp.inf),
+        axis=0,
+    )  # [F, B]
+    maxp = jnp.min(
+        jnp.where(in_sl & ub_con[:, None, None], outs[:, None, None], jnp.inf),
+        axis=0,
+    )
+    lb_left = lax.cummax(minp, axis=1)
+    ub_left = lax.cummin(maxp, axis=1)
+    suf_min = lax.cummax(minp[:, ::-1], axis=1)[:, ::-1]  # extremum over [t:]
+    suf_max = lax.cummin(maxp[:, ::-1], axis=1)[:, ::-1]
+    ninf = jnp.full((minp.shape[0], 1), -jnp.inf)
+    lb_right = jnp.concatenate([suf_min[:, 1:], ninf], axis=1)
+    ub_right = jnp.concatenate([suf_max[:, 1:], -ninf], axis=1)
+    return lb_left, ub_left, lb_right, ub_right
+
+
 def _candidate_for_leaf(
     hist, g, h, c, num_bins, nan_bins, feature_mask, p: GrowerParams,
     monotone=None, lb=None, ub=None, parent_output=0.0, is_cat=None,
-    cegb_penalty=None, rand_bins=None,
+    cegb_penalty=None, rand_bins=None, adv=None,
 ):
     """Best split for one leaf.  ``hist`` is the GLOBAL (psummed) histogram
     normally; under voting-parallel it is the LOCAL histogram and only the
@@ -251,6 +335,7 @@ def _candidate_for_leaf(
             is_cat=is_cat if p.use_cat else None,
             cegb_penalty=cegb_penalty if p.use_cegb else None,
             rand_bins=rand_bins if p.extra_trees else None,
+            adv_bounds=adv,
             **common,
         )
     # ---- PV-Tree election.  1) local per-feature best gains from the LOCAL
@@ -263,6 +348,7 @@ def _candidate_for_leaf(
         is_cat=is_cat if p.use_cat else None,
         cegb_penalty=cegb_penalty if p.use_cegb else None,
         rand_bins=rand_bins if p.extra_trees else None,
+        adv_bounds=adv,
         per_feature_gains=True,
         **common,
     )
@@ -292,6 +378,9 @@ def _candidate_for_leaf(
         ),
         rand_bins=(
             rand_bins[ids] if (p.extra_trees and rand_bins is not None) else None
+        ),
+        adv_bounds=(
+            tuple(a[ids] for a in adv) if adv is not None else None
         ),
         **common,
     )
@@ -420,7 +509,24 @@ def grow_tree(
     L, B = p.num_leaves, p.max_bin
     use_mono = p.use_monotone and monotone is not None
     use_inter_mono = use_mono and p.monotone_method in ("intermediate", "advanced")
+    # advanced = intermediate propagation machinery + recomputed-from-boxes
+    # bounds: per-threshold planes in the split scan, whole-box scalars at
+    # commit (reference AdvancedLeafConstraints, monotone_constraints.hpp:858)
+    use_adv_mono = use_mono and p.monotone_method == "advanced"
     mono_arr = monotone if use_mono else None
+
+    def _leaf_outs_now(g_, h_, cnt_, parent_, ivals_, lb_, ub_):
+        """Current would-be output of every leaf, matching the finalize
+        sequence exactly (smoothing BEFORE the monotone clip) so advanced
+        bound recomputation sees the same values the tree will emit."""
+        out = leaf_output(g_, h_, p.lambda_l1, p.lambda_l2, p.max_delta_step)
+        if p.path_smooth > 0.0:
+            pouts = jnp.where(
+                parent_ >= 0, ivals_[jnp.maximum(parent_, 0)], 0.0
+            )
+            ratio = cnt_ / p.path_smooth
+            out = out * ratio / (ratio + 1.0) + pouts / (ratio + 1.0)
+        return jnp.clip(out, lb_, ub_)
     use_cat = p.use_cat and is_cat is not None
     Bm = B if use_cat else 1  # cat-mask width (1 = static no-op)
     is_cat_arr = is_cat if use_cat else None
@@ -541,7 +647,7 @@ def grow_tree(
     bins_loc = _fslice(bins, axis=1) if f > 0 else bins
 
     def cand_for_leaf(hist, g, h, c, fm, lb=None, ub=None, pout=0.0,
-                      rand=None, cpen=None):
+                      rand=None, cpen=None, adv=None):
         """Leaf candidate with the distributed-mode plumbing: per-feature
         operand slicing + winner all-reduce under feature-parallel; voting
         election happens inside _candidate_for_leaf."""
@@ -550,6 +656,7 @@ def grow_tree(
                 hist, g, h, c, num_bins, nan_bins, fm, p,
                 monotone=mono_arr, lb=lb, ub=ub, parent_output=pout,
                 is_cat=is_cat_arr, cegb_penalty=cpen, rand_bins=rand,
+                adv=adv,
             )
         cand = _candidate_for_leaf(
             hist, g, h, c, _fslice(num_bins), _fslice(nan_bins),
@@ -559,6 +666,7 @@ def grow_tree(
             is_cat=_fslice(is_cat_arr) if is_cat_arr is not None else None,
             cegb_penalty=_fslice(cpen) if cpen is not None else None,
             rand_bins=_fslice(rand) if rand is not None else None,
+            adv=tuple(_fslice(a) for a in adv) if adv is not None else None,
         )
         return _featpar_reduce(cand)
 
@@ -1139,31 +1247,9 @@ def grow_tree(
         inter_idxs = None
         inter_valid = None
         if use_mono:
-            out_l_c = jnp.clip(
-                leaf_output(lg, lh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
-                lb_par, ub_par,
-            )
-            out_r_c = jnp.clip(
-                leaf_output(rg, rh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
-                lb_par, ub_par,
-            )
             mc_f = mono_arr[feat]
+            num_split = ~cis  # categorical splits carry no interval order
             if use_inter_mono:
-                num_split = ~cis  # categorical splits carry no interval order
-                # sibling bounds from actual outputs
-                # (UpdateConstraintsWithOutputs, :548)
-                ub_l = jnp.where(
-                    num_split & (mc_f > 0), jnp.minimum(ub_par, out_r_c), ub_par
-                )
-                lb_l = jnp.where(
-                    num_split & (mc_f < 0), jnp.maximum(lb_par, out_r_c), lb_par
-                )
-                ub_r = jnp.where(
-                    num_split & (mc_f < 0), jnp.minimum(ub_par, out_l_c), ub_par
-                )
-                lb_r = jnp.where(
-                    num_split & (mc_f > 0), jnp.maximum(lb_par, out_l_c), lb_par
-                )
                 # children feature boxes (categorical: inherit unchanged)
                 pbox = st.leaf_box[l]  # [F, 2]
                 box_l = pbox.at[feat, 1].set(
@@ -1171,6 +1257,51 @@ def grow_tree(
                 )
                 box_r = pbox.at[feat, 0].set(
                     jnp.where(num_split, tbin + 1, pbox[feat, 0])
+                )
+            if use_adv_mono:
+                # advanced: children bounds RECOMPUTED from every existing
+                # leaf's current output over the child's own box (reference
+                # resets + GoUpToFindConstrainingLeaves rather than
+                # inheriting the parent entry, monotone_constraints.hpp:396)
+                # — the parent's old box overlaps both children everywhere,
+                # so it never constrains its own children
+                leaf_ids_p = jnp.arange(L, dtype=jnp.int32)
+                valid_prev = (leaf_ids_p < st.num_leaves) & (leaf_ids_p != l)
+                outs_prev = _leaf_outs_now(
+                    st.leaf_g, st.leaf_h, st.leaf_cnt, st.leaf_parent,
+                    st.internal_value, st.leaf_lb, st.leaf_ub,
+                )
+                lb_l0, ub_l0 = adv_scalar_bounds(
+                    box_l, st.leaf_box, outs_prev, mono_arr, valid_prev
+                )
+                lb_r0, ub_r0 = adv_scalar_bounds(
+                    box_r, st.leaf_box, outs_prev, mono_arr, valid_prev
+                )
+            else:
+                lb_l0 = lb_r0 = lb_par
+                ub_l0 = ub_r0 = ub_par
+            out_l_c = jnp.clip(
+                leaf_output(lg, lh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
+                lb_l0, ub_l0,
+            )
+            out_r_c = jnp.clip(
+                leaf_output(rg, rh, p.lambda_l1, p.lambda_l2, p.max_delta_step),
+                lb_r0, ub_r0,
+            )
+            if use_inter_mono:
+                # sibling bounds from actual outputs
+                # (UpdateConstraintsWithOutputs, :548)
+                ub_l = jnp.where(
+                    num_split & (mc_f > 0), jnp.minimum(ub_l0, out_r_c), ub_l0
+                )
+                lb_l = jnp.where(
+                    num_split & (mc_f < 0), jnp.maximum(lb_l0, out_r_c), lb_l0
+                )
+                ub_r = jnp.where(
+                    num_split & (mc_f < 0), jnp.minimum(ub_r0, out_l_c), ub_r0
+                )
+                lb_r = jnp.where(
+                    num_split & (mc_f > 0), jnp.maximum(lb_r0, out_l_c), lb_r0
                 )
                 leaf_box = st.leaf_box.at[l].set(
                     jnp.where(can_split, box_l, pbox)
@@ -1193,17 +1324,30 @@ def grow_tree(
                 mneg = (mono_arr < 0)[None, :]
 
                 def _prop(cbox, out_c, lb, ub, changed):
-                    clo, chi = cbox[:, 0], cbox[:, 1]
-                    ov = (blo <= chi[None, :]) & (clo[None, :] <= bhi)  # [L,F]
-                    others = (ov.sum(axis=1) == f - 1)[:, None] & ~ov
-                    b_right = blo == chi[None, :] + 1  # b just right of c
-                    b_left = bhi == clo[None, :] - 1
-                    need_lb = (
-                        others & ((b_right & mpos) | (b_left & mneg))
-                    ).any(axis=1) & valid_b
-                    need_ub = (
-                        others & ((b_left & mpos) | (b_right & mneg))
-                    ).any(axis=1) & valid_b
+                    if use_adv_mono:
+                        # advanced: ANY ordered-disjoint leaf across the
+                        # monotone dim is constrained, not just the touching
+                        # ones (the reference's recompute reaches every leaf
+                        # of the opposite branches).  The set of leaves that
+                        # RECEIVE a lower bound from c is, by symmetry,
+                        # exactly the set that would impose an UPPER bound
+                        # on c — reuse the one constrainer geometry
+                        lbc, ubc = _adv_constrainers(
+                            cbox, leaf_box, mono_arr, valid_b
+                        )[:2]
+                        need_lb, need_ub = ubc, lbc
+                    else:
+                        clo, chi = cbox[:, 0], cbox[:, 1]
+                        ov = (blo <= chi[None, :]) & (clo[None, :] <= bhi)
+                        others = (ov.sum(axis=1) == f - 1)[:, None] & ~ov
+                        b_right = blo == chi[None, :] + 1  # b just right of c
+                        b_left = bhi == clo[None, :] - 1
+                        need_lb = (
+                            others & ((b_right & mpos) | (b_left & mneg))
+                        ).any(axis=1) & valid_b
+                        need_ub = (
+                            others & ((b_left & mpos) | (b_right & mneg))
+                        ).any(axis=1) & valid_b
                     lb2 = jnp.where(need_lb, jnp.maximum(lb, out_c), lb)
                     ub2 = jnp.where(need_ub, jnp.minimum(ub, out_c), ub)
                     return lb2, ub2, changed | (lb2 > lb) | (ub2 < ub)
@@ -1290,22 +1434,42 @@ def grow_tree(
         opt2 = []
         if use_mono:
             opt2 += [lb2, ub2]
+        if use_adv_mono:
+            # per-threshold bound planes for every leaf in the refresh batch,
+            # from CURRENT leaf boxes/outputs (the advanced scan constraints)
+            leaf_ids_b = jnp.arange(L, dtype=jnp.int32)
+            nvalid = leaf_ids_b < (st.num_leaves + can_split.astype(jnp.int32))
+            outs_new = _leaf_outs_now(
+                leaf_g, leaf_h, leaf_cnt, leaf_parent,
+                internal_value, leaf_lb, leaf_ub,
+            )
+            batch_idx = jnp.concatenate([jnp.stack([l, nl]), inter_idxs])
+            adv2 = jax.vmap(
+                lambda i: adv_planes(
+                    leaf_box[i], leaf_box, outs_new, mono_arr,
+                    nvalid & (leaf_ids_b != i), B,
+                )
+            )(batch_idx)
+            opt2 += list(adv2)
         use_rand = p.extra_trees and rng is not None
         if use_rand:
             opt2 += [jax.vmap(node_rand_bins)(seeds2)]
         cpen = _cegb_pen(cegb_used_new)
 
         def _child_cand(hist, g_, h_, c_, fm, po, *rest):
-            lbv = ubv = rbv = None
+            lbv = ubv = rbv = advv = None
             i = 0
             if use_mono:
                 lbv, ubv = rest[0], rest[1]
                 i = 2
+            if use_adv_mono:
+                advv = tuple(rest[i:i + 4])
+                i += 4
             if use_rand:
                 rbv = rest[i]
             return cand_for_leaf(
                 hist, g_, h_, c_, fm,
-                lb=lbv, ub=ubv, pout=po, cpen=cpen, rand=rbv,
+                lb=lbv, ub=ubv, pout=po, cpen=cpen, rand=rbv, adv=advv,
             )
 
         cand2 = jax.vmap(_child_cand)(hist2, g2, h2, c2, fm2, po2, *opt2)

@@ -131,6 +131,11 @@ def best_split(
     cegb_split_penalty: float = 0.0,  # tradeoff * cegb_penalty_split
     rand_bins: Optional[jnp.ndarray] = None,  # [F] extra_trees random bin
     per_feature_gains: bool = False,  # also return max gain per feature [F]
+    adv_bounds=None,  # advanced monotone: (lb_l, ub_l, lb_r, ub_r) [F, B]
+    #                   per-THRESHOLD child bounds (reference
+    #                   AdvancedLeafConstraints / CumulativeFeatureConstraint,
+    #                   monotone_constraints.hpp:858/:146) — applied to the
+    #                   numeric candidates instead of the scalar leaf bounds
 ) -> SplitCandidate:
     """cegb_*: Cost-Effective Gradient Boosting (reference:
     cost_effective_gradient_boosting.hpp DeltaGain — gain is reduced by
@@ -165,9 +170,11 @@ def best_split(
         valid_bin = valid_bin & (bin_ids == rand_bins[:, None])
     num_feature_mask = feature_mask & ~is_cat if use_cat else feature_mask
 
-    def eval_gain(lg, lh, lc, l2v, ok):
+    def eval_gain(lg, lh, lc, l2v, ok, bnds=None):
         """Masked split gain for [F, B] left-stat candidates (reference:
-        GetSplitGains, feature_histogram.hpp:759-828)."""
+        GetSplitGains, feature_histogram.hpp:759-828).  ``bnds`` overrides
+        the scalar leaf bounds with per-candidate (lb_l, ub_l, lb_r, ub_r)
+        arrays (advanced monotone mode, numeric candidates only)."""
         rg, rh, rc = parent[0] - lg, parent[1] - lh, parent[2] - lc
         ok = (
             ok
@@ -181,14 +188,18 @@ def best_split(
                 rg, rh, lambda_l1, l2v
             )
         else:
+            lb_l, ub_l, lb_r, ub_r = (
+                bnds if bnds is not None
+                else (leaf_lb, leaf_ub, leaf_lb, leaf_ub)
+            )
             # full path: constrained outputs + GetLeafGainGivenOutput
             out_l = constrained_output(
                 lg, lh, lambda_l1, l2v, max_delta_step,
-                path_smooth, lc, parent_output, leaf_lb, leaf_ub,
+                path_smooth, lc, parent_output, lb_l, ub_l,
             )
             out_r = constrained_output(
                 rg, rh, lambda_l1, l2v, max_delta_step,
-                path_smooth, rc, parent_output, leaf_lb, leaf_ub,
+                path_smooth, rc, parent_output, lb_r, ub_r,
             )
             gain = gain_given_output(lg, lh, lambda_l1, l2v, out_l) + \
                 gain_given_output(rg, rh, lambda_l1, l2v, out_r)
@@ -205,6 +216,7 @@ def best_split(
             left[..., 2],
             lambda_l2,
             valid_bin & num_feature_mask[:, None],
+            bnds=adv_bounds,
         )
 
     gain_right = eval_case(cum)  # missing -> right (default_left = False)
