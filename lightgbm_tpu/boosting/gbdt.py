@@ -913,10 +913,21 @@ class Booster:
                 "(use_quantized_grad=True provides the scales)"
             )
 
+        # feature budget: bins byte-pack two per i16 plane up to max_bin 256
+        # (242 features), one u16 plane per feature beyond (121 features —
+        # the reference's DenseBin<uint16_t> analog, dense_bin.hpp:18); wide
+        # configs must also fit the histogram kernel's VMEM scratch
+        from ..ops.pallas.seg import seg_vmem_ok
+
+        seg_fcap = 242 if self._max_bin_padded <= 256 else 121
+        seg_fits = seg_vmem_ok(
+            max(n_used, 1), self._max_bin_padded, getattr(self, "_has_cat", False)
+        )
         seg_ok = (
             not self._featpar  # feature-parallel partitions via leaf-id
-            and self._max_bin_padded <= 256
-            and 0 < n_used <= 242
+            and self._max_bin_padded <= 65536
+            and seg_fits
+            and 0 < n_used <= seg_fcap
             # the seg path has its own kernels: the default bf16 three-term
             # one and (r3) an int8 grid variant for quantized training;
             # other explicit kernel choices keep the ordered path
@@ -938,17 +949,24 @@ class Booster:
             # 1.4-10x slower than seg mode at scale (BENCH_NOTES.md)
             from ..utils.log import log_warning
 
-            why = (
-                f"max_bin padded to {self._max_bin_padded} > 256 (bins must "
-                "byte-pack)"
-                if self._max_bin_padded > 256
-                else f"{n_used} used features > 242 (packed row exceeds 128 "
-                "i16 lanes)"
-            )
+            if self._max_bin_padded > 65536:
+                why = f"max_bin padded to {self._max_bin_padded} > 65536"
+            elif not seg_fits:
+                why = (
+                    f"histogram VMEM scratch at {n_used} features x "
+                    f"max_bin {self._max_bin_padded} exceeds the budget"
+                )
+            else:
+                why = (
+                    f"{n_used} used features > {seg_fcap} (packed row "
+                    "exceeds 128 i16 lanes)"
+                )
             log_warning(
                 "segment-resident training is unavailable: " + why +
                 "; falling back to hist_mode='ordered' (1.4-10x slower at "
-                "scale). Consider max_bin<=255 or feature selection."
+                "scale). Consider feature selection"
+                + (" or a smaller max_bin" if seg_fcap == 121 or not seg_fits
+                   else "") + "."
             )
         hist_mode = str(
             self.params.get(

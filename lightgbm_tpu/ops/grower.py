@@ -671,18 +671,36 @@ def grow_tree(
         return _featpar_reduce(cand)
 
     if use_seg:
-        from .pallas.seg import pack_rows, padded_rows, seg_hist, stat_lanes
+        from .pallas.seg import (
+            MAX_WIDE_BIN,
+            pack_rows,
+            padded_rows,
+            seg_hist,
+            stat_lanes,
+        )
         from .segpart import leaf_id_from_seg, leaf_of_positions, sort_partition
 
-        if B > 256:
+        # bins byte-pack two features per i16 plane up to max_bin 256; wider
+        # bin spaces use one u16 plane per feature (the reference's
+        # DenseBin<uint16_t> upgrade, src/io/dense_bin.hpp:18)
+        seg_wide = B > 256
+        if B > MAX_WIDE_BIN:
             raise ValueError(
-                "hist_mode='seg' packs bins into bytes: max_bin (padded to "
-                f"{B}) must be <= 256 — use hist_mode='ordered' for wider "
-                "bin spaces"
+                f"hist_mode='seg' stores bins in u16 planes: max_bin "
+                f"(padded to {B}) must be <= {MAX_WIDE_BIN}"
             )
+        if jax.default_backend() == "tpu":
+            from .pallas.seg import seg_vmem_ok
+
+            if not seg_vmem_ok(f, B, use_cat):
+                raise ValueError(
+                    f"hist_mode='seg' at {f} features x max_bin {B} exceeds "
+                    "the histogram kernel's VMEM scratch budget — use "
+                    "hist_mode='ordered' or a smaller max_bin"
+                )
 
         n_pad_seg = padded_rows(n)
-        seg0 = pack_rows(bins, grad, hess, count_mask, n_pad_seg)
+        seg0 = pack_rows(bins, grad, hess, count_mask, n_pad_seg, wide=seg_wide)
 
         # explicit int8 opt-in (hist_method='pallas_int8' + quantized
         # gradients): integer grid accumulation, exact and ~2x throughput
@@ -700,6 +718,7 @@ def grow_tree(
                 num_bins=B,
                 n_pad=n_pad_seg,
                 quant_scales=seg_qs,
+                wide=seg_wide,
             )
             if hist_axis is not None:
                 hist = lax.psum(hist, hist_axis)
@@ -1061,6 +1080,7 @@ def grow_tree(
                 cmask.astype(jnp.float32),
                 f=f,
                 n_pad=n_pad_seg,
+                wide=seg_wide,
             )
             if p.axis_name is not None:
                 # global smaller-child choice (see gather-mode comment)
@@ -1581,7 +1601,7 @@ def grow_tree(
         lp = leaf_of_positions(
             state.leaf_begin, state.leaf_nrows, state.num_leaves, n
         )
-        GLO = stat_lanes(f)[0]
+        GLO = stat_lanes(f, seg_wide)[0]
         ridx = (state.order[GLO + 5, :n].astype(jnp.int32) & 0xFFFF) | (
             (state.order[GLO + 6, :n].astype(jnp.int32) & 0xFFFF) << 16
         )

@@ -83,6 +83,8 @@ def _seg_partition_kernel(
     n_pad: int,
     use_cat: bool,
     sub: int,
+    wide: bool,
+    bmt: int,
 ):
     sbegin = scal_ref[0]
     cnt = scal_ref[1]
@@ -178,17 +180,21 @@ def _seg_partition_kernel(
     def body1(t, carry):
         fill_l, bl, fill_r, br, nl = carry
         xu = _read_tile(seg_any, abegin + t * T)
-        lane = feat >> 1
-        sh = (feat & 1) * 8
-        colrow = jax.lax.dynamic_slice(xu, (lane, 0), (1, T))  # [1, T]
-        colv = (colrow >> sh) & 0xFF
+        if wide:
+            # one u16 plane per feature (max_bin > 256)
+            colv = jax.lax.dynamic_slice(xu, (feat, 0), (1, T))  # [1, T]
+        else:
+            lane = feat >> 1
+            sh = (feat & 1) * 8
+            colrow = jax.lax.dynamic_slice(xu, (lane, 0), (1, T))  # [1, T]
+            colv = (colrow >> sh) & 0xFF
         rpos = iota_j + t * T
         in_seg = (rpos >= off) & (rpos < off + cnt)
         go = (colv <= tbin) | ((dl != 0) & (nanb >= 0) & (colv == nanb))
         if use_cat:
             oh = (
-                colv == jax.lax.broadcasted_iota(jnp.int32, (256, T), 0)
-            ).astype(jnp.bfloat16)  # [256, T]
+                colv == jax.lax.broadcasted_iota(jnp.int32, (bmt, T), 0)
+            ).astype(jnp.bfloat16)  # [bmt, T]
             catv = jax.lax.dot_general(
                 cat_ref[...].astype(jnp.bfloat16), oh,
                 dimension_numbers=(((1,), (0,)), ((), ())),
@@ -245,15 +251,18 @@ def _seg_partition_kernel(
     lax.fori_loop(0, nt2, body2, (fill_l, bl))
 
 
-@functools.partial(jax.jit, static_argnames=("f", "n_pad", "use_cat", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("f", "n_pad", "use_cat", "wide", "interpret")
+)
 def seg_partition_pallas(
     seg: jnp.ndarray,  # [LANES, n_pad] i16 plane-major packed rows
     scal: jnp.ndarray,  # [8] i32: sbegin, cnt, feat, tbin, dl, nanb, iscat, 0
-    catmask: jnp.ndarray,  # [1, 256] f32
+    catmask: jnp.ndarray,  # [1, bmt] f32 (bmt >= 256, 128-multiple)
     *,
     f: int,
     n_pad: int,
     use_cat: bool,
+    wide: bool = False,
     interpret: bool = False,
 ):
     """Partition seg[sbegin : sbegin+cnt) by the split rule, in place.
@@ -262,11 +271,12 @@ def seg_partition_pallas(
     [sbegin+nl, sbegin+cnt), both in stable (original) order; every column
     outside the window keeps its value.
     """
-    sub = 2 * ((used_lanes(f) + 1) // 2)
+    sub = 2 * ((used_lanes(f, wide) + 1) // 2)
     lanes = seg.shape[0]
     tri = jnp.tril(jnp.ones((T, T), jnp.bfloat16)).T  # tri[i, j] = i <= j
     kernel = functools.partial(
-        _seg_partition_kernel, f=f, n_pad=n_pad, use_cat=use_cat, sub=sub
+        _seg_partition_kernel, f=f, n_pad=n_pad, use_cat=use_cat, sub=sub,
+        wide=wide, bmt=catmask.shape[1],
     )
     seg_new, _, nl = pl.pallas_call(
         kernel,

@@ -60,32 +60,50 @@ except ImportError:  # pragma: no cover
 LANES = 128  # hard cap on packed planes (128 i16 sublane budget)
 TILE = 512  # rows per DMA tile in seg_hist
 N_STAT_LANES = 7
-MAX_SEG_BIN = 256  # byte-packed bins: values must fit u8
+MAX_SEG_BIN = 256  # byte-packed bins: values must fit u8 (narrow layout)
+MAX_WIDE_BIN = 65536  # u16 planes (wide layout, max_bin > 256)
 
 
-def bin_lanes(f: int) -> int:
-    """i16 lanes holding byte-packed bins."""
-    return (f + 1) // 2
+def bin_lanes(f: int, wide: bool = False) -> int:
+    """i16 lanes holding bins: byte-packed two per plane normally, one u16
+    plane per feature when max_bin > 256 (``wide`` — the reference's
+    DenseBin<uint16_t> analog, src/io/dense_bin.hpp:18)."""
+    return f if wide else (f + 1) // 2
 
 
-def stat_lanes(f: int) -> Tuple[int, int, int, int, int, int, int]:
+def stat_lanes(f: int, wide: bool = False) -> Tuple[int, int, int, int, int, int, int]:
     """Lane indices of (g_lo, g_hi, h_lo, h_hi, mask, ridx_lo, ridx_hi)."""
-    s = bin_lanes(f)
+    s = bin_lanes(f, wide)
     return s, s + 1, s + 2, s + 3, s + 4, s + 5, s + 6
 
 
-def used_lanes(f: int) -> int:
-    return bin_lanes(f) + N_STAT_LANES
+def used_lanes(f: int, wide: bool = False) -> int:
+    return bin_lanes(f, wide) + N_STAT_LANES
 
 
-def storage_lanes(f: int) -> int:
+def storage_lanes(f: int, wide: bool = False) -> int:
     """Allocated planes: used planes rounded to an i16 sublane-tile multiple
     (32).  Storing only these — not the full 128 cap — cuts the segment
     matrix HBM footprint 4x at F=28 (2.7 GB -> 0.7 GB at 10.5M rows)."""
-    return min(LANES, -(-used_lanes(f) // 32) * 32)
+    return min(LANES, -(-used_lanes(f, wide) // 32) * 32)
 
 
 COL_ALIGN = 128  # minor-dim DMA starts must be 128-lane aligned
+SEG_VMEM_BUDGET = 12 * 1024 * 1024  # scratch ceiling for the seg kernels
+
+
+def seg_vmem_ok(f: int, num_bins: int, has_cat: bool = False) -> bool:
+    """Whether the seg kernels' VMEM scratch fits at this (F, max_bin).
+
+    seg_hist: acc [8, F*bpad] f32 + out [3, F*bpad] f32 + onehot
+    [TILE, ~max(bpad, 2048)] bf16 + the staging tile.  The categorical
+    partition additionally builds a [bmt, 256] one-hot (bf16).  Narrow
+    configs (max_bin <= 256) always fit; wide ones must be checked before
+    auto-selecting seg mode."""
+    bpad = (max(num_bins, 1) + 127) // 128 * 128
+    hist = 11 * f * bpad * 4 + TILE * max(bpad, 2048) * 2 + 128 * TILE * 2
+    part = (max(256, bpad) * 256 * 2) if has_cat else 0
+    return max(hist, part) <= SEG_VMEM_BUDGET
 
 
 def padded_rows(n: int) -> int:
@@ -105,24 +123,31 @@ def _u16(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def pack_rows(
-    bins: jnp.ndarray,  # [N, F] integer bins (values < 256)
+    bins: jnp.ndarray,  # [N, F] integer bins (values < 256, or < 65536 wide)
     grad: jnp.ndarray,  # [N] f32
     hess: jnp.ndarray,  # [N] f32
     mask: jnp.ndarray,  # [N] f32 in {0, 1}
     n_pad: int,
+    wide: bool = False,
 ) -> jnp.ndarray:
     """Pack rows into the PLANE-MAJOR [LANES, n_pad] i16 layout (ridx = iota)."""
     n, f = bins.shape
-    if used_lanes(f) > LANES:
+    if used_lanes(f, wide) > LANES:
+        cap = (LANES - N_STAT_LANES) if wide else 2 * (LANES - N_STAT_LANES)
         raise ValueError(
-            f"seg layout supports at most {2 * (LANES - N_STAT_LANES)} features, got {f}"
+            f"seg layout supports at most {cap} features"
+            f"{' at max_bin > 256' if wide else ''}, got {f}"
         )
     bt = bins.T.astype(jnp.int32)  # [F, N]
-    # byte-packed bins: values >= 256 would bleed into the paired feature
-    bt = jnp.clip(bt, 0, MAX_SEG_BIN - 1)
-    if f % 2:
-        bt = jnp.concatenate([bt, jnp.zeros((1, n), jnp.int32)], axis=0)
-    bin16 = _u16(bt[0::2] | (bt[1::2] << 8))  # [ceil(F/2), N]
+    if wide:
+        # one u16 plane per feature (DenseBin<uint16_t>, dense_bin.hpp:18)
+        bin16 = _u16(jnp.clip(bt, 0, MAX_WIDE_BIN - 1))  # [F, N]
+    else:
+        # byte-packed bins: values >= 256 would bleed into the paired feature
+        bt = jnp.clip(bt, 0, MAX_SEG_BIN - 1)
+        if f % 2:
+            bt = jnp.concatenate([bt, jnp.zeros((1, n), jnp.int32)], axis=0)
+        bin16 = _u16(bt[0::2] | (bt[1::2] << 8))  # [ceil(F/2), N]
     gbits = lax.bitcast_convert_type(grad.astype(jnp.float32), jnp.uint32).astype(jnp.int32)
     hbits = lax.bitcast_convert_type(hess.astype(jnp.float32), jnp.uint32).astype(jnp.int32)
     ridx = jnp.arange(n, dtype=jnp.int32)
@@ -138,7 +163,7 @@ def pack_rows(
     ]
     packed = jnp.concatenate(planes, axis=0)
     packed = jnp.pad(
-        packed, ((0, storage_lanes(f) - packed.shape[0]), (0, n_pad - n))
+        packed, ((0, storage_lanes(f, wide) - packed.shape[0]), (0, n_pad - n))
     )
     return packed
 
@@ -147,17 +172,21 @@ def _plane_u16(seg: jnp.ndarray, plane) -> jnp.ndarray:
     return seg[plane].astype(jnp.int32) & 0xFFFF
 
 
-def unpack_stats(seg: jnp.ndarray, f: int, n: Optional[int] = None):
+def unpack_stats(seg: jnp.ndarray, f: int, n: Optional[int] = None,
+                 wide: bool = False):
     """Recover (bins[N,F] i32, g f32, h f32, mask f32, ridx i32) from the
     plane-major matrix (optionally only the first n data rows)."""
-    GLO, GHI, HLO, HHI, M, RLO, RHI = stat_lanes(f)
+    GLO, GHI, HLO, HHI, M, RLO, RHI = stat_lanes(f, wide)
     if n is None:
         n = seg.shape[1]
     seg = seg[:, :n]
-    packed = seg[: bin_lanes(f)].astype(jnp.int32) & 0xFFFF  # [bl, N]
-    lo = packed & 0xFF
-    hi = (packed >> 8) & 0xFF
-    bins = jnp.stack([lo, hi], axis=1).reshape(-1, n)[:f].T  # [N, F]
+    packed = seg[: bin_lanes(f, wide)].astype(jnp.int32) & 0xFFFF  # [bl, N]
+    if wide:
+        bins = packed.T  # [N, F] — one u16 plane per feature
+    else:
+        lo = packed & 0xFF
+        hi = (packed >> 8) & 0xFF
+        bins = jnp.stack([lo, hi], axis=1).reshape(-1, n)[:f].T  # [N, F]
     g = lax.bitcast_convert_type(
         (_plane_u16(seg, GLO) | (_plane_u16(seg, GHI) << 16)).astype(jnp.uint32),
         jnp.float32,
@@ -193,6 +222,7 @@ def _seg_hist_kernel(
     group: int,
     sub: int,
     quantized: bool,
+    wide: bool,
 ):
     start = scal_ref[0]
     cnt = scal_ref[1]
@@ -205,7 +235,7 @@ def _seg_hist_kernel(
     # rounding difference cannot change the result)
     inv_g = 1.0 / scales_ref[0]
     inv_h = 1.0 / scales_ref[1]
-    GLO, GHI, HLO, HHI, M, _, _ = stat_lanes(f)
+    GLO, GHI, HLO, HHI, M, _, _ = stat_lanes(f, wide)
     iota_rows = jax.lax.broadcasted_iota(jnp.int32, (TILE, 1), 0)[:, 0]
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (TILE, bpad), 1)
 
@@ -242,7 +272,10 @@ def _seg_hist_kernel(
                 nf = min(group, f - basef)
                 for j in range(nf):
                     fj = basef + j
-                    col = (xu[:, fj >> 1] >> (8 * (fj & 1))) & 0xFF
+                    if wide:
+                        col = xu[:, fj]  # u16 plane per feature
+                    else:
+                        col = (xu[:, fj >> 1] >> (8 * (fj & 1))) & 0xFF
                     onehot[:, j * bpad : (j + 1) * bpad] = (
                         col[:, None] == iota_b
                     ).astype(oh_dtype)
@@ -326,7 +359,8 @@ def _seg_hist_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("f", "num_bins", "n_pad", "quantized", "interpret")
+    jax.jit,
+    static_argnames=("f", "num_bins", "n_pad", "quantized", "wide", "interpret"),
 )
 def seg_hist_pallas(
     seg: jnp.ndarray,
@@ -337,6 +371,7 @@ def seg_hist_pallas(
     num_bins: int,
     n_pad: int,
     quantized: bool = False,
+    wide: bool = False,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Histogram [F, B, 3] (g, h, count) of packed rows [start, start+cnt).
@@ -347,10 +382,10 @@ def seg_hist_pallas(
     group = min(max(1, _TARGET_LANES // bpad), f)
     # DMA only the used planes (bins + stats), padded to an i16 sublane
     # multiple — 32 planes at F=28, 4x less tile traffic than the 128 cap
-    sub = min(storage_lanes(f), (used_lanes(f) + 15) // 16 * 16)
+    sub = min(storage_lanes(f, wide), (used_lanes(f, wide) + 15) // 16 * 16)
     kernel = functools.partial(
         _seg_hist_kernel, f=f, bpad=bpad, group=group, sub=sub,
-        quantized=quantized,
+        quantized=quantized, wide=wide,
     )
     if scales is None:
         scales = jnp.ones((2,), jnp.float32)
@@ -380,20 +415,21 @@ def seg_hist_pallas(
     return out.reshape(3, f, bpad)[:, :, :num_bins].transpose(1, 2, 0)
 
 
-def seg_hist_ref(seg: jnp.ndarray, scal: jnp.ndarray, *, f: int, num_bins: int, n_pad: int):
+def seg_hist_ref(seg: jnp.ndarray, scal: jnp.ndarray, *, f: int, num_bins: int,
+                 n_pad: int, wide: bool = False):
     """Pure-JAX reference/CPU path: masked histogram over the whole array
     (static shapes; rows outside [start, start+cnt) masked out)."""
     from ..histogram import leaf_histogram_segment
 
     start, cnt = scal[0], scal[1]
-    bins, g, h, m, _ = unpack_stats(seg, f)
+    bins, g, h, m, _ = unpack_stats(seg, f, wide=wide)
     idx = jnp.arange(seg.shape[1], dtype=jnp.int32)
     window = (idx >= start) & (idx < start + cnt)
     return leaf_histogram_segment(bins, g, h, m * window.astype(jnp.float32), num_bins)
 
 
 def seg_hist(seg, scal, *, f: int, num_bins: int, n_pad: int,
-             quant_scales=None):
+             quant_scales=None, wide: bool = False):
     """Platform dispatch: Pallas on TPU (int8 grid accumulation when
     ``quant_scales`` is given — quantized training), masked full pass
     elsewhere."""
@@ -409,9 +445,9 @@ def seg_hist(seg, scal, *, f: int, num_bins: int, n_pad: int,
         scales,
         tpu=functools.partial(
             seg_hist_pallas, f=f, num_bins=num_bins, n_pad=n_pad,
-            quantized=quantized,
+            quantized=quantized, wide=wide,
         ),
         default=lambda seg, scal, _s: seg_hist_ref(
-            seg, scal, f=f, num_bins=num_bins, n_pad=n_pad
+            seg, scal, f=f, num_bins=num_bins, n_pad=n_pad, wide=wide
         ),
     )

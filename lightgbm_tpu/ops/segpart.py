@@ -56,7 +56,7 @@ def _go_left(colv, tbin, dl, nanb, iscat, catmask):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("f", "n_pad")
+    jax.jit, static_argnames=("f", "n_pad", "wide")
 )
 def sort_partition_xla(
     seg: jnp.ndarray,  # [LANES, n_pad] i16 packed rows, PLANE-MAJOR — the
@@ -73,13 +73,14 @@ def sort_partition_xla(
     *,
     f: int,
     n_pad: int,
+    wide: bool = False,
 ):
     """Partition seg[sbegin : sbegin+cnt) by the split rule.
 
     Returns (seg', nl, nr): left child at [sbegin, sbegin+nl), right child at
     [sbegin+nl, sbegin+cnt), both in stable order; rows outside untouched.
     """
-    n_ops = (used_lanes(f) + 1) // 2  # i32 lanes that carry real data
+    n_ops = (used_lanes(f, wide) + 1) // 2  # i32 lanes that carry real data
     caps = window_caps(n_pad)
 
     def make_branch(P: int):
@@ -95,11 +96,15 @@ def sort_partition_xla(
             uT = win16.astype(jnp.int32) & 0xFFFF  # [2*n_ops, P]
             pos = jnp.arange(P, dtype=jnp.int32)
             in_seg = (pos >= off) & (pos < off + cnt)
-            # feature column: byte j&1 of i16 lane j>>1
-            lane = feat >> 1
-            shift = (feat & 1) * 8
-            col16 = lax.dynamic_slice(uT, (lane, 0), (1, P))[0]
-            colv = (col16 >> shift) & 0xFF
+            if wide:
+                # one u16 plane per feature (max_bin > 256)
+                colv = lax.dynamic_slice(uT, (feat, 0), (1, P))[0]
+            else:
+                # feature column: byte j&1 of i16 lane j>>1
+                lane = feat >> 1
+                shift = (feat & 1) * 8
+                col16 = lax.dynamic_slice(uT, (lane, 0), (1, P))[0]
+                colv = (col16 >> shift) & 0xFF
             gl = _go_left(colv, tbin, dl, nanb, iscat, catmask) & in_seg
             key = jnp.where(
                 pos < off,
@@ -136,7 +141,8 @@ def sort_partition_xla(
 
 
 def sort_partition(
-    seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask, *, f: int, n_pad: int
+    seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask, *, f: int,
+    n_pad: int, wide: bool = False,
 ):
     """Platform dispatch for the segment partition: the Pallas streaming
     kernel on TPU (ops/pallas/partition.py — exact window, in place, no
@@ -146,20 +152,23 @@ def sort_partition(
 
     def _pallas(seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask):
         bm = catmask.shape[0]
-        catm = jnp.zeros((1, 256), jnp.float32)
+        bmt = max(256, -(-bm // 128) * 128)  # cat-table width (wide bins)
+        catm = jnp.zeros((1, bmt), jnp.float32)
         catm = catm.at[0, :bm].set(catmask.astype(jnp.float32))
         scal = jnp.stack(
             [sbegin, cnt, feat, tbin, dl, nanb, iscat, jnp.int32(0)]
         ).astype(jnp.int32)
         seg_new, nl = seg_partition_pallas(
-            seg, scal, catm, f=f, n_pad=n_pad, use_cat=bm > 1
+            seg, scal, catm, f=f, n_pad=n_pad, use_cat=bm > 1, wide=wide
         )
         return seg_new, nl, cnt - nl
 
     return jax.lax.platform_dependent(
         seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask,
         tpu=_pallas,
-        default=functools.partial(sort_partition_xla, f=f, n_pad=n_pad),
+        default=functools.partial(
+            sort_partition_xla, f=f, n_pad=n_pad, wide=wide
+        ),
     )
 
 

@@ -177,3 +177,193 @@ def test_seg_hist_int8_quantized_exact(packed):
     # counts exact; g/h equal to the integer sums times the scales
     assert np.array_equal(got[:, :, 2], np.asarray(ref)[:, :, 2])
     assert np.allclose(got, np.asarray(ref), rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# wide (u16) bin planes — max_bin > 256 (reference DenseBin<uint16_t>,
+# src/io/dense_bin.hpp:18)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def packed_wide():
+    rng = np.random.default_rng(17)
+    f, n, b = 5, 3000, 1000
+    n_pad = padded_rows(n)
+    bins = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.random(n).astype(np.float32) + 0.5
+    m = (rng.random(n) < 0.8).astype(np.float32)
+    seg = pack_rows(
+        jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m),
+        n_pad, wide=True,
+    )
+    catmask = (rng.random(b) < 0.5).astype(np.float32)
+    return dict(
+        f=f, n=n, b=b, n_pad=n_pad, bins=bins, g=g, h=h, m=m,
+        seg=seg, segnp=np.asarray(seg), catmask=catmask,
+    )
+
+
+def test_wide_pack_unpack_roundtrip(packed_wide):
+    p = packed_wide
+    b2, g2, h2, m2, r2 = unpack_stats(p["seg"], p["f"], n=p["n"], wide=True)
+    assert np.array_equal(np.asarray(b2), p["bins"])
+    assert np.array_equal(np.asarray(g2), p["g"])
+    assert np.array_equal(np.asarray(h2), p["h"])
+    assert np.array_equal(np.asarray(m2), p["m"])
+    assert np.array_equal(np.asarray(r2), np.arange(p["n"]))
+
+
+def _np_partition_wide(segnp, sb, cnt, feat, tbin, dl, nanb, iscat, catmask):
+    rows = segnp[:, sb : sb + cnt].T  # [cnt, LANES]
+    colv = rows[:, feat].view(np.uint16).astype(np.int64)
+    if iscat:
+        gl = (catmask[np.clip(colv, 0, len(catmask) - 1)] > 0.5) & (
+            colv < len(catmask)
+        )
+    else:
+        gl = (colv <= tbin) | ((dl != 0) & (nanb >= 0) & (colv == nanb))
+    return rows[gl], rows[~gl]
+
+
+@pytest.mark.parametrize(
+    "sb,cnt,feat,tbin,dl,nanb,iscat",
+    [
+        (0, 3000, 3, 500, 0, -1, 0),  # root, threshold past 256
+        (17, 2000, 1, 700, 1, 900, 0),  # unaligned, NaN bin > 256
+        (513, 777, 2, 300, 0, -1, 1),  # categorical, wide mask
+        (100, 500, 0, 90, 0, -1, 0),  # low threshold
+    ],
+)
+def test_wide_sort_partition_vs_oracle(
+    packed_wide, sb, cnt, feat, tbin, dl, nanb, iscat
+):
+    p = packed_wide
+    seg1, nl, nr = sort_partition(
+        p["seg"], jnp.int32(sb), jnp.int32(cnt), jnp.int32(feat),
+        jnp.int32(tbin), jnp.int32(dl), jnp.int32(nanb), jnp.int32(iscat),
+        jnp.asarray(p["catmask"]), f=p["f"], n_pad=p["n_pad"], wide=True,
+    )
+    nl, nr = int(nl), int(nr)
+    expL, expR = _np_partition_wide(
+        p["segnp"], sb, cnt, feat, tbin, dl, nanb, iscat, p["catmask"]
+    )
+    assert (nl, nr) == (len(expL), len(expR))
+    got = np.asarray(seg1)
+    assert np.array_equal(got[:, sb : sb + nl].T, expL)
+    assert np.array_equal(got[:, sb + nl : sb + cnt].T, expR)
+    assert np.array_equal(got[:, :sb], p["segnp"][:, :sb])
+    assert np.array_equal(got[:, sb + cnt :], p["segnp"][:, sb + cnt :])
+
+
+@pytest.mark.parametrize("st,cnt", [(0, 3000), (17, 2000)])
+def test_wide_seg_hist_vs_oracle(packed_wide, st, cnt):
+    p = packed_wide
+    hs = seg_hist(
+        p["seg"], jnp.asarray([st, cnt], jnp.int32),
+        f=p["f"], num_bins=p["b"], n_pad=p["n_pad"], wide=True,
+    )
+    bo, go, ho, mo, _ = unpack_stats(
+        p["seg"][:, st : st + cnt], p["f"], wide=True
+    )
+    ref = leaf_histogram_segment(bo, go, ho, mo, p["b"])
+    d = np.abs(np.asarray(hs) - np.asarray(ref)).max()
+    rel = d / max(1e-9, np.abs(np.asarray(ref)).max())
+    assert rel < 5e-6
+
+
+def test_wide_seg_hist_pallas_kernel_interpret(packed_wide):
+    from lightgbm_tpu.ops.pallas.seg import seg_hist_pallas
+
+    p = packed_wide
+    st, cnt = 17, 1500
+    hs = seg_hist_pallas(
+        p["seg"], jnp.asarray([st, cnt], jnp.int32),
+        f=p["f"], num_bins=p["b"], n_pad=p["n_pad"], wide=True,
+        interpret=True,
+    )
+    bo, go, ho, mo, _ = unpack_stats(
+        p["seg"][:, st : st + cnt], p["f"], wide=True
+    )
+    ref = leaf_histogram_segment(bo, go, ho, mo, p["b"])
+    d = np.abs(np.asarray(hs) - np.asarray(ref)).max()
+    rel = d / max(1e-9, np.abs(np.asarray(ref)).max())
+    assert rel < 5e-6
+
+
+def test_wide_partition_kernel_interpret(packed_wide):
+    """The Pallas streaming partition on wide planes must match the XLA
+    sort path bit-for-bit (the byte-split one-hot compaction is content
+    agnostic; only the key extraction reads u16)."""
+    from lightgbm_tpu.ops.pallas.partition import seg_partition_pallas
+    from lightgbm_tpu.ops.segpart import sort_partition_xla
+
+    p = packed_wide
+    sb, cnt, feat, tbin = 17, 2000, 1, 700
+    bm = len(p["catmask"])
+    bmt = max(256, -(-bm // 128) * 128)
+    catm = jnp.zeros((1, bmt), jnp.float32).at[0, :bm].set(
+        jnp.asarray(p["catmask"])
+    )
+    scal = jnp.asarray([sb, cnt, feat, tbin, 1, 900, 0, 0], jnp.int32)
+    got, nl_k = seg_partition_pallas(
+        p["seg"], scal, catm, f=p["f"], n_pad=p["n_pad"], use_cat=True,
+        wide=True, interpret=True,
+    )
+    want, nl_s, _ = sort_partition_xla(
+        p["seg"], jnp.int32(sb), jnp.int32(cnt), jnp.int32(feat),
+        jnp.int32(tbin), jnp.int32(1), jnp.int32(900), jnp.int32(0),
+        jnp.asarray(p["catmask"]), f=p["f"], n_pad=p["n_pad"], wide=True,
+    )
+    assert int(nl_k) == int(nl_s)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_wide_grow_tree_matches_ordered():
+    """End-to-end: a seg-mode tree at max_bin=1024 equals the ordered-mode
+    tree (same splits, same leaf values)."""
+    from lightgbm_tpu.ops.grower import GrowerParams, grow_tree
+
+    rng = np.random.default_rng(23)
+    n, f, b = 4000, 4, 1024
+    bins = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = (rng.random(n).astype(np.float32) + 0.5)
+    num_bins = jnp.full((f,), b, jnp.int32)
+    nan_bins = jnp.full((f,), -1, jnp.int32)
+    trees = {}
+    for mode in ("seg", "ordered"):
+        params = GrowerParams(
+            num_leaves=15, max_bin=b, min_data_in_leaf=5,
+            min_sum_hessian_in_leaf=0.0, lambda_l2=0.1, hist_mode=mode,
+        )
+        tree, leaf_id = grow_tree(
+            jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.ones(n, jnp.float32), num_bins, nan_bins,
+            jnp.ones(f, bool), params,
+        )
+        trees[mode] = (tree, np.asarray(leaf_id))
+    ts, tord = trees["seg"][0], trees["ordered"][0]
+    assert int(ts.num_leaves) == int(tord.num_leaves)
+    np.testing.assert_array_equal(
+        np.asarray(ts.split_feature), np.asarray(tord.split_feature)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ts.split_bin), np.asarray(tord.split_bin)
+    )
+    np.testing.assert_allclose(
+        np.asarray(ts.leaf_value), np.asarray(tord.leaf_value), rtol=1e-5,
+        atol=1e-7,
+    )
+    np.testing.assert_array_equal(trees["seg"][1], trees["ordered"][1])
+
+
+def test_seg_vmem_gate():
+    from lightgbm_tpu.ops.pallas.seg import seg_vmem_ok
+
+    assert seg_vmem_ok(28, 256)  # the bench config always fits
+    assert seg_vmem_ok(121, 1024)  # wide, moderate
+    assert not seg_vmem_ok(100, 4096)  # 18 MB acc — must fall back
+    assert not seg_vmem_ok(121, 65536)
+    assert not seg_vmem_ok(4, 65536, has_cat=True)  # cat one-hot blows up
