@@ -41,19 +41,51 @@ class SampleStrategy:
 
 
 class BaggingStrategy(SampleStrategy):
-    """Per-row Bernoulli bagging, refreshed every ``bagging_freq`` iterations."""
+    """Per-row Bernoulli bagging, refreshed every ``bagging_freq`` iterations.
 
-    def __init__(self, config: Config, num_data: int, is_pos=None):
+    ``query_sizes`` switches to per-QUERY bagging (reference
+    ``bagging_by_query``, src/boosting/bagging.hpp:52): whole queries are
+    kept or dropped as units so lambdarank's within-query pairs never see a
+    partially-sampled query.  The reference rebuilds ``bag_data_indices``
+    query by query; the TPU formulation draws one Bernoulli per query and
+    expands it to rows with a static-shape ``jnp.repeat`` (query sizes are
+    host constants — no gather)."""
+
+    def __init__(self, config: Config, num_data: int, is_pos=None,
+                 query_sizes=None):
         super().__init__(config, num_data)
         self._mask = self._ones
         self._last_refresh = -1
         self._is_pos = is_pos  # device bool [N] for balanced bagging, or None
+        self._qsizes = None
+        if query_sizes is not None:
+            qs = np.asarray(query_sizes, np.int64)
+            pad = num_data - int(qs.sum())
+            if pad < 0:
+                raise ValueError(
+                    f"query sizes sum {qs.sum()} > num_data {num_data}"
+                )
+            if pad:
+                # padding rows form a pseudo-query that is never in bag
+                qs = np.append(qs, pad)
+            self._qsizes = qs
+            self._qpad = pad
 
     def sample(self, iteration, grad, hess, rng):
         cfg = self.config
         freq = max(1, cfg.bagging_freq)
         if iteration % freq == 0:
-            if self._is_pos is not None:
+            if self._qsizes is not None:
+                nq = len(self._qsizes)
+                qmask = jax.random.bernoulli(
+                    rng, cfg.bagging_fraction, (nq,)
+                ).astype(jnp.float32)
+                if self._qpad:
+                    qmask = qmask.at[nq - 1].set(0.0)
+                self._mask = jnp.repeat(
+                    qmask, self._qsizes, total_repeat_length=self.num_data
+                )
+            elif self._is_pos is not None:
                 p = jnp.where(
                     self._is_pos, cfg.pos_bagging_fraction, cfg.neg_bagging_fraction
                 )
@@ -98,17 +130,49 @@ class GOSSStrategy(SampleStrategy):
         return mask, grad * factor * mask[None, :], hess * factor * mask[None, :]
 
 
-def create_sample_strategy(config: Config, num_data: int, is_pos=None) -> SampleStrategy:
+def create_sample_strategy(
+    config: Config, num_data: int, is_pos=None, query_sizes=None
+) -> SampleStrategy:
     """Factory (reference: SampleStrategy::CreateSampleStrategy,
     src/boosting/sample_strategy.cpp)."""
-    if config.boosting == "goss" or (config.raw or {}).get("data_sample_strategy") == "goss":
-        return GOSSStrategy(config, num_data)
+    is_goss = (
+        config.boosting == "goss"
+        or (config.raw or {}).get("data_sample_strategy") == "goss"
+    )
     need_balanced = (
         config.pos_bagging_fraction < 1.0 or config.neg_bagging_fraction < 1.0
     )
+    bagging_active = (
+        config.bagging_freq > 0
+        and (config.bagging_fraction < 1.0 or need_balanced)
+    ) or config.boosting == "rf"
+    qs = query_sizes if config.bagging_by_query else None
+    if config.bagging_by_query and bagging_active:
+        # by-query sampling can't be combined with row-level strategies:
+        # both would partially sample queries, the exact thing it forbids
+        if is_goss:
+            raise ValueError(
+                "bagging_by_query cannot be combined with GOSS (GOSS "
+                "samples individual rows, splitting queries)"
+            )
+        if need_balanced:
+            raise ValueError(
+                "bagging_by_query cannot be combined with pos/neg "
+                "balanced bagging (balanced bagging samples individual "
+                "rows, splitting queries)"
+            )
+        if query_sizes is None:
+            raise ValueError(
+                "bagging_by_query=True needs query information (set "
+                "`group` on the train Dataset)"
+            )
+    if is_goss:
+        return GOSSStrategy(config, num_data)
     if config.bagging_freq > 0 and (config.bagging_fraction < 1.0 or need_balanced):
-        return BaggingStrategy(config, num_data, is_pos if need_balanced else None)
+        return BaggingStrategy(
+            config, num_data, is_pos if need_balanced else None, query_sizes=qs
+        )
     if config.boosting == "rf":
         # RF requires bagging (reference rf.hpp:25 CHECK)
-        return BaggingStrategy(config, num_data)
+        return BaggingStrategy(config, num_data, query_sizes=qs)
     return SampleStrategy(config, num_data)

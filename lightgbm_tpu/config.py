@@ -295,6 +295,7 @@ class Config:
     neg_bagging_fraction: float = 1.0
     bagging_freq: int = 0
     bagging_seed: int = 3
+    bagging_by_query: bool = False
     feature_fraction: float = 1.0
     feature_fraction_bynode: float = 1.0
     feature_fraction_seed: int = 2

@@ -21,9 +21,10 @@ Layout decisions:
     (the reference's ``FindInBitset``, tree.h:346, as vector ops).
 
 Supported: numeric + categorical splits in BIN space, bin values < 256
-(byte-packed), trees up to 512 nodes / 512 leaves, F <= 128 features, any
-class count (output padded to a multiple of 8).  Wider-bin models fall back
-to the XLA walker.
+(byte-packed), trees up to 512 nodes / 512 leaves, F <= 512 features (4 per
+i32 lane across ceil(F/128) plane groups; the plane-select tree deepens
+with F), any class count (output padded to a multiple of 8).  Wider-bin
+models fall back to the XLA walker.
 """
 
 from __future__ import annotations
@@ -46,10 +47,18 @@ LANES = 128
 ROW_TILE = 1024
 MAX_NODES = 512  # hard cap (4 lane-gather halves); per-model H is smaller
 MAX_THR = 256  # bin values are byte-packed: thresholds/NaN bins must fit u8
+MAX_F = 512  # feature cap: 9-bit feature field, 128 packed i32 planes
 KPAD = 8  # minimum output class columns (padded to a multiple of 8)
-BINS_PACKED = 32  # 128 features at 4 bins per i32 lane
 CAT_WORDS = 8  # 256-bit category bitset = 8 i32 words per node
 VMEM_TABLE_BUDGET = 12 * 1024 * 1024  # fall back when tables outgrow VMEM
+
+
+def n_planes(num_features: int) -> int:
+    """Packed i32 bin planes for F features: pow2(ceil(F/4)), min 32."""
+    p = 32
+    while p * 4 < num_features:
+        p *= 2
+    return p
 
 
 class ForestTables(NamedTuple):
@@ -57,7 +66,7 @@ class ForestTables(NamedTuple):
     leading dim carries the tree index so per-tree slicing never hits the
     tiled-dim alignment rules)."""
 
-    pk1: jnp.ndarray  # i32: thr | feat<<9 | dl<<16 | (nanb+1)<<17 | cat<<26
+    pk1: jnp.ndarray  # i32: thr | feat<<9 | dl<<18 | (nanb+1)<<19 | cat<<28
     pk2: jnp.ndarray  # i32: (left+m_nodes) | (right+m_nodes)<<16 (neg = ~leaf)
     leaf: jnp.ndarray  # f32 [T, H, 128]: leaf value by LEAF index
     catw: jnp.ndarray  # i32 [T, CAT_WORDS, H, 128] category bitset words
@@ -68,44 +77,61 @@ class ForestTables(NamedTuple):
     has_cat: bool
 
 
-def walk_eligible(
+def walk_reject_reason(
     records, nan_bins: np.ndarray, num_features: int, max_bin: int
-) -> bool:
-    """<=511 splits/tree, bin space fits a byte, F <= 128; categorical OK."""
-    if num_features > LANES:
-        return False
+):
+    """None when the kernel can run this model, else a human-readable reason
+    (<=511 splits/tree, bin space fits a byte, F <= 512; categorical OK)."""
+    if num_features > MAX_F:
+        return f"{num_features} features > {MAX_F}"
     if max_bin > MAX_THR:
         # input bins would clip at 255 and could misroute at high thresholds
-        return False
+        return f"max_bin {max_bin} > {MAX_THR} (bins must fit a byte)"
     if len(nan_bins) and int(np.max(nan_bins)) >= MAX_THR:
-        return False  # NaN bin must fit the 8-bit fval (nanb+1 has 9 bits)
+        # NaN bin must fit the 8-bit fval (nanb+1 has 9 bits)
+        return f"NaN bin {int(np.max(nan_bins))} >= {MAX_THR}"
     n_nodes_max = 1
     has_cat = False
     for r in records:
         sf = r.get("split_feature")
         if sf is None or len(sf) >= MAX_NODES:
-            return False
+            return (
+                "a tree has no bin-space record"
+                if sf is None
+                else f"a tree has {len(sf)} splits >= {MAX_NODES}"
+            )
         n_nodes_max = max(n_nodes_max, len(sf) + 1)
         sic = r.get("split_is_cat")
         if sic is not None and np.any(np.asarray(sic)):
             has_cat = True
             cm = r.get("cat_mask")
             if cm is None or (np.size(cm) and np.asarray(cm).shape[-1] > 256):
-                return False
+                return "a categorical mask is wider than 256 bins"
             cma = np.asarray(cm)
             if np.size(cma) and cma.shape[-1] == 256 and np.any(cma[..., 255]):
                 # pad_bins_for_walk clips the unseen-category sentinel to
                 # 255: if a real mask claims bin 255 goes left, the clipped
                 # sentinel would misroute left (the walker/reference sends
                 # unseen categories right) — fall back
-                return False
+                return "a categorical mask claims bin 255 (sentinel clash)"
         if len(sf) and int(np.max(np.asarray(r["split_bin"]))) >= MAX_THR:
-            return False
+            return f"a split threshold bin >= {MAX_THR}"
     h = max(1, -(-n_nodes_max // LANES))
     if h == 3:
         h = 4  # build_tables pads to a power-of-two of halves
     table_bytes = len(records) * h * LANES * 4 * (3 + (CAT_WORDS if has_cat else 0))
-    return table_bytes <= VMEM_TABLE_BUDGET
+    if table_bytes > VMEM_TABLE_BUDGET:
+        return (
+            f"node tables ({table_bytes >> 20} MiB for {len(records)} trees) "
+            "exceed the VMEM budget"
+        )
+    return None
+
+
+def walk_eligible(
+    records, nan_bins: np.ndarray, num_features: int, max_bin: int
+) -> bool:
+    return walk_reject_reason(records, nan_bins, num_features, max_bin) is None
 
 
 def build_tables(records, nan_bins: np.ndarray) -> ForestTables:
@@ -154,7 +180,7 @@ def build_tables(records, nan_bins: np.ndarray) -> ForestTables:
             else np.zeros(nn, np.int64)
         )
         pk1[i, :nn] = (
-            thr | (sf << 9) | (dl << 16) | (nb << 17) | (cat << 26)
+            thr | (sf << 9) | (dl << 18) | (nb << 19) | (cat << 28)
         ).astype(np.int32)
         pk2[i, :nn] = ((lc + m_nodes) | ((rc + m_nodes) << 16)).astype(np.int32)
         if has_cat and cat.any():
@@ -212,8 +238,8 @@ def _lookup(table_hx128, cur, h: int):
 
 
 def _walk_kernel(
-    bins_ref,  # VMEM [1, BINS_PACKED, 8, 128] i32 — 4 bins per i32, tile
-    #           rows laid out as (sublane, lane); everything in the walk is a
+    bins_ref,  # VMEM [1, P, 8, 128] i32 — 4 bins per i32, tile rows laid
+    #           out as (sublane, lane); everything in the walk is a
     #           vreg-shaped [8, 128] op — no reshapes, no row-major crossings
     pk1_ref,  # VMEM [T, H, 128] i32
     pk2_ref,
@@ -228,15 +254,17 @@ def _walk_kernel(
     h: int,
     m_nodes: int,
     has_cat: bool,
+    planes_n: int,
 ):
-    planes = [bins_ref[0, p] for p in range(BINS_PACKED)]  # 32 x [8, 128]
+    planes = [bins_ref[0, p] for p in range(planes_n)]  # P x [8, 128]
     out_ref[...] = jnp.zeros_like(out_ref)
     iota_k = jax.lax.broadcasted_iota(jnp.int32, (kpad, 8, LANES), 0)
+    sel_bits = planes_n.bit_length() - 1  # planes_n is a power of two
 
     def select_plane(lane_idx):
-        """31-select binary tree: out[s,l] = planes[lane_idx[s,l]][s,l]."""
+        """(P-1)-select binary tree: out[s,l] = planes[lane_idx[s,l]][s,l]."""
         level_vals = planes
-        for bit in range(5):
+        for bit in range(sel_bits):
             b = (lane_idx >> bit) & 1
             level_vals = [
                 jnp.where(b != 0, level_vals[2 * i + 1], level_vals[2 * i])
@@ -253,15 +281,15 @@ def _walk_kernel(
             # categorical node pay the 8-word bitset lookup per level (one
             # vector reduce per tree buys a lax.cond skip of ~8H gathers +
             # selects per level for the all-numeric trees)
-            tree_cat = jnp.any(((pk1 >> 26) & 1) != 0)
+            tree_cat = jnp.any(((pk1 >> 28) & 1) != 0)
 
         def level(_, cur):
             curc = jnp.maximum(cur, 0)  # [8, 128]
             p1 = _lookup(pk1, curc, h)
             thr = p1 & 0x1FF
-            feat = (p1 >> 9) & 0x7F
-            dl = (p1 >> 16) & 1
-            nb = ((p1 >> 17) & 0x1FF) - 1
+            feat = (p1 >> 9) & 0x1FF
+            dl = (p1 >> 18) & 1
+            nb = ((p1 >> 19) & 0x1FF) - 1
             packed = select_plane(feat >> 2)
             fval = (packed >> ((feat & 3) * 8)) & 0xFF
             gl = (fval <= thr) | ((dl != 0) & (nb >= 0) & (fval == nb))
@@ -286,7 +314,7 @@ def _walk_kernel(
                         ]
                         bit += 1
                     catgo = ((words[0] >> (fval & 31)) & 1) != 0
-                    isc = (p1 >> 26) & 1
+                    isc = (p1 >> 28) & 1
                     return jnp.where(isc != 0, catgo, g)
 
                 gl = lax.cond(tree_cat, cat_gl, lambda g: g, gl)
@@ -310,7 +338,7 @@ def _walk_kernel(
 
 
 def forest_walk(
-    bins: jnp.ndarray,  # [N_pad, BINS_PACKED] i32 (N_pad % ROW_TILE == 0)
+    bins: jnp.ndarray,  # [n_tiles, P, 8, 128] i32 (P = n_planes(F))
     tables: ForestTables,
     *,
     n_trees: int,
@@ -346,6 +374,7 @@ def _forest_walk_jit(
     interpret,
 ):
     n_tiles = bins.shape[0]
+    planes_n = bins.shape[1]
     h = pk1.shape[1]
     kpad = max(KPAD, -(-k // 8) * 8)
     kernel = functools.partial(
@@ -357,12 +386,13 @@ def _forest_walk_jit(
         h=h,
         m_nodes=m_nodes,
         has_cat=has_cat,
+        planes_n=planes_n,
     )
     return pl.pallas_call(
         kernel,
         grid=(n_tiles,),
         in_specs=[
-            pl.BlockSpec((1, BINS_PACKED, 8, LANES), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, planes_n, 8, LANES), lambda i: (i, 0, 0, 0)),
             pl.BlockSpec((n_trees, h, LANES), lambda i: (0, 0, 0)),
             pl.BlockSpec((n_trees, h, LANES), lambda i: (0, 0, 0)),
             pl.BlockSpec((n_trees, h, LANES), lambda i: (0, 0, 0)),
@@ -376,20 +406,21 @@ def _forest_walk_jit(
 
 @functools.partial(jax.jit, static_argnames=("n_pad",))
 def _pack_bins_device(mat_u8: jnp.ndarray, n_pad: int) -> jnp.ndarray:
-    """Device-side bin packing: [N, F] u8 -> [n_tiles, 32, 8, 128] i32."""
+    """Device-side bin packing: [N, F] u8 -> [n_tiles, P, 8, 128] i32."""
     n, f = mat_u8.shape
-    b = jnp.zeros((n_pad, LANES), jnp.int32)
+    p = n_planes(f)
+    b = jnp.zeros((n_pad, 4 * p), jnp.int32)
     b = b.at[:n, :f].set(mat_u8.astype(jnp.int32))
     packed = (
         b[:, 0::4] | (b[:, 1::4] << 8) | (b[:, 2::4] << 16) | (b[:, 3::4] << 24)
-    )  # [n_pad, 32]
-    return packed.reshape(n_pad // ROW_TILE, 8, LANES, BINS_PACKED).transpose(
+    )  # [n_pad, P]
+    return packed.reshape(n_pad // ROW_TILE, 8, LANES, p).transpose(
         0, 3, 1, 2
     )
 
 
 def pad_bins_for_walk(bins: np.ndarray) -> jnp.ndarray:
-    """[N, F] int bins -> [n_tiles, BINS_PACKED, 8, 128] i32, 4 bins
+    """[N, F] int bins -> [n_tiles, P, 8, 128] i32, 4 bins
     byte-packed per i32 (feature j in byte j&3 of pack j>>2); row n sits at
     [n // 1024, :, (n % 1024) // 128, n % 128].  Only the compact u8 matrix
     crosses host->device (the padded i32 form is 9x bigger — built on

@@ -1,0 +1,135 @@
+"""bagging_by_query: whole queries sampled as units (reference:
+src/boosting/bagging.hpp:52 — per-query BaggingHelper + index rebuild;
+here one Bernoulli per query expanded to rows by a static jnp.repeat)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.boosting.sampling import BaggingStrategy, create_sample_strategy
+
+
+def _mask(strategy, it, n, seed=0):
+    g = jnp.zeros((1, n), jnp.float32)
+    m, _, _ = strategy.sample(it, g, g, jax.random.PRNGKey(seed))
+    return np.asarray(m)
+
+
+def test_mask_constant_within_queries():
+    sizes = np.array([7, 3, 12, 1, 9, 20, 8], np.int64)
+    n = int(sizes.sum())
+    cfg = Config.from_params({"bagging_fraction": 0.5, "bagging_freq": 1})
+    s = BaggingStrategy(cfg, n, query_sizes=sizes)
+    for it in range(4):
+        m = _mask(s, it, n, seed=it)
+        o = 0
+        for sz in sizes:
+            q = m[o : o + sz]
+            assert (q == q[0]).all(), "query partially sampled"
+            o += sz
+        assert set(np.unique(m)) <= {0.0, 1.0}
+
+
+def test_padding_rows_never_in_bag():
+    sizes = np.array([10, 10], np.int64)
+    n = 32  # 12 padding rows
+    cfg = Config.from_params({"bagging_fraction": 1.0, "bagging_freq": 1})
+    s = BaggingStrategy(cfg, n, query_sizes=sizes)
+    m = _mask(s, 0, n)
+    assert (m[:20] == 1.0).all()
+    assert (m[20:] == 0.0).all()
+
+
+def test_fraction_approximately_respected():
+    rng = np.random.default_rng(0)
+    sizes = rng.integers(5, 15, size=400).astype(np.int64)
+    n = int(sizes.sum())
+    cfg = Config.from_params({"bagging_fraction": 0.3, "bagging_freq": 1})
+    s = BaggingStrategy(cfg, n, query_sizes=sizes)
+    kept = []
+    for it in range(5):
+        m = _mask(s, it, n, seed=it)
+        o = 0
+        k = 0
+        for sz in sizes:
+            k += int(m[o])
+            o += sz
+        kept.append(k / len(sizes))
+    assert 0.2 < np.mean(kept) < 0.4
+
+
+def test_refresh_respects_bagging_freq():
+    sizes = np.array([16] * 10, np.int64)
+    n = 160
+    cfg = Config.from_params({"bagging_fraction": 0.5, "bagging_freq": 3})
+    s = BaggingStrategy(cfg, n, query_sizes=sizes)
+    m0 = _mask(s, 0, n, seed=1)
+    m1 = _mask(s, 1, n, seed=2)  # no refresh: same mask despite new rng
+    assert np.array_equal(m0, m1)
+    m3 = _mask(s, 3, n, seed=3)
+    assert not np.array_equal(m0, m3)  # refresh at freq boundary
+
+
+def test_factory_requires_group():
+    cfg = Config.from_params(
+        {"bagging_by_query": True, "bagging_fraction": 0.5, "bagging_freq": 1}
+    )
+    with pytest.raises(ValueError, match="query information"):
+        create_sample_strategy(cfg, 100)
+
+
+def test_lambdarank_bagging_by_query_e2e():
+    rng = np.random.default_rng(3)
+    n, f = 1200, 6
+    X = rng.normal(size=(n, f))
+    y = rng.integers(0, 4, n).astype(float)
+    grp = np.full(60, 20)
+    params = {
+        "objective": "lambdarank",
+        "bagging_by_query": True,
+        "bagging_fraction": 0.5,
+        "bagging_freq": 1,
+        "verbosity": -1,
+        "metric": "ndcg",
+        "eval_at": [3],
+    }
+    res = {}
+    b = lgb.train(
+        params,
+        lgb.Dataset(X, y, group=grp),
+        num_boost_round=15,
+        valid_sets=[lgb.Dataset(X, y, group=grp)],
+        valid_names=["t"],
+        callbacks=[lgb.record_evaluation(res)],
+    )
+    assert b.num_trees() == 15
+    assert res["t"]["ndcg@3"][-1] > 0.5  # learns something
+
+
+def test_conflicting_strategies_rejected():
+    base = {"bagging_by_query": True, "bagging_fraction": 0.5, "bagging_freq": 1}
+    with pytest.raises(ValueError, match="GOSS"):
+        create_sample_strategy(
+            Config.from_params({**base, "boosting": "goss"}), 100,
+            query_sizes=np.array([50, 50]),
+        )
+    with pytest.raises(ValueError, match="balanced"):
+        create_sample_strategy(
+            Config.from_params(
+                {**base, "objective": "binary", "pos_bagging_fraction": 0.5}
+            ),
+            100,
+            query_sizes=np.array([50, 50]),
+        )
+
+
+def test_inactive_bagging_is_noop():
+    # bagging_by_query with bagging off (freq=0 default) must not require
+    # group info — the reference only consults it inside active bagging
+    cfg = Config.from_params({"bagging_by_query": True})
+    s = create_sample_strategy(cfg, 100)
+    m = _mask(s, 0, 100)
+    assert (m == 1.0).all()

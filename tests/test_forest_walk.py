@@ -83,9 +83,13 @@ def test_walk_eligibility_gates():
     assert not walk_eligible(
         b._bin_records, np.asarray(b._nan_bins), X.shape[1], 512
     )
-    # > 128 features falls back (lane-gather plane budget)
-    assert not walk_eligible(
+    # > 512 features falls back (9-bit feature field / plane budget);
+    # 200 features is now eligible via the deeper plane-select tree
+    assert walk_eligible(
         b._bin_records, np.asarray(b._nan_bins), 200, b._max_bin_padded
+    )
+    assert not walk_eligible(
+        b._bin_records, np.asarray(b._nan_bins), 600, b._max_bin_padded
     )
 
 
@@ -281,3 +285,29 @@ def test_bin_edge_rows_rebinned_exactly():
     # exact path, and non-suspects are provably safe (their distance to any
     # boundary exceeds the f32/f64 rounding gap the tolerance covers)
     assert np.array_equal(fixed, host)
+
+
+def test_forest_walk_256_features():
+    """F > 128 rides the deeper plane-select tree (VERDICT r3 #8): a
+    256-feature model must stay on the fast path and match the XLA walker."""
+    rng = np.random.default_rng(9)
+    n, f = 2000, 256
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] + 0.5 * X[:, 200] - X[:, 129] + rng.normal(size=n) * 0.1
+    b = _train(X, y, {"objective": "regression", "num_leaves": 31}, 8)
+    got = _walk_raw(b, X, 1)[:, 0]
+    exp = _xla_raw(b, X, 1)[:, 0]
+    assert np.allclose(got, exp, atol=1e-5)
+
+
+def test_walk_reject_reasons():
+    from lightgbm_tpu.ops.pallas.forest_walk import walk_reject_reason
+
+    assert "features > 512" in walk_reject_reason([], np.array([]), 600, 64)
+    assert "max_bin" in walk_reject_reason([], np.array([]), 4, 1024)
+    assert walk_reject_reason(
+        [dict(split_feature=np.array([0]), split_bin=np.array([3]),
+              default_left=np.array([0]), left_child=np.array([-1]),
+              right_child=np.array([-2]), leaf_value=np.array([0.1, 0.2]))],
+        np.array([-1]), 4, 64,
+    ) is None
