@@ -370,6 +370,11 @@ class Booster:
                 and not self._featpar  # rows replicated: no padding at all
                 and self.objective is not None
                 and self.objective.need_query
+                # multi-process feeding keeps ALL devices: trimming by the
+                # LOCAL row count would leave a mesh spanning processes
+                # unevenly (non-uniform sharding); the equal-rows-divisible
+                # check below enforces the no-padding invariant instead
+                and not (jax.process_count() > 1 and cfg.pre_partition)
             ):
                 dn = len(devices)
                 while dn > 1 and n % dn != 0:
@@ -606,16 +611,10 @@ class Booster:
                 if pad:
                     ip = np.concatenate([ip, np.zeros(pad, bool)])
             is_pos = jnp.asarray(ip)
+        from .sampling import bagging_is_active
+
         query_sizes = None
-        bagging_on = cfg.boosting == "rf" or (
-            cfg.bagging_freq > 0
-            and (
-                cfg.bagging_fraction < 1.0
-                or cfg.pos_bagging_fraction < 1.0
-                or cfg.neg_bagging_fraction < 1.0
-            )
-        )
-        if cfg.bagging_by_query and bagging_on:
+        if cfg.bagging_by_query and bagging_is_active(cfg):
             if self._multiproc:
                 raise NotImplementedError(
                     "bagging_by_query under pre_partition multi-process "
@@ -1203,6 +1202,59 @@ class Booster:
 
         return jnp.asarray(pad_rows_np(np.asarray(delta, dtype=np.float32), pad))
 
+    def _get_gradients(self):
+        """Objective gradients in the GLOBAL score sharding.
+
+        Elementwise objectives run straight on the sharded score.  Ranking
+        objectives under multi-process feeding are per-query and queries
+        never straddle processes (the init contract at _init_train), so
+        each process computes gradients on its LOCAL score columns and the
+        results are reassembled into the global sharded array from local
+        device buffers — no host round trip of the global matrix
+        (reference: rank_objective gradients are rank-local too; the
+        Allreduce happens later on histograms)."""
+        if not (self._multiproc and self.objective.need_query):
+            return self.objective.get_gradients(self._score, self._next_rng())
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        shards = sorted(
+            self._score.addressable_shards,
+            key=lambda s: s.index[1].start or 0,
+        )
+        # per-device shards -> one host-local [K, lpad] block (small: the
+        # score column slice of this process only)
+        local = np.concatenate([np.asarray(s.data) for s in shards], axis=1)
+        n = self.train_set.num_data  # local unpadded rows
+        g, h = self.objective.get_gradients(
+            jnp.asarray(local[:, :n]), self._next_rng()
+        )
+        lpad = local.shape[1]
+        if lpad > n:
+            z = jnp.zeros((g.shape[0], lpad - n), g.dtype)
+            g = jnp.concatenate([g, z], axis=1)
+            h = jnp.concatenate([h, z], axis=1)
+        pidx = _jax.process_index()
+        # mesh devices along the data axis, this process's block (process
+        # blocks are contiguous: the mesh is built from jax.devices())
+        mine = [
+            d for d in self._mesh.devices.flat if d.process_index == pidx
+        ]
+        chunk = lpad // len(mine)
+        sh = NamedSharding(self._mesh, P(None, "data"))
+        gshape = (g.shape[0], self._n_dev_global)
+
+        def _assemble(a):
+            pieces = [
+                _jax.device_put(a[:, i * chunk : (i + 1) * chunk], d)
+                for i, d in enumerate(mine)
+            ]
+            return _jax.make_array_from_single_device_arrays(
+                gshape, sh, pieces
+            )
+
+        return _assemble(g), _assemble(h)
+
     def _sample(self, grad, hess):
         """Bagging/GOSS row sampling; padded (mesh-fill) rows never count.
 
@@ -1252,9 +1304,7 @@ class Booster:
             and type(self) is Booster
             and eff_len >= k  # init/boost-from-avg settled
         ):
-            grad, hess = self.objective.get_gradients(
-                self._score, self._next_rng()
-            )
+            grad, hess = self._get_gradients()
             mask, grad, hess = self._sample(grad, hess)
             feature_mask = self._feature_mask_for_iter()
             return self._update_pipelined(grad, hess, mask, feature_mask, k)
@@ -1278,7 +1328,7 @@ class Booster:
                         self._score = self._score.at[kk].add(s)
                         for entry in self._valid:
                             entry.score = entry.score.at[kk].add(s)
-            grad, hess = self.objective.get_gradients(self._score, self._next_rng())
+            grad, hess = self._get_gradients()
         else:
             if self._multiproc:
                 raise ValueError(

@@ -130,6 +130,18 @@ class GOSSStrategy(SampleStrategy):
         return mask, grad * factor * mask[None, :], hess * factor * mask[None, :]
 
 
+def bagging_is_active(config: Config) -> bool:
+    """Whether any bagging mask will ever be drawn (used by the factory AND
+    by the Booster to decide whether query info must be collected)."""
+    need_balanced = (
+        config.pos_bagging_fraction < 1.0 or config.neg_bagging_fraction < 1.0
+    )
+    return (
+        config.bagging_freq > 0
+        and (config.bagging_fraction < 1.0 or need_balanced)
+    ) or config.boosting == "rf"
+
+
 def create_sample_strategy(
     config: Config, num_data: int, is_pos=None, query_sizes=None
 ) -> SampleStrategy:
@@ -142,10 +154,7 @@ def create_sample_strategy(
     need_balanced = (
         config.pos_bagging_fraction < 1.0 or config.neg_bagging_fraction < 1.0
     )
-    bagging_active = (
-        config.bagging_freq > 0
-        and (config.bagging_fraction < 1.0 or need_balanced)
-    ) or config.boosting == "rf"
+    bagging_active = bagging_is_active(config)
     qs = query_sizes if config.bagging_by_query else None
     if config.bagging_by_query and bagging_active:
         # by-query sampling can't be combined with row-level strategies:

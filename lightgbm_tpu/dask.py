@@ -1,35 +1,276 @@
-"""Dask interface placeholder (reference: python-package/lightgbm/dask.py).
+"""Dask interface — cluster front-end over the multi-controller launcher.
 
-dask is not installed in this environment; the TPU-native road to
-multi-machine training is a jax.distributed multi-controller run
-(``lightgbm_tpu.parallel.launcher`` / ``init_distributed``) — meshes span all
-processes' devices and the grower's psum rides ICI/DCN. These classes exist
-for API parity and raise with that guidance, mirroring the reference's
-behavior when dask is absent.
-"""
+Reference analog: python-package/lightgbm/dask.py (worker discovery via
+``client.scheduler_info()``, per-worker ``_train_part`` tasks, a
+``machines`` string wiring the workers into one training cluster, model
+collected from the first worker).
+
+The TPU-native transport differs: instead of the reference's socket
+Allreduce ring, every worker process joins a ``jax.distributed``
+multi-controller cluster (``lightgbm_tpu.parallel.init_distributed``) and
+trains with ``pre_partition`` process-local data — collectives ride XLA
+(ICI/DCN).  The dask client is only the *scheduler*: it places one
+``_train_part`` task per worker and ships each worker its data partition.
+
+dask itself is optional and duck-typed: any object with
+``scheduler_info()`` and ``submit(fn, *args, workers=[addr])`` returning
+futures with ``.result()`` works (the test suite drives the whole flow
+with a mock client whose "workers" are local subprocesses)."""
 
 from __future__ import annotations
 
-_MSG = (
-    "dask is not installed; for distributed training use "
-    "lightgbm_tpu.parallel.init_distributed (jax.distributed multi-controller) "
-    "with tree_learner='data', or the process launcher "
-    "`python -m lightgbm_tpu.parallel.launcher -n N script.py`"
-)
+import socket
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .sklearn import LGBMClassifier, LGBMRanker, LGBMRegressor
 
 
-class _DaskUnavailable:
-    def __init__(self, *args, **kwargs):
-        raise ImportError(_MSG)
+def _worker_addresses(client) -> List[str]:
+    """Sorted worker addresses from the scheduler (reference dask.py
+    ``_machines_to_worker_map`` input)."""
+    info = client.scheduler_info()
+    workers = info.get("workers", {})
+    if not workers:
+        raise ValueError("no dask workers available to train on")
+    return sorted(workers)
 
 
-class DaskLGBMClassifier(_DaskUnavailable):
-    pass
+def _host_of(address: str) -> str:
+    """'tcp://10.0.0.5:8786' -> '10.0.0.5'."""
+    hp = address.rsplit("://", 1)[-1]
+    return hp.rsplit(":", 1)[0] if ":" in hp else hp
 
 
-class DaskLGBMRegressor(_DaskUnavailable):
-    pass
+def _split_rows(arr, n_parts: int, boundaries: Optional[np.ndarray] = None):
+    """Split rows into n_parts contiguous chunks; with ``boundaries``
+    (cumulative query sizes) the cuts snap to query boundaries so no query
+    is split across workers."""
+    n = arr.shape[0] if hasattr(arr, "shape") else len(arr)
+    if boundaries is None:
+        cuts = [(n * i) // n_parts for i in range(1, n_parts)]
+    else:
+        cuts = []
+        for i in range(1, n_parts):
+            target = (n * i) // n_parts
+            j = int(np.searchsorted(boundaries, target, side="left"))
+            cuts.append(int(boundaries[min(j, len(boundaries) - 1)]))
+    out, prev = [], 0
+    for c in list(cuts) + [n]:
+        out.append(arr[prev:c])
+        prev = c
+    return out
 
 
-class DaskLGBMRanker(_DaskUnavailable):
-    pass
+def _partition_data(X, y, sample_weight, group, n_workers: int):
+    """Per-worker part dicts.  Plain array-likes are split contiguously
+    (group-aware for ranking).  Real dask collections would arrive already
+    partitioned (reference dask.py ``_train`` follows the collection's own
+    partitioning); without dask in this environment they are rejected with
+    guidance rather than silently gathered."""
+    if hasattr(X, "to_delayed") or hasattr(X, "dask"):
+        raise NotImplementedError(
+            "dask-collection inputs need dask installed at runtime; pass "
+            "numpy/scipy arrays instead (they are split contiguously per "
+            "worker)"
+        )
+    boundaries = None
+    if group is not None:
+        boundaries = np.cumsum(np.asarray(group, np.int64))
+        n_rows = int(boundaries[-1])
+        # multi-process ranking requires EQUAL per-worker row counts
+        # (queries cannot be weight-0 padded, gbdt._init_train) — the even
+        # cut must land exactly on query boundaries
+        bset = set(int(b) for b in boundaries)
+        bad = [
+            (n_rows * i) // n_workers
+            for i in range(1, n_workers)
+            if (n_rows * i) % n_workers or (n_rows * i) // n_workers not in bset
+        ]
+        if bad:
+            raise ValueError(
+                "distributed ranking needs query sizes that split the rows "
+                f"EQUALLY across {n_workers} workers (queries are never "
+                f"split and cannot be padded); no query boundary at row(s) "
+                f"{bad} — rearrange groups or change the worker count"
+            )
+    xs = _split_rows(np.asarray(X), n_workers, boundaries)
+    ys = _split_rows(np.asarray(y), n_workers, boundaries)
+    ws = (
+        _split_rows(np.asarray(sample_weight), n_workers, boundaries)
+        if sample_weight is not None
+        else [None] * n_workers
+    )
+    if group is not None:
+        g = np.asarray(group, np.int64)
+        bounds = np.concatenate([[0], np.cumsum(g)])
+        row_cuts = np.concatenate([[0], np.cumsum([x.shape[0] for x in xs])])
+        gs = []
+        for i in range(n_workers):
+            lo = int(np.searchsorted(bounds, row_cuts[i]))
+            hi = int(np.searchsorted(bounds, row_cuts[i + 1]))
+            gs.append(g[lo:hi])
+    else:
+        gs = [None] * n_workers
+    return [
+        {"data": xs[i], "label": ys[i], "weight": ws[i], "group": gs[i]}
+        for i in range(n_workers)
+    ]
+
+
+def _train_part(
+    params: Dict[str, Any],
+    part: Dict[str, Any],
+    process_id: int,
+    num_processes: int,
+    coordinator: str,
+    num_boost_round: int,
+) -> Optional[str]:
+    """Runs ON a worker: join the jax.distributed cluster, train on the
+    local partition (``pre_partition`` process-local feeding), return the
+    model text from process 0 only (reference dask.py ``_train_part``
+    returns the booster on one worker)."""
+    from .dataset import Dataset
+    from .engine import train as _train
+    from .parallel import init_distributed
+
+    if num_processes > 1:
+        init_distributed(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    ds_params = dict(params)
+    ds_params["pre_partition"] = num_processes > 1
+    ds = Dataset(
+        part["data"],
+        label=part["label"],
+        weight=part.get("weight"),
+        group=part.get("group"),
+        params=ds_params,
+    )
+    booster = _train(ds_params, ds, num_boost_round=num_boost_round)
+    if num_processes > 1:
+        import jax
+
+        if jax.process_index() != 0:
+            return None
+    return booster.model_to_string()
+
+
+class _DaskLGBMModel:
+    """Mixin implementing the distributed fit over a dask-like client."""
+
+    def _resolve_client(self):
+        client = getattr(self, "client", None) or self._other_params.get(
+            "client"
+        )
+        if client is None:
+            try:
+                from distributed import default_client  # type: ignore
+
+                client = default_client()
+            except Exception:
+                raise ValueError(
+                    "no dask client: pass client=... to the estimator"
+                )
+        return client
+
+    def _dask_fit(self, X, y, sample_weight=None, group=None, **kwargs):
+        if kwargs:
+            raise NotImplementedError(
+                "DaskLGBM fit does not support these arguments yet: "
+                + ", ".join(sorted(kwargs))
+            )
+        if isinstance(self, LGBMClassifier):
+            # mirror LGBMClassifier.fit label handling (classes recorded,
+            # labels encoded to 0..K-1, num_class set for multiclass)
+            y = np.asarray(y)
+            self._classes = np.unique(y)
+            self._n_classes = len(self._classes)
+            y = np.searchsorted(self._classes, y).astype(np.float64)
+            if self.objective is None and self._n_classes > 2:
+                self._other_params.setdefault("num_class", self._n_classes)
+        client = self._resolve_client()
+        workers = _worker_addresses(client)
+        n = len(workers)
+        parts = _partition_data(X, y, sample_weight, group, n)
+        params = {
+            k: v
+            for k, v in self._lgb_params().items()
+            if k not in ("client", "local_listen_port")
+        }
+        params.setdefault("tree_learner", "data")
+        # reference dask.py uses local_listen_port (default 12400) as the
+        # base of its machines string; here it is the jax.distributed
+        # coordinator port on the first worker's host
+        port = int(self._other_params.get("local_listen_port", 12400))
+        host = _host_of(workers[0])
+        if host in ("127.0.0.1", "localhost", ""):
+            host = "127.0.0.1"
+        coordinator = f"{host}:{port}"
+        futures = [
+            client.submit(
+                _train_part,
+                params,
+                parts[i],
+                i,
+                n,
+                coordinator,
+                self.n_estimators,
+                workers=[w],
+            )
+            for i, w in enumerate(workers)
+        ]
+        results = [f.result() for f in futures]
+        model_str = next(s for s in results if s)
+        from .boosting.gbdt import Booster
+
+        self._Booster = Booster(model_str=model_str)
+        return self
+
+    def to_local(self):
+        """A plain (non-dask) estimator carrying the trained booster
+        (reference dask.py ``to_local``)."""
+        cls = {
+            DaskLGBMRegressor: LGBMRegressor,
+            DaskLGBMClassifier: LGBMClassifier,
+            DaskLGBMRanker: LGBMRanker,
+        }[type(self)]
+        local = cls(**self.get_params())
+        local._Booster = self._Booster
+        local._classes = getattr(self, "_classes", None)
+        local._n_classes = getattr(self, "_n_classes", -1)
+        return local
+
+
+class DaskLGBMRegressor(_DaskLGBMModel, LGBMRegressor):
+    def __init__(self, client=None, **kwargs):
+        self.client = client
+        super().__init__(**kwargs)
+
+    def fit(self, X, y, sample_weight=None, **kwargs):
+        return self._dask_fit(X, y, sample_weight=sample_weight, **kwargs)
+
+
+class DaskLGBMClassifier(_DaskLGBMModel, LGBMClassifier):
+    def __init__(self, client=None, **kwargs):
+        self.client = client
+        super().__init__(**kwargs)
+
+    def fit(self, X, y, sample_weight=None, **kwargs):
+        return self._dask_fit(X, y, sample_weight=sample_weight, **kwargs)
+
+
+class DaskLGBMRanker(_DaskLGBMModel, LGBMRanker):
+    def __init__(self, client=None, **kwargs):
+        self.client = client
+        super().__init__(**kwargs)
+
+    def fit(self, X, y, sample_weight=None, group=None, **kwargs):
+        if group is None:
+            raise ValueError("DaskLGBMRanker.fit requires group=")
+        return self._dask_fit(
+            X, y, sample_weight=sample_weight, group=group, **kwargs
+        )

@@ -65,7 +65,11 @@ _SLOW_TESTS = {
     "test_booster.py::test_rf",
     "test_booster.py::test_sklearn_classifier",
     "test_monotone.py::test_intermediate_not_worse_than_basic",
-    "test_monotone.py::test_advanced_falls_back_to_intermediate",
+    "test_monotone.py::test_advanced_not_worse_than_intermediate",
+    "test_monotone.py::test_advanced_monotone_with_path_smooth",
+    "test_monotone.py::test_advanced_monotone_with_categoricals",
+    "test_dask.py::test_dask_regressor_two_workers_matches_single_process",
+    "test_dask.py::test_dask_ranker_groups_not_split",
     "test_categorical.py::test_e2e_categorical_nan_goes_right",
     "test_categorical.py::test_e2e_categorical_roundtrip_and_consistency",
     "test_categorical.py::test_e2e_categorical_beats_frequency_rank",

@@ -1,0 +1,230 @@
+"""Dask adapter: worker discovery -> per-worker _train_part -> model from
+worker 0 (reference python-package/lightgbm/dask.py), driven end-to-end
+with a MOCK client whose workers are real subprocesses joining one
+jax.distributed CPU cluster (dask itself is not installed here)."""
+
+import os
+import pickle
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.dask import (
+    DaskLGBMClassifier,
+    DaskLGBMRanker,
+    DaskLGBMRegressor,
+    _partition_data,
+    _split_rows,
+)
+
+REPO_ROOT = str(Path(__file__).resolve().parents[1])
+
+_RUNNER = textwrap.dedent(
+    """
+    import os, sys, pickle, importlib
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    mod, name, args = pickle.load(open(sys.argv[1], "rb"))
+    fn = getattr(importlib.import_module(mod), name)
+    res = fn(*args)
+    pickle.dump(res, open(sys.argv[2], "wb"))
+    """
+).format(repo=REPO_ROOT)
+
+
+class MockFuture:
+    def __init__(self, proc, out_path):
+        self._proc = proc
+        self._out = out_path
+
+    def result(self, timeout=300):
+        rc = self._proc.wait(timeout=timeout)
+        if rc != 0:
+            out, err = self._proc.communicate()
+            raise RuntimeError(f"worker failed rc={rc}:\n{out}\n{err}")
+        with open(self._out, "rb") as f:
+            return pickle.load(f)
+
+
+class MockClient:
+    """Duck-typed dask client: scheduler_info + submit; each submitted task
+    runs in its own subprocess (a real separate jax process)."""
+
+    def __init__(self, n_workers: int, tmpdir: Path):
+        self._addrs = [
+            f"tcp://127.0.0.1:{41000 + i}" for i in range(n_workers)
+        ]
+        self._tmp = tmpdir
+        self._n = 0
+
+    def scheduler_info(self):
+        return {"workers": {a: {} for a in self._addrs}}
+
+    def submit(self, fn, *args, workers=None, **kw):
+        self._n += 1
+        inp = self._tmp / f"in_{self._n}.pkl"
+        out = self._tmp / f"out_{self._n}.pkl"
+        with open(inp, "wb") as f:
+            pickle.dump((fn.__module__, fn.__qualname__, args), f)
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _RUNNER, str(inp), str(out)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=REPO_ROOT,
+        )
+        return MockFuture(proc, str(out))
+
+
+def test_split_rows_group_aware():
+    g = np.array([5, 5, 10, 5, 5], np.int64)
+    boundaries = np.cumsum(g)
+    X = np.arange(30)[:, None]
+    parts = _split_rows(X, 2, boundaries)
+    sizes = [p.shape[0] for p in parts]
+    assert sum(sizes) == 30
+    # cut lands exactly on a query boundary
+    assert sizes[0] in (10, 15, 20)
+    # partitioning requires an EQUAL split on a query boundary: 15/15 exists
+    g2 = np.array([5, 10, 10, 5], np.int64)
+    pd = _partition_data(X, np.arange(30), None, g2, 2)
+    assert sum(int(p["group"].sum()) for p in pd) == 30
+    for p in pd:
+        assert int(p["group"].sum()) == p["data"].shape[0] == 15
+
+
+def test_partition_data_even_split_no_group():
+    X = np.arange(40).reshape(20, 2)
+    parts = _partition_data(X, np.arange(20), np.ones(20), None, 3)
+    assert [p["data"].shape[0] for p in parts] == [6, 7, 7]
+    assert all(p["group"] is None for p in parts)
+    np.testing.assert_array_equal(
+        np.concatenate([p["data"] for p in parts]), X
+    )
+
+
+def test_no_workers_raises(tmp_path):
+    client = MockClient(0, tmp_path)
+    est = DaskLGBMRegressor(client=client, n_estimators=2)
+    with pytest.raises(ValueError, match="no dask workers"):
+        est.fit(np.zeros((10, 2)), np.zeros(10))
+
+
+def test_dask_regressor_two_workers_matches_single_process(tmp_path):
+    """2 mock workers train one jax.distributed cluster.  With
+    integer-valued features (partition-invariant binning, same setup as the
+    launcher pre_partition test) the tree STRUCTURE must match a
+    single-process run exactly and leaf values to f32 reduction-order
+    tolerance."""
+    rng = np.random.default_rng(5)
+    n = 3000
+    X = rng.integers(0, 63, size=(n, 5)).astype(np.float64)
+    y = X[:, 0] * 0.2 + np.sin(X[:, 1]) + rng.normal(scale=0.3, size=n)
+    client = MockClient(2, tmp_path)
+    est = DaskLGBMRegressor(
+        client=client,
+        n_estimators=8,
+        num_leaves=15,
+        max_bin=63,
+        # pid-derived port: a previous killed run's orphaned workers must
+        # not collide with this cluster's coordinator
+        local_listen_port=20000 + (os.getpid() % 10000),
+    )
+    est.fit(X, y)
+    # local single-process baseline with identical params
+    base = lgb.train(
+        {
+            **{k: v for k, v in est._lgb_params().items()},
+            "tree_learner": "data",
+        },
+        lgb.Dataset(X, y),
+        num_boost_round=8,
+    )
+
+    def _structure_and_values(ms):
+        struct, vals = [], []
+        for line in ms.splitlines():
+            if line.startswith(("split_feature=", "threshold=", "decision_type=")):
+                struct.append(line)
+            elif line.startswith("leaf_value="):
+                vals.append([float(v) for v in line.split("=", 1)[1].split()])
+        return struct, vals
+
+    s_got, v_got = _structure_and_values(est._Booster.model_to_string())
+    s_exp, v_exp = _structure_and_values(base.model_to_string())
+    assert s_got == s_exp
+    for a, b in zip(v_got, v_exp):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+    p = est.predict(X)
+    # y's std is ~3.6 (X0*0.2 spans 0..12.6); 8 rounds at lr 0.1 shrink it
+    assert np.sqrt(np.mean((p - y) ** 2)) < 0.75 * np.std(y)
+    # to_local keeps the booster
+    local = est.to_local()
+    assert np.array_equal(local.predict(X), p)
+
+
+def test_dask_ranker_groups_not_split(tmp_path):
+    rng = np.random.default_rng(7)
+    n = 1200
+    X = rng.normal(size=(n, 4))
+    y = rng.integers(0, 4, n).astype(float)
+    grp = np.full(60, 20)
+    client = MockClient(2, tmp_path)
+    est = DaskLGBMRanker(
+        client=client,
+        n_estimators=5,
+        num_leaves=15,
+        local_listen_port=31000 + (os.getpid() % 9000),
+    )
+    est.fit(X, y, group=grp)
+    assert est._Booster.num_trees() == 5
+    assert est.predict(X).shape == (n,)
+
+
+def test_ranker_uneven_groups_rejected(tmp_path):
+    g = np.array([7, 5, 9], np.int64)  # 21 rows, no boundary at 10/11
+    client = MockClient(2, tmp_path)
+    est = DaskLGBMRanker(client=client, n_estimators=2)
+    with pytest.raises(ValueError, match="EQUALLY"):
+        est.fit(np.zeros((21, 2)), np.zeros(21), group=g)
+
+
+def test_fit_kwargs_rejected(tmp_path):
+    client = MockClient(2, tmp_path)
+    est = DaskLGBMRegressor(client=client, n_estimators=2)
+    with pytest.raises(NotImplementedError, match="eval_set"):
+        est.fit(np.zeros((10, 2)), np.zeros(10), eval_set=[(None, None)])
+
+
+def test_dask_classifier_multiclass(tmp_path):
+    """Labels are encoded and num_class shipped (mirrors LGBMClassifier.fit);
+    3-class data must train a multiclass objective, not binary."""
+    rng = np.random.default_rng(11)
+    n = 1200
+    X = rng.normal(size=(n, 4))
+    y = np.digitize(X[:, 0], [-0.4, 0.4]) * 10.0  # classes {0, 10, 20}
+    client = MockClient(2, tmp_path)
+    est = DaskLGBMClassifier(
+        client=client,
+        n_estimators=5,
+        num_leaves=15,
+        local_listen_port=22000 + (os.getpid() % 9000),
+    )
+    est.fit(X, y)
+    assert est.n_classes_ == 3
+    proba = est.predict_proba(X)
+    assert proba.shape == (n, 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+    pred = est.predict(X)
+    assert set(np.unique(pred)) <= {0.0, 10.0, 20.0}
+    assert (pred == y).mean() > 0.8
