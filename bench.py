@@ -36,9 +36,48 @@ def _probe_accelerator(timeout_s: int = 180) -> bool:
         return False
 
 
+def _make_data(n_rows: int, n_features: int):
+    rng = np.random.default_rng(42)
+    X = rng.normal(size=(n_rows, n_features)).astype(np.float32)
+    w = rng.normal(size=n_features)
+    logits = X @ w * 0.5 + rng.normal(scale=1.0, size=n_rows)
+    y = (logits > 0).astype(np.float64)
+    return X, y
+
+
+_PARAMS = {
+    "objective": "binary",
+    "num_leaves": 255,
+    "max_bin": 255,
+    "learning_rate": 0.1,
+    "min_data_in_leaf": 100,
+    "verbosity": -1,
+    "metric": "none",
+}
+
+
+def _train_bench(X, y, timed_iters: int, warmup_iters: int = 2):
+    """(iters/sec, booster) for the Higgs-shaped workload on these rows."""
+    import jax
+
+    import lightgbm_tpu as lgb
+
+    dtrain = lgb.Dataset(X, y, params=_PARAMS)
+    booster = lgb.Booster(_PARAMS, dtrain)
+    for _ in range(warmup_iters):
+        booster.update()
+    jax.block_until_ready(booster._score)
+    t0 = time.perf_counter()
+    for _ in range(timed_iters):
+        booster.update()
+    jax.block_until_ready(booster._score)
+    return timed_iters / (time.perf_counter() - t0), booster
+
+
 def main() -> None:
     platform_note = None
-    if not _probe_accelerator():
+    on_accel = _probe_accelerator()
+    if not on_accel:
         # accelerator unreachable (e.g. TPU tunnel down): record an honest
         # CPU number rather than hanging the whole bench run
         os.environ["JAX_PLATFORMS"] = "cpu"
@@ -49,46 +88,25 @@ def main() -> None:
         except Exception:
             pass
         platform_note = "cpu-fallback (accelerator unreachable)"
-    n_rows = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    # the headline target is defined at Higgs scale (10.5M rows,
+    # docs/Experiments.rst:108) — measure THAT on a real accelerator, plus
+    # a secondary 1M point for round-over-round comparability; the CPU
+    # fallback stays small so a tunnel outage doesn't stall the driver
+    n_rows = int(
+        os.environ.get("BENCH_ROWS", 10_500_000 if on_accel else 1_000_000)
+    )
     n_features = 28
-    num_leaves = 255
-    warmup_iters = 2
     timed_iters = int(os.environ.get("BENCH_ITERS", 10))
 
-    rng = np.random.default_rng(42)
-    X = rng.normal(size=(n_rows, n_features)).astype(np.float32)
-    w = rng.normal(size=n_features)
-    logits = X @ w * 0.5 + rng.normal(scale=1.0, size=n_rows)
-    y = (logits > 0).astype(np.float64)
-
-    import lightgbm_tpu as lgb
-
-    params = {
-        "objective": "binary",
-        "num_leaves": num_leaves,
-        "max_bin": 255,
-        "learning_rate": 0.1,
-        "min_data_in_leaf": 100,
-        "verbosity": -1,
-        "metric": "none",
-    }
-    dtrain = lgb.Dataset(X, y, params=params)
-    booster = lgb.Booster(params, dtrain)
-
-    for _ in range(warmup_iters):
-        booster.update()
-    import jax
-
-    jax.block_until_ready(booster._score)
-
-    t0 = time.perf_counter()
-    for _ in range(timed_iters):
-        booster.update()
-    jax.block_until_ready(booster._score)
-    dt = time.perf_counter() - t0
-
-    iters_per_sec = timed_iters / dt
+    X, y = _make_data(n_rows, n_features)
+    iters_per_sec, booster = _train_bench(X, y, timed_iters)
     baseline = 3.8  # reference CPU iters/sec on Higgs (BASELINE.md)
+
+    secondary_rows = int(os.environ.get("BENCH_ROWS_SECONDARY", 1_000_000))
+    iters_per_sec_secondary = None
+    if on_accel and secondary_rows and secondary_rows < n_rows:
+        Xs, ys = X[:secondary_rows], y[:secondary_rows]
+        iters_per_sec_secondary, _ = _train_bench(Xs, ys, timed_iters)
 
     # batch-inference throughput. The fork's 84k preds/s (original.md) was
     # measured on a 376-tree model; replicate the trained trees to the same
@@ -112,23 +130,24 @@ def main() -> None:
 
     import jax as _jax
 
-    print(
-        json.dumps(
-            {
-                "metric": "higgs_like_1m_boosting_iters_per_sec",
-                "value": round(iters_per_sec, 4),
-                "unit": "iters/sec",
-                "vs_baseline": round(iters_per_sec / baseline, 4),
-                "platform": platform_note or _jax.default_backend(),
-                "rows": n_rows,
-                "baseline_rows": 10_500_000,
-                "note": "vs_baseline divides by the reference CPU's 3.8 iters/s on 10.5M rows (BASELINE.md); this run uses 'rows' rows, so per-row throughput differs by rows/baseline_rows",
-                "preds_per_sec": round(preds_per_sec),
-                "pred_rows": pred_rows,
-                "preds_vs_fork_84k": round(preds_per_sec / 84000.0, 2),
-            }
+    out = {
+        "metric": f"higgs_like_{n_rows}_rows_boosting_iters_per_sec",
+        "value": round(iters_per_sec, 4),
+        "unit": "iters/sec",
+        "vs_baseline": round(iters_per_sec / baseline, 4),
+        "platform": platform_note or _jax.default_backend(),
+        "rows": n_rows,
+        "baseline_rows": 10_500_000,
+        "note": "vs_baseline divides by the reference CPU's 3.8 iters/s on 10.5M rows (BASELINE.md); when 'rows' != baseline_rows the per-row throughput differs by rows/baseline_rows",
+        "preds_per_sec": round(preds_per_sec),
+        "pred_rows": pred_rows,
+        "preds_vs_fork_84k": round(preds_per_sec / 84000.0, 2),
+    }
+    if iters_per_sec_secondary is not None:
+        out[f"iters_per_sec_{secondary_rows}_rows"] = round(
+            iters_per_sec_secondary, 4
         )
-    )
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

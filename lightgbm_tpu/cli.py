@@ -125,10 +125,22 @@ def run_refit(params: Dict[str, Any], cfg: Config) -> None:
 
 
 def run_convert_model(params: Dict[str, Any], cfg: Config) -> None:
+    """task=convert_model: JSON dump, or standalone if-else C++ with
+    convert_model_language=cpp (reference: GBDT::SaveModelToIfElse,
+    src/boosting/gbdt_model_text.cpp:289)."""
     model_path = params.get("input_model")
     if not model_path:
         raise SystemExit("task=convert_model requires input_model=<model file>")
     booster = Booster(model_file=model_path)
+    lang = str(params.get("convert_model_language", "")).lower()
+    if lang in ("cpp", "c++"):
+        from .codegen import model_to_cpp
+
+        out = params.get("convert_model", "gbdt_prediction.cpp")
+        with open(out, "w") as fp:
+            fp.write(model_to_cpp(booster))
+        print(f"Model converted to C++ at {out}")
+        return
     import json
 
     out = params.get("convert_model", "gbdt_prediction.json")

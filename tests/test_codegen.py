@@ -1,0 +1,165 @@
+"""convert_model C++ codegen: the generated standalone predictor must match
+booster.predict on the same rows (reference: SaveModelToIfElse,
+src/boosting/gbdt_model_text.cpp:289)."""
+
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.codegen import model_to_cpp
+
+GXX = shutil.which("g++")
+
+
+def _compile_and_predict(booster, X, tmp_path):
+    src = tmp_path / "model.cpp"
+    src.write_text(model_to_cpp(booster))
+    exe = tmp_path / "model_bin"
+    subprocess.run(
+        [GXX, "-O1", "-DLGBM_CODEGEN_MAIN", "-o", str(exe), str(src)],
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+    rows = "\n".join(" ".join(repr(float(v)) for v in r) for r in X)
+    r = subprocess.run(
+        [str(exe)], input=rows, capture_output=True, text=True, check=True
+    )
+    return np.array(
+        [[float(v) for v in line.split()] for line in r.stdout.splitlines()]
+    )
+
+
+needs_gxx = pytest.mark.skipif(GXX is None, reason="g++ not available")
+
+
+@needs_gxx
+def test_cpp_codegen_regression_with_nans(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 1500
+    X = rng.normal(size=(n, 6))
+    X[::7, 2] = np.nan
+    y = X[:, 0] + np.where(np.isnan(X[:, 2]), 1.5, X[:, 2]) + rng.normal(
+        scale=0.1, size=n
+    )
+    b = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1},
+        lgb.Dataset(X, y),
+        15,
+    )
+    got = _compile_and_predict(b, X[:200], tmp_path)[:, 0]
+    exp = b.predict(X[:200])
+    # the booster's device walker accumulates leaf values in f32; the
+    # generated C++ sums in f64 — agreement is to f32 rounding
+    np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-7)
+
+
+@needs_gxx
+def test_cpp_codegen_binary_sigmoid(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 1200
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    b = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(X, y),
+        10,
+    )
+    got = _compile_and_predict(b, X[:150], tmp_path)[:, 0]
+    exp = b.predict(X[:150])
+    np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-7)
+
+
+@needs_gxx
+def test_cpp_codegen_multiclass_softmax(tmp_path):
+    rng = np.random.default_rng(2)
+    n = 1500
+    X = rng.normal(size=(n, 5))
+    y = np.digitize(X[:, 1], [-0.4, 0.4]).astype(float)
+    b = lgb.train(
+        {
+            "objective": "multiclass",
+            "num_class": 3,
+            "num_leaves": 15,
+            "verbosity": -1,
+        },
+        lgb.Dataset(X, y),
+        6,
+    )
+    got = _compile_and_predict(b, X[:150], tmp_path)
+    exp = b.predict(X[:150])
+    assert got.shape == exp.shape
+    np.testing.assert_allclose(got, exp, rtol=1e-6, atol=1e-9)
+
+
+@needs_gxx
+def test_cpp_codegen_categorical(tmp_path):
+    rng = np.random.default_rng(3)
+    n = 2000
+    X = np.column_stack(
+        [rng.normal(size=n), rng.integers(0, 8, n).astype(float)]
+    )
+    y = X[:, 0] + (np.isin(X[:, 1], [2, 5])) * 2.0 + rng.normal(
+        scale=0.1, size=n
+    )
+    b = lgb.train(
+        {
+            "objective": "regression",
+            "num_leaves": 15,
+            "verbosity": -1,
+            "categorical_feature": [1],
+        },
+        lgb.Dataset(X, y),
+        10,
+    )
+    Xq = X[:200].copy()
+    Xq[0, 1] = 11.0  # unseen category -> routes right, like predict
+    got = _compile_and_predict(b, Xq, tmp_path)[:, 0]
+    exp = b.predict(Xq)
+    np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-7)
+
+
+@needs_gxx
+def test_cli_convert_model_cpp(tmp_path):
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(500, 3))
+    y = X[:, 0] + rng.normal(scale=0.1, size=500)
+    b = lgb.train(
+        {"objective": "regression", "verbosity": -1}, lgb.Dataset(X, y), 5
+    )
+    model = tmp_path / "m.txt"
+    b.save_model(str(model))
+    out = tmp_path / "m.cpp"
+    from lightgbm_tpu.cli import main
+
+    main(
+        [
+            "task=convert_model",
+            f"input_model={model}",
+            "convert_model_language=cpp",
+            f"convert_model={out}",
+        ]
+    )
+    text = out.read_text()
+    assert "PredictTree0" in text and "void Predict(" in text
+
+
+@needs_gxx
+def test_cpp_codegen_xentlambda_softplus(tmp_path):
+    rng = np.random.default_rng(5)
+    n = 1000
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] > 0).astype(float)
+    b = lgb.train(
+        {"objective": "cross_entropy_lambda", "num_leaves": 15,
+         "verbosity": -1},
+        lgb.Dataset(X, y),
+        8,
+    )
+    got = _compile_and_predict(b, X[:100], tmp_path)[:, 0]
+    exp = b.predict(X[:100])
+    np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-7)
