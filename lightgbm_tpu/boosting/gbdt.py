@@ -939,15 +939,17 @@ class Booster:
         # configs must also fit the histogram kernel's VMEM scratch
         from ..ops.pallas.seg import seg_vmem_ok
 
+        # feature-parallel seg: each shard packs only its feature slice, so
+        # the lane/VMEM budgets apply to the PER-SHARD feature count
+        n_eff = n_used // self._featpar if self._featpar else n_used
         seg_fcap = 242 if self._max_bin_padded <= 256 else 121
         seg_fits = seg_vmem_ok(
-            max(n_used, 1), self._max_bin_padded, getattr(self, "_has_cat", False)
+            max(n_eff, 1), self._max_bin_padded, getattr(self, "_has_cat", False)
         )
         seg_ok = (
-            not self._featpar  # feature-parallel partitions via leaf-id
-            and self._max_bin_padded <= 65536
+            self._max_bin_padded <= 65536
             and seg_fits
-            and 0 < n_used <= seg_fcap
+            and 0 < n_eff <= seg_fcap
             # the seg path has its own kernels: the default bf16 three-term
             # one and (r3) an int8 grid variant for quantized training;
             # other explicit kernel choices keep the ordered path
@@ -991,7 +993,8 @@ class Booster:
         hist_mode = str(
             self.params.get(
                 "hist_mode",
-                "gather" if self._featpar else ("seg" if seg_ok else "ordered"),
+                "seg" if seg_ok
+                else ("gather" if self._featpar else "ordered"),
             )
         )
         return GrowerParams(
@@ -1824,33 +1827,67 @@ class Booster:
                     self.train_set.bin_mappers, self.train_set.used_features
                 )
             dbt = self._stack_cache[("devbin",)]
-        if dbt is not None:
-            xs = np.ascontiguousarray(
-                X[:, self.train_set.used_features], dtype=np.float32
+
+        def _walk(packed):
+            return forest_walk(
+                packed,
+                tables,
+                n_trees=tables.n_trees,
+                max_depth=tables.max_depth,
+                k=k,
             )
-            mat_dev, suspect = bin_numeric_device(jnp.asarray(xs), *dbt)
-            # device binning compares in f32; rows with a value within a few
-            # ulps of a bin boundary are re-binned with the exact f64 host
-            # path so predictions match it bit-for-bit (ADVICE r2; the
+
+        if dbt is None:
+            out = _walk(pad_bins_for_walk(self._bin_input_host(X)))
+            return unpack_walk_scores(np.asarray(out), n, k).astype(np.float64)
+
+        # device binning + chunked feed: fixed-size chunks keep ONE compiled
+        # (bin, pack, walk) pipeline, and dispatching chunk i+1's host slice
+        # prep while chunk i computes overlaps transfer with the walk (the
+        # ROUND_NOTES r3 double-buffering plan; jax's async dispatch is the
+        # buffer)
+        CHUNK = 1 << 20
+        used = self.train_set.used_features
+
+        def _bin_chunk(xs_np, x_orig, rows):
+            """[CHUNK, F] f32 used-feature slice -> exact device bins.
+
+            ``x_orig`` is the ORIGINAL full-width f64 rows of this chunk —
+            the suspect re-bin must run the exact host path on the
+            unrounded values (and _bin_input_host indexes by global
+            feature id)."""
+            mat_dev, suspect = bin_numeric_device(jnp.asarray(xs_np), *dbt)
+            # device binning compares in f32; rows with a value within a
+            # few ulps of a bin boundary are re-binned with the exact f64
+            # host path so predictions match it bit-for-bit (ADVICE r2; the
             # boundary test is conservative, suspects are typically none)
-            sidx = np.flatnonzero(np.asarray(suspect))
+            sidx = np.flatnonzero(np.asarray(suspect[:rows]))
             if len(sidx):
-                patch = self._bin_input_host(X[sidx])
+                patch = self._bin_input_host(x_orig[sidx])
                 mat_dev = mat_dev.at[jnp.asarray(sidx)].set(
                     jnp.asarray(patch.astype(np.int32))
                 )
+            return mat_dev
+
+        if n <= CHUNK:
+            xs = np.ascontiguousarray(X[:, used], dtype=np.float32)
             n_pad = (n + ROW_TILE - 1) // ROW_TILE * ROW_TILE
-            packed = _pack_bins_device(mat_dev, n_pad)
-        else:
-            packed = pad_bins_for_walk(self._bin_input_host(X))
-        out = forest_walk(
-            packed,
-            tables,
-            n_trees=tables.n_trees,
-            max_depth=tables.max_depth,
-            k=k,
-        )
-        return unpack_walk_scores(np.asarray(out), n, k).astype(np.float64)
+            out = _walk(_pack_bins_device(_bin_chunk(xs, X, n), n_pad))
+            return unpack_walk_scores(np.asarray(out), n, k).astype(np.float64)
+
+        outs = []
+        for lo in range(0, n, CHUNK):
+            rows = min(CHUNK, n - lo)
+            xo = X[lo : lo + rows]
+            xs = np.zeros((CHUNK, len(used)), np.float32)
+            xs[:rows] = xo[:, used]
+            out = _walk(_pack_bins_device(_bin_chunk(xs, xo, rows), CHUNK))
+            outs.append((out, rows))  # keep device arrays in flight
+        parts = [
+            unpack_walk_scores(np.asarray(o), rows, k)
+            for o, rows in outs
+        ]
+        return np.concatenate(parts, axis=0).astype(np.float64)
 
     def _early_stop_type(self, k: int) -> str:
         """Reference c_api chooses the margin rule from the objective

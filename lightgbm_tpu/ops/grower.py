@@ -584,10 +584,10 @@ def grow_tree(
         p.feature_shard > 1 and p.axis_name is not None and f > 0
     )
     if use_featpar:
-        if p.hist_mode not in ("gather", "full"):
+        if p.hist_mode not in ("gather", "full", "seg"):
             raise ValueError(
-                "feature-parallel training needs hist_mode='gather' or "
-                "'full' (full columns stay addressable for the partition)"
+                "feature-parallel training needs hist_mode='gather', 'full' "
+                "or 'seg' (ordered mode keeps no per-shard feature slices)"
             )
         if f % p.feature_shard:
             raise ValueError(
@@ -689,18 +689,23 @@ def grow_tree(
                 f"hist_mode='seg' stores bins in u16 planes: max_bin "
                 f"(padded to {B}) must be <= {MAX_WIDE_BIN}"
             )
+        # feature-parallel seg: each shard packs ONLY its feature slice's bin
+        # planes (rows replicated, histogram work /D); the winner feature's
+        # go-left bits come from the owning shard via psum at partition time
+        f_seg = f_loc if use_featpar else f
         if jax.default_backend() == "tpu":
             from .pallas.seg import seg_vmem_ok
 
-            if not seg_vmem_ok(f, B, use_cat):
+            if not seg_vmem_ok(f_seg, B, use_cat):
                 raise ValueError(
-                    f"hist_mode='seg' at {f} features x max_bin {B} exceeds "
-                    "the histogram kernel's VMEM scratch budget — use "
-                    "hist_mode='ordered' or a smaller max_bin"
+                    f"hist_mode='seg' at {f_seg} features x max_bin {B} "
+                    "exceeds the histogram kernel's VMEM scratch budget — "
+                    "use hist_mode='ordered' or a smaller max_bin"
                 )
-
         n_pad_seg = padded_rows(n)
-        seg0 = pack_rows(bins, grad, hess, count_mask, n_pad_seg, wide=seg_wide)
+        seg0 = pack_rows(
+            bins_loc, grad, hess, count_mask, n_pad_seg, wide=seg_wide
+        )
 
         # explicit int8 opt-in (hist_method='pallas_int8' + quantized
         # gradients): integer grid accumulation, exact and ~2x throughput
@@ -714,7 +719,7 @@ def grow_tree(
             hist = seg_hist(
                 seg_arr,
                 jnp.stack([start, cnt_rows]).astype(jnp.int32),
-                f=f,
+                f=f_seg,
                 num_bins=B,
                 n_pad=n_pad_seg,
                 quant_scales=seg_qs,
@@ -1068,6 +1073,38 @@ def grow_tree(
         if use_seg:
             begin_l = st.leaf_begin[l]
             seg_cnt_l = jnp.where(can_split, st.leaf_nrows[l], 0)
+            gl_vec = None
+            if use_featpar:
+                # only the OWNING shard holds the winner feature's bin
+                # plane: it computes the go-left bits over the whole packed
+                # matrix (segment order) and the psum broadcasts them —
+                # every shard then applies the identical stable partition
+                # (reference feature-parallel keeps partitioning local
+                # because every machine holds all columns; here columns are
+                # sliced, so the bits travel instead — O(N) f32 on ICI)
+                from .segpart import _go_left as _seg_go_left
+
+                owner = jnp.clip(feat // f_loc, 0, p.feature_shard - 1)
+                lane = jnp.clip(feat - owner * f_loc, 0, max(f_loc - 1, 0))
+                if seg_wide:
+                    p16 = lax.dynamic_slice_in_dim(st.order, lane, 1, axis=0)[0]
+                    colv = p16.astype(jnp.int32) & 0xFFFF
+                else:
+                    p16 = lax.dynamic_slice_in_dim(
+                        st.order, lane >> 1, 1, axis=0
+                    )[0]
+                    colv = (
+                        (p16.astype(jnp.int32) & 0xFFFF) >> ((lane & 1) * 8)
+                    ) & 0xFF
+                glv = _seg_go_left(
+                    colv, tbin, dl.astype(jnp.int32), nan_bins[feat],
+                    cis.astype(jnp.int32), cmask.astype(jnp.float32),
+                )
+                mine = lax.axis_index(p.axis_name) == owner
+                gl_vec = lax.psum(
+                    jnp.where(mine, glv.astype(jnp.float32), 0.0),
+                    p.axis_name,
+                )
             order, nleft, nright = sort_partition(
                 st.order,
                 begin_l,
@@ -1078,9 +1115,10 @@ def grow_tree(
                 nan_bins[feat],
                 cis.astype(jnp.int32),
                 cmask.astype(jnp.float32),
-                f=f,
+                f=f_seg,
                 n_pad=n_pad_seg,
                 wide=seg_wide,
+                gl_vec=gl_vec,
             )
             if p.axis_name is not None:
                 # global smaller-child choice (see gather-mode comment)
@@ -1601,7 +1639,7 @@ def grow_tree(
         lp = leaf_of_positions(
             state.leaf_begin, state.leaf_nrows, state.num_leaves, n
         )
-        GLO = stat_lanes(f, seg_wide)[0]
+        GLO = stat_lanes(f_seg, seg_wide)[0]
         ridx = (state.order[GLO + 5, :n].astype(jnp.int32) & 0xFFFF) | (
             (state.order[GLO + 6, :n].astype(jnp.int32) & 0xFFFF) << 16
         )

@@ -27,6 +27,7 @@ like the reference's histogram-pool size classes.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -56,7 +57,7 @@ def _go_left(colv, tbin, dl, nanb, iscat, catmask):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("f", "n_pad", "wide")
+    jax.jit, static_argnames=("f", "n_pad", "wide", "use_gl_vec")
 )
 def sort_partition_xla(
     seg: jnp.ndarray,  # [LANES, n_pad] i16 packed rows, PLANE-MAJOR — the
@@ -70,22 +71,31 @@ def sort_partition_xla(
     nanb: jnp.ndarray,  # scalar i32 (NaN bin or -1)
     iscat: jnp.ndarray,  # scalar i32
     catmask: jnp.ndarray,  # [Bm] f32 — bin -> goes left (categorical)
+    gl_vec: Optional[jnp.ndarray] = None,  # [n_pad] f32 go-left bits
     *,
     f: int,
     n_pad: int,
     wide: bool = False,
+    use_gl_vec: bool = False,
 ):
     """Partition seg[sbegin : sbegin+cnt) by the split rule.
+
+    ``use_gl_vec``: the go-left decision comes from a precomputed [n_pad]
+    bit vector instead of the feature column (feature-parallel seg mode —
+    only the owning shard holds the winner's bin plane; the bits arrive by
+    psum and every shard applies the identical stable partition).
 
     Returns (seg', nl, nr): left child at [sbegin, sbegin+nl), right child at
     [sbegin+nl, sbegin+cnt), both in stable order; rows outside untouched.
     """
     n_ops = (used_lanes(f, wide) + 1) // 2  # i32 lanes that carry real data
     caps = window_caps(n_pad)
+    if gl_vec is None:
+        gl_vec = jnp.zeros((n_pad,), jnp.float32)
 
     def make_branch(P: int):
         def branch(op):
-            seg, sbegin, cnt, feat, tbin, dl, nanb, iscat = op
+            seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, glv = op
             start = jnp.minimum(sbegin, n_pad - P)
             off = sbegin - start
             # window-first: only O(P) data is ever materialized — a
@@ -96,16 +106,19 @@ def sort_partition_xla(
             uT = win16.astype(jnp.int32) & 0xFFFF  # [2*n_ops, P]
             pos = jnp.arange(P, dtype=jnp.int32)
             in_seg = (pos >= off) & (pos < off + cnt)
-            if wide:
-                # one u16 plane per feature (max_bin > 256)
-                colv = lax.dynamic_slice(uT, (feat, 0), (1, P))[0]
+            if use_gl_vec:
+                gl = (lax.dynamic_slice(glv, (start,), (P,)) > 0.5) & in_seg
             else:
-                # feature column: byte j&1 of i16 lane j>>1
-                lane = feat >> 1
-                shift = (feat & 1) * 8
-                col16 = lax.dynamic_slice(uT, (lane, 0), (1, P))[0]
-                colv = (col16 >> shift) & 0xFF
-            gl = _go_left(colv, tbin, dl, nanb, iscat, catmask) & in_seg
+                if wide:
+                    # one u16 plane per feature (max_bin > 256)
+                    colv = lax.dynamic_slice(uT, (feat, 0), (1, P))[0]
+                else:
+                    # feature column: byte j&1 of i16 lane j>>1
+                    lane = feat >> 1
+                    shift = (feat & 1) * 8
+                    col16 = lax.dynamic_slice(uT, (lane, 0), (1, P))[0]
+                    colv = (col16 >> shift) & 0xFF
+                gl = _go_left(colv, tbin, dl, nanb, iscat, catmask) & in_seg
             key = jnp.where(
                 pos < off,
                 0,
@@ -134,7 +147,8 @@ def sort_partition_xla(
     ).astype(jnp.int32)
     branches = [make_branch(P) for P in caps]
     seg_new, nl = lax.switch(
-        bucket, branches, (seg, sbegin, cnt, feat, tbin, dl, nanb, iscat)
+        bucket, branches,
+        (seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, gl_vec),
     )
     nr = cnt - nl
     return seg_new, nl, nr
@@ -142,13 +156,23 @@ def sort_partition_xla(
 
 def sort_partition(
     seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask, *, f: int,
-    n_pad: int, wide: bool = False,
+    n_pad: int, wide: bool = False, gl_vec=None,
 ):
     """Platform dispatch for the segment partition: the Pallas streaming
     kernel on TPU (ops/pallas/partition.py — exact window, in place, no
     defensive copies), the stable-sort formulation elsewhere.  Both are
-    stable partitions with bit-identical results."""
+    stable partitions with bit-identical results.
+
+    ``gl_vec`` (feature-parallel seg): precomputed go-left bits — always
+    the XLA sort ladder (the Pallas kernel reads the column itself; a
+    bits-fed kernel variant is future work)."""
     from .pallas.partition import seg_partition_pallas
+
+    if gl_vec is not None:
+        return sort_partition_xla(
+            seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask,
+            gl_vec, f=f, n_pad=n_pad, wide=wide, use_gl_vec=True,
+        )
 
     def _pallas(seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask):
         bm = catmask.shape[0]

@@ -70,6 +70,11 @@ _SLOW_TESTS = {
     "test_monotone.py::test_advanced_monotone_with_categoricals",
     "test_dask.py::test_dask_regressor_two_workers_matches_single_process",
     "test_dask.py::test_dask_ranker_groups_not_split",
+    "test_dask.py::test_dask_classifier_multiclass",
+    "test_monotone.py::test_monotone_property[advanced]",
+    "test_codegen.py::test_cpp_codegen_multiclass_softmax",
+    "test_codegen.py::test_cpp_codegen_xentlambda_softplus",
+    "test_feature_parallel.py::test_feature_parallel_seg_categorical_matches_serial",
     "test_categorical.py::test_e2e_categorical_nan_goes_right",
     "test_categorical.py::test_e2e_categorical_roundtrip_and_consistency",
     "test_categorical.py::test_e2e_categorical_beats_frequency_rank",

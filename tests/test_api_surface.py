@@ -95,6 +95,9 @@ def test_booster_utilities(xy):
     b.set_train_data_name("train")
 
 
-def test_dask_stubs_raise():
-    with pytest.raises(ImportError):
-        lgb.DaskLGBMRegressor()
+def test_dask_estimators_constructible():
+    # r4: the dask module is a real adapter now (see test_dask.py); the
+    # estimators construct without a client and fail at fit time instead
+    est = lgb.DaskLGBMRegressor(n_estimators=3)
+    with pytest.raises(ValueError, match="client"):
+        est.fit([[0.0]], [0.0])

@@ -1,0 +1,233 @@
+"""Round-4 TPU measurement program — run THE MOMENT the tunnel is up.
+
+    python tools/perf_r4.py all   # everything, crash-tolerant, results to
+                                  # tools/PERF_R4_RESULTS.md as it goes
+
+Individual modes: parity (native partition + int8 + forest-walk bit/close
+checks), part (partition perf), train [rows] [iters], overhead (ms/split
+fixed-cost row sweep), profile [rows], predict, all.
+
+Every timing uses the marginal-rep method (axon result caching + dispatch
+variance make naive timings lie — see BENCH_NOTES).  `all` orders steps by
+priority so a mid-run tunnel death still leaves the headline numbers:
+train@10.5M -> predict -> parity -> part -> overhead -> profile.
+"""
+
+import io
+import sys
+import time
+import traceback
+from contextlib import redirect_stdout
+from pathlib import Path
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from perf_r3 import (  # noqa: E402
+    bench_partition,
+    bench_predict,
+    bench_profile,
+    bench_train,
+    marginal,
+)
+
+
+def parity_native():
+    """Native (non-interpret) TPU runs of the escrowed kernels vs their
+    oracles — the r3 ADVICE medium item."""
+    from lightgbm_tpu.ops.pallas.partition import seg_partition_pallas
+    from lightgbm_tpu.ops.pallas.seg import (
+        pack_rows,
+        padded_rows,
+        seg_hist_pallas,
+        unpack_stats,
+    )
+    from lightgbm_tpu.ops.segpart import sort_partition_xla
+    from lightgbm_tpu.ops.histogram import leaf_histogram_segment
+
+    rng = np.random.default_rng(7)
+    f, n = 11, 200_000
+    n_pad = padded_rows(n)
+    bins = rng.integers(0, 256, size=(n, f)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.random(n).astype(np.float32) + 0.5
+    m = (rng.random(n) < 0.8).astype(np.float32)
+    seg = jax.device_put(
+        pack_rows(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                  jnp.asarray(m), n_pad)
+    )
+    catm_narrow = (rng.random(256) < 0.5).astype(np.float32)
+    catm = jnp.zeros((1, 256), jnp.float32).at[0].set(jnp.asarray(catm_narrow))
+
+    # -- partition kernel: native vs XLA sort, bit-identical
+    for (sb, cnt, feat, tbin, dl, nanb, iscat) in (
+        (0, n, 3, 120, 0, -1, 0),
+        (137, 60_000, 5, 80, 1, 200, 0),
+        (513, 1029, 7, 30, 0, -1, 1),
+    ):
+        scal = jnp.asarray([sb, cnt, feat, tbin, dl, nanb, iscat, 0], jnp.int32)
+        got, nl_k = seg_partition_pallas(
+            seg, scal, catm, f=f, n_pad=n_pad, use_cat=bool(iscat)
+        )
+        want, nl_s, _ = sort_partition_xla(
+            seg, jnp.int32(sb), jnp.int32(cnt), jnp.int32(feat),
+            jnp.int32(tbin), jnp.int32(dl), jnp.int32(nanb),
+            jnp.int32(iscat), jnp.asarray(catm_narrow), f=f, n_pad=n_pad,
+        )
+        assert int(nl_k) == int(nl_s), (int(nl_k), int(nl_s))
+        assert np.array_equal(np.asarray(got), np.asarray(want)), (
+            f"partition kernel mismatch at window ({sb},{cnt})"
+        )
+    print("partition kernel NATIVE parity: bit-identical to sort path")
+
+    # -- seg histogram (bf16 three-term) native tolerance
+    hs = seg_hist_pallas(
+        seg, jnp.asarray([137, 60_000], jnp.int32), f=f, num_bins=256,
+        n_pad=n_pad,
+    )
+    bo, go, ho, mo, _ = unpack_stats(seg[:, 137:137 + 60_000], f)
+    ref = leaf_histogram_segment(bo, go, ho, mo, 256)
+    rel = float(
+        np.abs(np.asarray(hs) - np.asarray(ref)).max()
+        / max(1e-9, np.abs(np.asarray(ref)).max())
+    )
+    assert rel < 5e-6, rel
+    print(f"seg_hist NATIVE parity: rel err {rel:.2e} (< 5e-6)")
+
+    # -- int8 grid variant native exactness (quantized training)
+    gs, hsc = np.float32(0.037), np.float32(0.0021)
+    kq = rng.integers(-63, 64, size=n).astype(np.float32)
+    hq = rng.integers(0, 64, size=n).astype(np.float32)
+    seg_q = jax.device_put(
+        pack_rows(jnp.asarray(bins), jnp.asarray(kq * gs),
+                  jnp.asarray(hq * hsc), jnp.asarray(m), n_pad)
+    )
+    out_q = seg_hist_pallas(
+        seg_q, jnp.asarray([137, 60_000], jnp.int32),
+        jnp.asarray([gs, hsc], jnp.float32), f=f, num_bins=256, n_pad=n_pad,
+        quantized=True,
+    )
+    bo, go, ho, mo, _ = unpack_stats(seg_q[:, 137:137 + 60_000], f)
+    ref_q = leaf_histogram_segment(bo, go, ho, mo, 256)
+    assert np.array_equal(
+        np.asarray(out_q)[:, :, 2], np.asarray(ref_q)[:, :, 2]
+    )
+    assert np.allclose(np.asarray(out_q), np.asarray(ref_q), rtol=1e-6, atol=1e-6)
+    print("int8 seg_hist NATIVE parity: counts exact, g/h at 1e-6")
+
+    # -- forest-walk kernel native vs XLA walker (via a trained model)
+    import lightgbm_tpu as lgb
+
+    X = rng.normal(size=(20_000, 7))
+    X[::5, 2] = np.nan
+    y = np.where(np.isnan(X[:, 2]), 1.0, X[:, 0])
+    b = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "verbosity": -1},
+        lgb.Dataset(X, y), 12,
+    )
+    raw_fw = b._forest_walk_raw(X[:5000], 0, 12, 1)
+    assert raw_fw is not None, "forest-walk ineligible on TPU?!"
+    from lightgbm_tpu.predict import predict_bins_raw
+
+    bins_h = jnp.asarray(b._bin_input_host(X[:5000]))
+    batch = b._stacked_bins(0, 12)
+    exp = np.asarray(predict_bins_raw(batch, bins_h, b._nan_bins)).reshape(
+        5000, -1
+    ).sum(axis=1)
+    assert np.allclose(raw_fw[:, 0], exp, atol=1e-5), "forest walk mismatch"
+    print("forest-walk kernel NATIVE parity: matches XLA walker at 1e-5")
+
+
+def bench_overhead():
+    """ms/split fixed-cost extraction: serial training at halving row
+    counts; the row->0 intercept is the per-split fixed overhead (VERDICT
+    r3 #4 asks for <= 0.2 ms/split)."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(0)
+    X0 = rng.normal(size=(1_000_000, 28)).astype(np.float32)
+    y0 = (X0[:, 0] + X0[:, 1] > 0).astype(np.float64)
+    print("| rows | ms/tree | ms/split |")
+    print("|---|---|---|")
+    pts = []
+    for rows in (1_000_000, 500_000, 250_000, 125_000, 62_500):
+        params = {
+            "objective": "binary", "num_leaves": 255, "max_bin": 255,
+            "min_data_in_leaf": 100, "verbosity": -1, "metric": "none",
+        }
+        d = lgb.Dataset(X0[:rows], y0[:rows], params=params)
+        b = lgb.Booster(params, d)
+
+        def step(i):
+            b.update()
+            return b._score
+
+        dt = marginal(step, 2, 5)
+        pts.append((rows, dt))
+        print(f"| {rows} | {dt*1e3:.0f} | {dt*1e3/254:.3f} |", flush=True)
+    # linear fit: ms/split = a * rows + c
+    rs = np.array([p[0] for p in pts], np.float64)
+    ts = np.array([p[1] * 1e3 / 254 for p in pts], np.float64)
+    a, c = np.polyfit(rs, ts, 1)
+    print(
+        f"\nfit ms/split = {a:.3e} * rows + {c:.3f}  ->  fixed overhead "
+        f"~{c:.3f} ms/split (target <= 0.2)"
+    )
+
+
+_STEPS = [
+    ("train_10p5M", lambda: bench_train(10_500_000, 8)),
+    ("train_1M", lambda: bench_train(1_000_000, 8)),
+    ("predict", lambda: bench_predict()),
+    ("parity_native", parity_native),
+    ("partition_perf", bench_partition),
+    ("overhead", bench_overhead),
+    ("profile_10p5M", lambda: bench_profile(10_500_000)),
+]
+
+
+def run_all():
+    out_path = Path(__file__).parent / "PERF_R4_RESULTS.md"
+    with open(out_path, "a") as fp:
+        fp.write(f"\n# perf_r4 run {time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}\n")
+        fp.write(f"backend: {jax.default_backend()}, devices: {jax.devices()}\n\n")
+        for name, fn in _STEPS:
+            fp.write(f"## {name}\n\n")
+            buf = io.StringIO()
+            t0 = time.perf_counter()
+            try:
+                with redirect_stdout(buf):
+                    fn()
+                status = "ok"
+            except Exception:
+                buf.write("\n" + traceback.format_exc())
+                status = "FAILED"
+            fp.write(buf.getvalue())
+            fp.write(
+                f"\n[{name}: {status} in {time.perf_counter()-t0:.0f}s]\n\n"
+            )
+            fp.flush()
+            print(f"{name}: {status}", flush=True)
+    print(f"results appended to {out_path}")
+
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if mode == "all":
+        run_all()
+    elif mode == "parity":
+        parity_native()
+    elif mode == "part":
+        bench_partition()
+    elif mode == "train":
+        bench_train(int(sys.argv[2]) if len(sys.argv) > 2 else 10_500_000,
+                    int(sys.argv[3]) if len(sys.argv) > 3 else 8)
+    elif mode == "overhead":
+        bench_overhead()
+    elif mode == "profile":
+        bench_profile(int(sys.argv[2]) if len(sys.argv) > 2 else 10_500_000)
+    elif mode == "predict":
+        bench_predict()

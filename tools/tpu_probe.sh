@@ -1,10 +1,26 @@
 #!/bin/bash
 # Probe the axon TPU tunnel every 3 minutes; touch /tmp/tpu_up when alive.
-# Runs until killed. Logs to /tmp/tpu_probe.log.
+# The FIRST time the tunnel comes up, immediately run the round-4
+# measurement program (tools/perf_r4.py all — crash-tolerant, appends to
+# tools/PERF_R4_RESULTS.md) so a brief tunnel window still captures the
+# headline numbers. Logs to /tmp/tpu_probe.log.
+cd /root/repo || exit 1
 while true; do
   if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'" 2>/dev/null; then
     date -u +"%FT%TZ up" >> /tmp/tpu_probe.log
     touch /tmp/tpu_up
+    if [ ! -f /tmp/perf_r4_done ]; then
+      date -u +"%FT%TZ launching perf_r4" >> /tmp/tpu_probe.log
+      PYTHONPATH=/root/repo timeout 5400 python tools/perf_r4.py all \
+        >> /tmp/perf_r4.log 2>&1
+      rc=$?
+      date -u +"%FT%TZ perf_r4 done rc=$rc" >> /tmp/tpu_probe.log
+      # mark done only on success: a tunnel flap mid-run retries next time
+      # it comes up (individual steps are idempotent and append results)
+      if [ "$rc" -eq 0 ]; then
+        touch /tmp/perf_r4_done
+      fi
+    fi
   else
     date -u +"%FT%TZ down" >> /tmp/tpu_probe.log
     rm -f /tmp/tpu_up
