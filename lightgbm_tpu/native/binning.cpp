@@ -11,6 +11,7 @@
 #include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include <vector>
 
 extern "C" {
 
@@ -35,6 +36,69 @@ void bin_numeric_f64(const double* values, long long n, const double* ub,
     }
     out[i] = b;
   }
+}
+
+// Equal-count greedy binning over sorted distinct values — the O(n_distinct)
+// inner loop of bin-boundary construction (reference GreedyFindBin,
+// src/io/bin.cpp).  Matches lightgbm_tpu/binning.py _greedy_find_bin
+// operation-for-operation (same float expressions, same branch order) so the
+// boundaries are bit-identical to the Python fallback.  big_suffix[i] =
+// #heavy distinct values at indices >= i, precomputed so the rebudgeting
+// branch (which reads big_suffix[i + 1]) is O(1) instead of an O(n) scan.
+// Returns the number of bounds written (<= max_bin); the +inf terminator is
+// appended by the caller.
+int greedy_find_bin(const double* distinct_values, const double* counts,
+                    long long n, int max_bin, double total_sample_cnt,
+                    double min_data_in_bin, double* bounds_out) {
+  int nb = 0;
+  if (n == 0) return 0;
+  if (n <= max_bin) {
+    double cur_cnt = 0.0;
+    for (long long i = 0; i + 1 < n; ++i) {
+      cur_cnt += counts[i];
+      if (cur_cnt >= min_data_in_bin || max_bin >= n) {
+        bounds_out[nb++] = (distinct_values[i] + distinct_values[i + 1]) / 2.0;
+        cur_cnt = 0.0;
+      }
+    }
+    return nb;
+  }
+  if (max_bin < 1) max_bin = 1;
+  double mean_bin_size = total_sample_cnt / max_bin;
+  // is_big + suffix counts in one backward pass
+  double big_cnt = 0.0;
+  std::vector<long long> big_suffix(n + 1);
+  big_suffix[n] = 0;
+  for (long long i = n - 1; i >= 0; --i) {
+    bool big = counts[i] >= mean_bin_size;
+    big_suffix[i] = big_suffix[i + 1] + (big ? 1 : 0);
+    if (big) big_cnt += counts[i];
+  }
+  double rest_cnt = total_sample_cnt - big_cnt;
+  long long rest_bins = max_bin - big_suffix[0];
+  if (rest_bins > 0) mean_bin_size = rest_cnt / rest_bins;
+  double orig_mean = total_sample_cnt / max_bin;  // is_big uses the ORIGINAL
+  double cur_cnt = 0.0;
+  long long remaining_bins = max_bin;
+  for (long long i = 0; i + 1 < n; ++i) {
+    bool big_i = counts[i] >= orig_mean;
+    bool big_next = counts[i + 1] >= orig_mean;
+    if (!big_i) rest_cnt -= counts[i];
+    cur_cnt += counts[i];
+    if (big_i || cur_cnt >= mean_bin_size ||
+        (big_next && cur_cnt >= std::max(1.0, mean_bin_size * 0.5))) {
+      bounds_out[nb++] = (distinct_values[i] + distinct_values[i + 1]) / 2.0;
+      cur_cnt = 0.0;
+      --remaining_bins;
+      if (remaining_bins <= 1) break;
+      if (!big_i && rest_bins > 0) {
+        long long rest_bins_left = remaining_bins - big_suffix[i + 1];
+        if (rest_bins_left > 0)
+          mean_bin_size = std::max(1.0, rest_cnt / rest_bins_left);
+      }
+    }
+  }
+  return nb;
 }
 
 }  // extern "C"

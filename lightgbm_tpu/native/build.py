@@ -62,7 +62,15 @@ def load_native() -> Optional[ctypes.CDLL]:
                 ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_double,
                 ctypes.c_void_p,
             ]
+            lib.greedy_find_bin.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong,
+                ctypes.c_int, ctypes.c_double, ctypes.c_double,
+                ctypes.c_void_p,
+            ]
+            lib.greedy_find_bin.restype = ctypes.c_int
             _lib = lib
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError: a stale cached .so predating a newly added
+            # symbol (mtime-preserving copies skip the rebuild) — fall back
             _lib = None
         return _lib

@@ -63,3 +63,29 @@ def test_native_handles_extremes(lib):
     got = _native_bins(lib, m, vals)
     want = m.values_to_bins(vals)
     np.testing.assert_array_equal(got, want)
+
+
+def test_native_greedy_find_bin_matches_python():
+    """native/binning.cpp greedy_find_bin must be operation-identical to
+    the Python fallback (reference GreedyFindBin, src/io/bin.cpp)."""
+    import lightgbm_tpu.binning as B
+    import lightgbm_tpu.native.build as nb
+
+    if nb.load_native() is None:
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(3)
+    for _ in range(10):
+        n = int(rng.integers(4097, 40000))
+        dv = np.unique(np.sort(rng.normal(size=n)))
+        cnt = rng.integers(1, 50, size=len(dv)).astype(np.int64)
+        cnt[rng.integers(0, len(dv), 4)] += int(rng.integers(1000, 20000))
+        total = int(cnt.sum())
+        mb = int(rng.choice([63, 255, 1024]))
+        got = B._greedy_find_bin(dv, cnt, mb, total, 3)
+        saved = (nb._tried, nb._lib)
+        nb._tried, nb._lib = True, None  # force the Python fallback
+        try:
+            exp = B._greedy_find_bin(dv, cnt, mb, total, 3)
+        finally:
+            nb._tried, nb._lib = saved
+        assert got == exp
