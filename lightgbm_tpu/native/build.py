@@ -52,10 +52,8 @@ def load_native() -> Optional[ctypes.CDLL]:
         here = Path(__file__).parent
         src = here / "binning.cpp"
         out = here / "_binning.so"
-        try:
-            if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
-                if not _build(src, out):
-                    return None
+
+        def _load():
             lib = ctypes.CDLL(str(out))
             lib.bin_numeric_f64.argtypes = [
                 ctypes.c_void_p, ctypes.c_longlong, ctypes.c_void_p,
@@ -68,9 +66,19 @@ def load_native() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p,
             ]
             lib.greedy_find_bin.restype = ctypes.c_int
-            _lib = lib
+            return lib
+
+        try:
+            if not out.exists() or out.stat().st_mtime < src.stat().st_mtime:
+                if not _build(src, out):
+                    return None
+            try:
+                _lib = _load()
+            except AttributeError:
+                # stale cached .so predating a newly added symbol
+                # (mtime-preserving copies skip the rebuild): rebuild once
+                out.unlink(missing_ok=True)
+                _lib = _load() if _build(src, out) else None
         except (OSError, AttributeError):
-            # AttributeError: a stale cached .so predating a newly added
-            # symbol (mtime-preserving copies skip the rebuild) — fall back
             _lib = None
         return _lib

@@ -80,7 +80,8 @@ def test_native_greedy_find_bin_matches_python():
         cnt = rng.integers(1, 50, size=len(dv)).astype(np.int64)
         cnt[rng.integers(0, len(dv), 4)] += int(rng.integers(1000, 20000))
         total = int(cnt.sum())
-        mb = int(rng.choice([63, 255, 1024]))
+        # 8192 > n exercises the native n <= max_bin branch too
+        mb = int(rng.choice([63, 255, 1024, 8192 + 60000]))
         got = B._greedy_find_bin(dv, cnt, mb, total, 3)
         saved = (nb._tried, nb._lib)
         nb._tried, nb._lib = True, None  # force the Python fallback
