@@ -10,7 +10,13 @@ Public surface mirrors the reference python-package (lightgbm/__init__.py):
 ``Dataset``, ``Booster``, ``train``, ``cv``, callbacks, sklearn wrappers.
 """
 
-from .basic import LGBMDeprecationWarning  # noqa: F401
+from .basic import (  # noqa: F401
+    LGBMDeprecationWarning,
+    LightGBMError,
+)
+
+# common user-code alias for the reference error class
+LGBMError = LightGBMError
 from .boosting.gbdt import Booster
 from .callback import (
     EarlyStopException,
@@ -43,6 +49,8 @@ except Exception:  # pragma: no cover - sklearn not installed
 __version__ = "0.1.0"
 
 __all__ = [
+    "LGBMError",
+    "LightGBMError",
     "Dataset",
     "Booster",
     "CVBooster",

@@ -614,18 +614,39 @@ class Booster:
         from .sampling import bagging_is_active
 
         query_sizes = None
+        pad_query_mask = None
         if cfg.bagging_by_query and bagging_is_active(cfg):
-            if self._multiproc:
-                raise NotImplementedError(
-                    "bagging_by_query under pre_partition multi-process "
-                    "training is not wired yet (per-process query blocks "
-                    "need globally-consistent padding)"
-                )
             qb = md.query_boundaries
-            if qb is not None:
+            if qb is not None and self._multiproc:
+                # global query-size list in PROCESS-BLOCK order: every
+                # process's local queries followed by its block's padding
+                # rows as a never-in-bag pseudo-query — all processes build
+                # the identical list (allgather), so the shared rng stream
+                # yields the same per-query mask everywhere (SPMD)
+                from ..parallel import allgather_host_varlen
+
+                local_sizes = np.diff(np.asarray(qb, np.int64))
+                gsizes, gcounts = allgather_host_varlen(
+                    local_sizes, return_counts=True
+                )
+                lpad = n_dev  # the per-process padded block width
+                sizes, padm, off = [], [], 0
+                for p, cq in enumerate(gcounts):
+                    block = gsizes[off : off + int(cq)]
+                    off += int(cq)
+                    sizes.extend(int(s) for s in block)
+                    padm.extend([False] * int(cq))
+                    blk_pad = lpad - int(block.sum())
+                    if blk_pad:
+                        sizes.append(blk_pad)
+                        padm.append(True)
+                query_sizes = np.asarray(sizes, np.int64)
+                pad_query_mask = np.asarray(padm, bool)
+            elif qb is not None:
                 query_sizes = np.diff(np.asarray(qb, np.int64))
         self._sampler = create_sample_strategy(
-            cfg, n_sampler, is_pos, query_sizes=query_sizes
+            cfg, n_sampler, is_pos, query_sizes=query_sizes,
+            pad_query_mask=pad_query_mask,
         )
         self._gathered_label = None  # free the init-time global label copy
 

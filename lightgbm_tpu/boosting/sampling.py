@@ -52,7 +52,7 @@ class BaggingStrategy(SampleStrategy):
     host constants — no gather)."""
 
     def __init__(self, config: Config, num_data: int, is_pos=None,
-                 query_sizes=None):
+                 query_sizes=None, pad_query_mask=None):
         super().__init__(config, num_data)
         self._mask = self._ones
         self._last_refresh = -1
@@ -60,16 +60,24 @@ class BaggingStrategy(SampleStrategy):
         self._qsizes = None
         if query_sizes is not None:
             qs = np.asarray(query_sizes, np.int64)
+            padq = (
+                np.zeros(len(qs), bool)
+                if pad_query_mask is None
+                else np.asarray(pad_query_mask, bool)
+            )
             pad = num_data - int(qs.sum())
             if pad < 0:
                 raise ValueError(
                     f"query sizes sum {qs.sum()} > num_data {num_data}"
                 )
             if pad:
-                # padding rows form a pseudo-query that is never in bag
+                # trailing padding rows form a pseudo-query, never in bag
+                # (multi-process feeding interleaves per-block pad entries
+                # via pad_query_mask instead)
                 qs = np.append(qs, pad)
+                padq = np.append(padq, True)
             self._qsizes = qs
-            self._qpad = pad
+            self._qpad_dev = jnp.asarray(~padq, jnp.float32)
 
     def sample(self, iteration, grad, hess, rng):
         cfg = self.config
@@ -80,8 +88,7 @@ class BaggingStrategy(SampleStrategy):
                 qmask = jax.random.bernoulli(
                     rng, cfg.bagging_fraction, (nq,)
                 ).astype(jnp.float32)
-                if self._qpad:
-                    qmask = qmask.at[nq - 1].set(0.0)
+                qmask = qmask * self._qpad_dev
                 self._mask = jnp.repeat(
                     qmask, self._qsizes, total_repeat_length=self.num_data
                 )
@@ -143,7 +150,8 @@ def bagging_is_active(config: Config) -> bool:
 
 
 def create_sample_strategy(
-    config: Config, num_data: int, is_pos=None, query_sizes=None
+    config: Config, num_data: int, is_pos=None, query_sizes=None,
+    pad_query_mask=None,
 ) -> SampleStrategy:
     """Factory (reference: SampleStrategy::CreateSampleStrategy,
     src/boosting/sample_strategy.cpp)."""
@@ -177,11 +185,14 @@ def create_sample_strategy(
             )
     if is_goss:
         return GOSSStrategy(config, num_data)
+    pq = pad_query_mask if config.bagging_by_query else None
     if config.bagging_freq > 0 and (config.bagging_fraction < 1.0 or need_balanced):
         return BaggingStrategy(
-            config, num_data, is_pos if need_balanced else None, query_sizes=qs
+            config, num_data, is_pos if need_balanced else None,
+            query_sizes=qs, pad_query_mask=pq,
         )
     if config.boosting == "rf":
         # RF requires bagging (reference rf.hpp:25 CHECK)
-        return BaggingStrategy(config, num_data, query_sizes=qs)
+        return BaggingStrategy(config, num_data, query_sizes=qs,
+                               pad_query_mask=pq)
     return SampleStrategy(config, num_data)

@@ -124,6 +124,19 @@ def run_refit(params: Dict[str, Any], cfg: Config) -> None:
     print(f"Finished refit; model written to {out}")
 
 
+def run_save_binary(params: Dict[str, Any], cfg: Config) -> None:
+    """task=save_binary: load + bin the data, write the binary dataset
+    (reference: application.cpp TaskType::kSaveBinary — construct, then
+    Dataset::SaveBinaryFile)."""
+    if not cfg.data:
+        raise SystemExit("task=save_binary requires data=<training file>")
+    ds = Dataset(cfg.data, params=params)
+    ds.construct()
+    out = params.get("output_model", cfg.data + ".bin")
+    ds.save_binary(out)
+    print(f"Finished saving binary dataset to {out}")
+
+
 def run_convert_model(params: Dict[str, Any], cfg: Config) -> None:
     """task=convert_model: JSON dump, or standalone if-else C++ with
     convert_model_language=cpp (reference: GBDT::SaveModelToIfElse,
@@ -163,6 +176,8 @@ def main(argv=None) -> None:
         run_predict(params, cfg)
     elif task == "convert_model":
         run_convert_model(params, cfg)
+    elif task == "save_binary":
+        run_save_binary(params, cfg)
     elif task == "refit":
         run_refit(params, cfg)
     else:

@@ -296,6 +296,18 @@ def _arrow_column_to_numpy(arr):
     return arr.to_numpy(zero_copy_only=False)
 
 
+def _is_binary_dataset_file(path: str) -> bool:
+    """True when ``path`` is a lightgbm_tpu binary dataset (pickle with our
+    format marker in the first bytes) — the reference's binary-magic check
+    (dataset_loader.cpp LoadFromBinFile)."""
+    try:
+        with open(path, "rb") as fh:
+            head = fh.read(64)
+    except OSError:
+        return False
+    return head[:1] == b"\x80" and b"lightgbm_tpu.dataset.v1" in head
+
+
 def _load_text_file(path: str, config: Config) -> Dict[str, Any]:
     """Parse a CSV/TSV/LibSVM training file (reference src/io/parser.cpp);
     LibSVM rows load into a CSR matrix (sparse path), dense CSV/TSV into a
@@ -416,6 +428,32 @@ class Dataset:
     def _construct_inner(self) -> "Dataset":
         data = self._raw_data
         label = self._label
+        if isinstance(data, (str, Path)) and _is_binary_dataset_file(str(data)):
+            # binary dataset auto-detection (reference: DatasetLoader checks
+            # the binary magic before falling back to the text parsers,
+            # src/io/dataset_loader.cpp LoadFromBinFile)
+            if self.reference is not None:
+                raise ValueError(
+                    "a binary dataset carries its own bin mappers and "
+                    "cannot be re-binned against a reference dataset; "
+                    "construct the validation set from the raw data file, "
+                    "or save the binary from a Dataset built with "
+                    "reference= so its bins already match"
+                )
+            # explicitly passed per-row fields override the pickled ones
+            keep = {
+                "label": self._label,
+                "weight": self._weight,
+                "group": self._group,
+                "init_score": self._init_score,
+            }
+            loaded_ds = Dataset.load_binary(str(data), params=self.params)
+            self.__dict__.update(loaded_ds.__dict__)
+            self._constructed = True
+            for name, val in keep.items():
+                if val is not None:
+                    self.set_field(name, val)
+            return self
         if isinstance(data, (str, Path)):
             loaded = _load_text_file(str(data), self.config)
             data = loaded["data"]

@@ -341,6 +341,30 @@ class AveragePrecisionMetric(Metric):
 
 
 # =============================================================== multiclass
+def _mlogloss_device(score, label, weight):
+    """ONE jitted program for the device-side multiclass logloss: a single
+    dispatch on the sharded score instead of an op-by-op chain (each
+    op-by-op step compiles/dispatches its own tiny sharded program — a
+    large surface that tickled an XLA CPU segfault deep into long
+    compile-heavy processes)."""
+    import jax
+    import jax.numpy as jnp
+
+    logp = jax.nn.log_softmax(score, axis=0)  # [K, N]
+    # one-hot contraction instead of take_along_axis: no gather on the
+    # sharded array (gathers also serialize on TPU)
+    k = score.shape[0]
+    onehot = jax.nn.one_hot(label, k, axis=0, dtype=logp.dtype)  # [K, N]
+    p = jnp.sum(logp * onehot, axis=0)
+    loss = -jnp.maximum(p, jnp.log(_EPS))
+    if weight is not None:
+        loss = loss * weight
+    return loss.sum()
+
+
+_mlogloss_device_jit = None
+
+
 class MultiLoglossMetric(Metric):
     name = "multi_logloss"
 
@@ -357,7 +381,7 @@ class MultiLoglossMetric(Metric):
         import jax
         import jax.numpy as jnp
 
-        # log_softmax below is the softmax objective's convert_output in log
+        # log_softmax is the softmax objective's convert_output in log
         # space; other objectives (e.g. multiclassova) convert differently
         if objective is None or getattr(objective, "name", "") != "multiclass":
             return None
@@ -366,12 +390,13 @@ class MultiLoglossMetric(Metric):
             self._weight_dev = (
                 None if self.weight is None else jnp.asarray(self.weight, jnp.float32)
             )
-        logp = jax.nn.log_softmax(score_dev, axis=0)  # [K, N]
-        p = jnp.take_along_axis(logp, self._label_dev[None, :], axis=0)[0]
-        loss = -jnp.maximum(p, jnp.log(_EPS))
-        if self._weight_dev is not None:
-            loss = loss * self._weight_dev
-        return [(self.name, float(loss.sum()) / self.sum_weights)]
+        global _mlogloss_device_jit
+        if _mlogloss_device_jit is None:
+            _mlogloss_device_jit = jax.jit(_mlogloss_device)
+        total = _mlogloss_device_jit(
+            score_dev, self._label_dev, self._weight_dev
+        )
+        return [(self.name, float(total) / self.sum_weights)]
 
 
 class MultiErrorMetric(Metric):

@@ -67,6 +67,8 @@ def _seg_partition_kernel(
     seg_any,  # ANY [LANES, n_pad] i16 (aliased to seg_out)
     cat_ref,  # VMEM [1, 256] f32 — bin -> goes-left (categorical)
     tri_ref,  # VMEM [T, T] bf16 — tri[i, j] = (i <= j), cumsum-by-matmul
+    gl_any,  # ANY [1, n_pad] f32 — precomputed go-left bits (use_gl; else
+    #          a [1, COL_ALIGN] dummy)
     seg_out,  # ANY [LANES, n_pad] i16 (aliased with seg_any)
     scratch_out,  # ANY [SUB, n_pad] i16 — right-stream spill
     nl_ref,  # SMEM [1, 1] i32 — rows of the segment going left
@@ -76,8 +78,10 @@ def _seg_partition_kernel(
     stage_hi,  # VMEM [SUB, W] f32
     rstage_lo,  # VMEM [SUB, W] f32 — right stream staging
     rstage_hi,  # VMEM [SUB, W] f32
+    gl_stage,  # VMEM [1, T] f32 — go-left tile (use_gl)
     sem_in,
     sem_out,
+    sem_gl,
     *,
     f: int,
     n_pad: int,
@@ -85,6 +89,7 @@ def _seg_partition_kernel(
     sub: int,
     wide: bool,
     bmt: int,
+    use_gl: bool,
 ):
     sbegin = scal_ref[0]
     cnt = scal_ref[1]
@@ -180,27 +185,42 @@ def _seg_partition_kernel(
     def body1(t, carry):
         fill_l, bl, fill_r, br, nl = carry
         xu = _read_tile(seg_any, abegin + t * T)
-        if wide:
-            # one u16 plane per feature (max_bin > 256)
-            colv = jax.lax.dynamic_slice(xu, (feat, 0), (1, T))  # [1, T]
-        else:
-            lane = feat >> 1
-            sh = (feat & 1) * 8
-            colrow = jax.lax.dynamic_slice(xu, (lane, 0), (1, T))  # [1, T]
-            colv = (colrow >> sh) & 0xFF
         rpos = iota_j + t * T
         in_seg = (rpos >= off) & (rpos < off + cnt)
-        go = (colv <= tbin) | ((dl != 0) & (nanb >= 0) & (colv == nanb))
-        if use_cat:
-            oh = (
-                colv == jax.lax.broadcasted_iota(jnp.int32, (bmt, T), 0)
-            ).astype(jnp.bfloat16)  # [bmt, T]
-            catv = jax.lax.dot_general(
-                cat_ref[...].astype(jnp.bfloat16), oh,
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # [1, T]
-            go = jnp.where(iscat != 0, catv > 0.5, go)
+        if use_gl:
+            # precomputed go-left bits (feature-parallel seg: the winner's
+            # plane lives on the owning shard; the bits arrived by psum)
+            dma = pltpu.make_async_copy(
+                gl_any.at[
+                    pl.ds(0, 1),
+                    pl.ds(pl.multiple_of(abegin + t * T, COL_ALIGN), T),
+                ],
+                gl_stage,
+                sem_gl,
+            )
+            dma.start()
+            dma.wait()
+            go = gl_stage[...] > 0.5  # [1, T]
+        else:
+            if wide:
+                # one u16 plane per feature (max_bin > 256)
+                colv = jax.lax.dynamic_slice(xu, (feat, 0), (1, T))  # [1, T]
+            else:
+                lane = feat >> 1
+                sh = (feat & 1) * 8
+                colrow = jax.lax.dynamic_slice(xu, (lane, 0), (1, T))
+                colv = (colrow >> sh) & 0xFF
+            go = (colv <= tbin) | ((dl != 0) & (nanb >= 0) & (colv == nanb))
+            if use_cat:
+                oh = (
+                    colv == jax.lax.broadcasted_iota(jnp.int32, (bmt, T), 0)
+                ).astype(jnp.bfloat16)  # [bmt, T]
+                catv = jax.lax.dot_general(
+                    cat_ref[...].astype(jnp.bfloat16), oh,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )  # [1, T]
+                go = jnp.where(iscat != 0, catv > 0.5, go)
         keep_l = (rpos < off) | (in_seg & go)
         keep_r = jnp.logical_not(keep_l)
         nl = nl + jnp.sum((in_seg & go).astype(jnp.int32))
@@ -258,6 +278,7 @@ def seg_partition_pallas(
     seg: jnp.ndarray,  # [LANES, n_pad] i16 plane-major packed rows
     scal: jnp.ndarray,  # [8] i32: sbegin, cnt, feat, tbin, dl, nanb, iscat, 0
     catmask: jnp.ndarray,  # [1, bmt] f32 (bmt >= 256, 128-multiple)
+    gl_vec: jnp.ndarray = None,  # [n_pad] f32 go-left bits (featpar seg)
     *,
     f: int,
     n_pad: int,
@@ -267,16 +288,26 @@ def seg_partition_pallas(
 ):
     """Partition seg[sbegin : sbegin+cnt) by the split rule, in place.
 
+    ``gl_vec``: the go-left decision comes from precomputed bits instead of
+    the feature column (feature-parallel seg — only the owning shard holds
+    the winner's bin plane).
+
     Returns (seg', nl).  Left child lands at [sbegin, sbegin+nl), right at
     [sbegin+nl, sbegin+cnt), both in stable (original) order; every column
     outside the window keeps its value.
     """
+    use_gl = gl_vec is not None
     sub = 2 * ((used_lanes(f, wide) + 1) // 2)
     lanes = seg.shape[0]
     tri = jnp.tril(jnp.ones((T, T), jnp.bfloat16)).T  # tri[i, j] = i <= j
+    gl_arr = (
+        gl_vec.reshape(1, n_pad).astype(jnp.float32)
+        if use_gl
+        else jnp.zeros((1, COL_ALIGN), jnp.float32)
+    )
     kernel = functools.partial(
         _seg_partition_kernel, f=f, n_pad=n_pad, use_cat=use_cat, sub=sub,
-        wide=wide, bmt=catmask.shape[1],
+        wide=wide, bmt=catmask.shape[1], use_gl=use_gl,
     )
     seg_new, _, nl = pl.pallas_call(
         kernel,
@@ -286,6 +317,7 @@ def seg_partition_pallas(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
@@ -304,10 +336,12 @@ def seg_partition_pallas(
             pltpu.VMEM((sub, W), jnp.float32),
             pltpu.VMEM((sub, W), jnp.float32),
             pltpu.VMEM((sub, W), jnp.float32),
+            pltpu.VMEM((1, T), jnp.float32),
+            pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
         input_output_aliases={1: 0},
         interpret=interpret,
-    )(scal, seg, catmask, tri)
+    )(scal, seg, catmask, tri, gl_arr)
     return seg_new, nl[0, 0]

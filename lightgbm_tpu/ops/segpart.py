@@ -163,18 +163,15 @@ def sort_partition(
     defensive copies), the stable-sort formulation elsewhere.  Both are
     stable partitions with bit-identical results.
 
-    ``gl_vec`` (feature-parallel seg): precomputed go-left bits — always
-    the XLA sort ladder (the Pallas kernel reads the column itself; a
-    bits-fed kernel variant is future work)."""
+    ``gl_vec`` (feature-parallel seg): the go-left decision comes from a
+    precomputed [n_pad] bit vector; the Pallas kernel DMAs a bits tile per
+    row tile instead of reading the feature column."""
     from .pallas.partition import seg_partition_pallas
 
-    if gl_vec is not None:
-        return sort_partition_xla(
-            seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask,
-            gl_vec, f=f, n_pad=n_pad, wide=wide, use_gl_vec=True,
-        )
+    use_gl = gl_vec is not None
 
-    def _pallas(seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask):
+    def _pallas(seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask,
+                *maybe_gl):
         bm = catmask.shape[0]
         bmt = max(256, -(-bm // 128) * 128)  # cat-table width (wide bins)
         catm = jnp.zeros((1, bmt), jnp.float32)
@@ -183,17 +180,23 @@ def sort_partition(
             [sbegin, cnt, feat, tbin, dl, nanb, iscat, jnp.int32(0)]
         ).astype(jnp.int32)
         seg_new, nl = seg_partition_pallas(
-            seg, scal, catm, f=f, n_pad=n_pad, use_cat=bm > 1, wide=wide
+            seg, scal, catm, maybe_gl[0] if maybe_gl else None,
+            f=f, n_pad=n_pad, use_cat=bm > 1, wide=wide,
         )
         return seg_new, nl, cnt - nl
 
-    return jax.lax.platform_dependent(
-        seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask,
-        tpu=_pallas,
-        default=functools.partial(
-            sort_partition_xla, f=f, n_pad=n_pad, wide=wide
-        ),
-    )
+    def _xla(seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask,
+             *maybe_gl):
+        return sort_partition_xla(
+            seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask,
+            maybe_gl[0] if maybe_gl else None,
+            f=f, n_pad=n_pad, wide=wide, use_gl_vec=use_gl,
+        )
+
+    args = (seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask)
+    if use_gl:
+        args = args + (gl_vec,)
+    return jax.lax.platform_dependent(*args, tpu=_pallas, default=_xla)
 
 
 def leaf_of_positions(

@@ -170,9 +170,10 @@ def shard_cols(
     return jax.device_put(jnp.asarray(arr), NamedSharding(mesh, P(None, axis_name)))
 
 
-def allgather_host_varlen(arr: np.ndarray) -> np.ndarray:
+def allgather_host_varlen(arr: np.ndarray, return_counts: bool = False):
     """Allgather variable-length per-process host rows; returns the global
-    concatenation (process order) on every process.
+    concatenation (process order) on every process — with ``return_counts``
+    also the per-process row counts (to re-split the concat).
 
     The reference syncs init statistics with Network::Allreduce
     (objective_function.cpp ObtainAutomaticInitialScore); here the full
@@ -188,9 +189,10 @@ def allgather_host_varlen(arr: np.ndarray) -> np.ndarray:
     padded = np.zeros((mx,) + arr.shape[1:], arr.dtype)
     padded[: arr.shape[0]] = arr
     gathered = allgather_host_exact(padded)  # [nproc, mx, ...]
-    return np.concatenate(
+    out = np.concatenate(
         [gathered[i, : int(c)] for i, c in enumerate(counts)], axis=0
     )
+    return (out, counts) if return_counts else out
 
 
 def allgather_host_exact(arr: np.ndarray) -> np.ndarray:

@@ -101,3 +101,53 @@ def test_dask_estimators_constructible():
     est = lgb.DaskLGBMRegressor(n_estimators=3)
     with pytest.raises(ValueError, match="client"):
         est.fit([[0.0]], [0.0])
+
+
+def test_cli_save_binary_round_trip(tmp_path):
+    """task=save_binary writes a binary dataset that Dataset(path) later
+    auto-detects (reference: application.cpp TaskType::kSaveBinary +
+    DatasetLoader binary-magic sniffing)."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 4))
+    y = X[:, 0]
+    rows = [
+        "\t".join([f"{yy:.6f}"] + [f"{v:.6f}" for v in r])
+        for yy, r in zip(y, X)
+    ]
+    (tmp_path / "train.tsv").write_text("\n".join(rows))
+    from lightgbm_tpu.cli import main
+
+    main(
+        [
+            "task=save_binary",
+            f"data={tmp_path/'train.tsv'}",
+            f"output_model={tmp_path/'d.bin'}",
+            "header=false",
+            "label_column=0",
+            "verbosity=-1",
+        ]
+    )
+    d = lgb.Dataset(str(tmp_path / "d.bin"), params={"verbosity": -1})
+    d.construct()
+    assert d.num_data == 300 and d.num_total_features == 4
+    b = lgb.train({"objective": "regression", "verbosity": -1}, d, 3)
+    assert b.num_trees() == 3
+
+
+def test_binary_dataset_guard_rails(tmp_path):
+    """Binary datasets: explicit fields override the pickled metadata; a
+    reference= binary load is rejected (its bins cannot be re-mapped)."""
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(200, 3))
+    y = X[:, 0]
+    d = lgb.Dataset(X, y)
+    d.construct()
+    f = str(tmp_path / "d.bin")
+    d.save_binary(f)
+    y2 = -y
+    d2 = lgb.Dataset(f, label=y2)
+    d2.construct()
+    np.testing.assert_array_equal(d2.get_label(), y2)
+    ref = lgb.Dataset(X, y)
+    with pytest.raises(ValueError, match="bin mappers"):
+        lgb.Dataset(f, reference=ref).construct()

@@ -243,3 +243,61 @@ def test_two_process_pre_partition_training(tmp_path):
     ss, sv = structure_and_values(b.model_to_string())
     assert ws == ss, "multi-process split structure != single-process"
     np.testing.assert_allclose(wv, sv, rtol=1e-4, atol=1e-5)
+
+
+BAGQ_TMPL = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, "__REPO__")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    import hashlib
+    import numpy as np
+    from lightgbm_tpu.parallel import init_distributed
+
+    init_distributed()
+    rank = jax.process_index()
+    rng = np.random.default_rng(44)
+    n = 1200
+    X = rng.integers(0, 63, size=(n, 4)).astype(np.float64)
+    y = rng.integers(0, 4, n).astype(float)
+    lo, hi = rank * 600, (rank + 1) * 600
+    grp = np.full(30, 20)
+    import lightgbm_tpu as lgb
+
+    params = dict(
+        objective="lambdarank", tree_learner="data", pre_partition=True,
+        bagging_by_query=True, bagging_fraction=0.5, bagging_freq=1,
+        verbosity=-1, metric="none", max_bin=63,
+    )
+    d = lgb.Dataset(X[lo:hi], y[lo:hi], group=grp, params=params)
+    b = lgb.train(params, d, 5)
+    ms = b.model_to_string()
+    print(f"MODELHASH {hashlib.sha256(ms.encode()).hexdigest()}")
+    """
+)
+
+
+def test_two_process_bagging_by_query(tmp_path):
+    """bagging_by_query under pre_partition: every process builds the same
+    global per-query mask (allgathered query sizes with per-block pad
+    pseudo-queries), so models must be bit-identical across processes."""
+    script = tmp_path / "bagq_worker.py"
+    script.write_text(BAGQ_TMPL.replace("__REPO__", REPO_ROOT))
+    from lightgbm_tpu.parallel.launcher import launch_collect
+
+    rc, outputs = launch_collect(
+        2, [sys.executable, str(script)], coordinator_port=29527
+    )
+    assert rc == 0, outputs
+    digests = []
+    for out in outputs:
+        for line in out.splitlines():
+            if line.startswith("MODELHASH"):
+                digests.append(line.split()[1][:64])
+    assert len(digests) == 2, f"expected a digest per worker: {outputs}"
+    assert len(set(digests)) == 1, f"models differ across processes: {digests}"

@@ -106,3 +106,40 @@ def test_partition_kernel_sequential_tree_stress():
         )
         nl = int(nl_k)
         segments += [(sb, nl), (sb + nl, cnt - nl)]
+
+
+def test_partition_kernel_gl_vec_matches_sort():
+    """Bits-fed kernel variant (feature-parallel seg): partitioning by a
+    precomputed go-left vector must be bit-identical to the column-reading
+    sort path given the same bits."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    f, n = 9, 40_000
+    n_pad = padded_rows(n)
+    bins = rng.integers(0, 256, size=(n, f)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = np.ones(n, np.float32)
+    m = np.ones(n, np.float32)
+    seg = pack_rows(
+        jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m),
+        n_pad,
+    )
+    for sb, cnt, feat, tbin in ((0, n, 3, 120), (137, 7000, 5, 40)):
+        colv = np.zeros(n_pad, np.int64)
+        colv[:n] = bins[:, feat]
+        glv = jnp.asarray((colv <= tbin).astype(np.float32))
+        catm = jnp.zeros((1, 256), jnp.float32)
+        scal = jnp.asarray([sb, cnt, feat, tbin, 0, -1, 0, 0], jnp.int32)
+        got, nl_k = seg_partition_pallas(
+            seg, scal, catm, glv, f=f, n_pad=n_pad, use_cat=False,
+            interpret=True,
+        )
+        want, nl_s, _ = sort_partition_xla(
+            seg, jnp.int32(sb), jnp.int32(cnt), jnp.int32(feat),
+            jnp.int32(tbin), jnp.int32(0), jnp.int32(-1), jnp.int32(0),
+            jnp.zeros((1,), jnp.float32), f=f, n_pad=n_pad,
+        )
+        assert int(nl_k) == int(nl_s)
+        assert np.array_equal(np.asarray(got), np.asarray(want))

@@ -83,6 +83,23 @@ def parity_native():
         )
     print("partition kernel NATIVE parity: bit-identical to sort path")
 
+    # bits-fed variant (feature-parallel seg)
+    colv = np.zeros(n_pad, np.int64)
+    colv[:n] = bins[:, 3]
+    glv = jnp.asarray((colv <= 120).astype(np.float32))
+    scal = jnp.asarray([0, n, 3, 120, 0, -1, 0, 0], jnp.int32)
+    got, nl_k = seg_partition_pallas(
+        seg, scal, catm, glv, f=f, n_pad=n_pad, use_cat=False
+    )
+    want, nl_s, _ = sort_partition_xla(
+        seg, jnp.int32(0), jnp.int32(n), jnp.int32(3), jnp.int32(120),
+        jnp.int32(0), jnp.int32(-1), jnp.int32(0),
+        jnp.asarray(catm_narrow), f=f, n_pad=n_pad,
+    )
+    assert int(nl_k) == int(nl_s)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+    print("bits-fed partition kernel NATIVE parity: bit-identical")
+
     # -- seg histogram (bf16 three-term) native tolerance
     hs = seg_hist_pallas(
         seg, jnp.asarray([137, 60_000], jnp.int32), f=f, num_bins=256,
