@@ -367,3 +367,30 @@ def test_seg_vmem_gate():
     assert not seg_vmem_ok(100, 4096)  # 18 MB acc — must fall back
     assert not seg_vmem_ok(121, 65536)
     assert not seg_vmem_ok(4, 65536, has_cat=True)  # cat one-hot blows up
+
+
+def test_wide_seg_hist_int8_quantized(packed_wide):
+    """wide (u16) planes + int8 grid accumulation together: counts exact,
+    g/h equal to integer sums times the grid scales."""
+    from lightgbm_tpu.ops.pallas.seg import seg_hist_pallas
+
+    p = packed_wide
+    rng = np.random.default_rng(29)
+    gs, hs = np.float32(0.041), np.float32(0.003)
+    kq = rng.integers(-63, 64, size=p["n"]).astype(np.float32)
+    hq = rng.integers(0, 64, size=p["n"]).astype(np.float32)
+    seg = pack_rows(
+        jnp.asarray(p["bins"]), jnp.asarray(kq * gs), jnp.asarray(hq * hs),
+        jnp.asarray(p["m"]), p["n_pad"], wide=True,
+    )
+    out = seg_hist_pallas(
+        seg, jnp.asarray([17, 1500], jnp.int32),
+        jnp.asarray([gs, hs], jnp.float32),
+        f=p["f"], num_bins=p["b"], n_pad=p["n_pad"],
+        quantized=True, wide=True, interpret=True,
+    )
+    bo, go, ho, mo, _ = unpack_stats(seg[:, 17:17 + 1500], p["f"], wide=True)
+    ref = leaf_histogram_segment(bo, go, ho, mo, p["b"])
+    got = np.asarray(out)
+    assert np.array_equal(got[:, :, 2], np.asarray(ref)[:, :, 2])
+    assert np.allclose(got, np.asarray(ref), rtol=1e-6, atol=1e-6)

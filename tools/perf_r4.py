@@ -4,13 +4,15 @@
                                   # tools/PERF_R4_RESULTS.md as it goes
 
 Individual modes: parity (native partition + int8 + forest-walk bit/close
-checks), part (partition perf), train [rows] [iters], overhead (ms/split
-fixed-cost row sweep), profile [rows], predict, all.
+checks), part (partition perf), train [rows] [iters], train_int8 [rows]
+(quantized A/B), overhead (ms/split fixed-cost row sweep), profile [rows],
+predict, all.
 
 Every timing uses the marginal-rep method (axon result caching + dispatch
 variance make naive timings lie — see BENCH_NOTES).  `all` orders steps by
 priority so a mid-run tunnel death still leaves the headline numbers:
-train@10.5M -> predict -> parity -> part -> overhead -> profile.
+train@10.5M -> train@1M -> train_int8@10.5M -> predict -> parity -> part
+-> overhead -> profile.
 """
 
 import io
@@ -195,9 +197,38 @@ def bench_overhead():
     )
 
 
+def bench_train_int8(rows, iters=8):
+    """Quantized training with the int8 seg-hist grid kernel — the measured
+    A/B against bench_train's bf16 path (expected ~2x histogram
+    throughput).  Identical data/shape/warmup to bench_train: the only
+    delta is the quantized-gradient int8 kernel, so the two numbers are
+    directly comparable."""
+    import perf_r3
+    import lightgbm_tpu as lgb
+
+    orig = perf_r3._make_booster
+
+    def _mk(rows_):
+        b = orig(rows_)
+        params = {
+            **b.params,
+            "use_quantized_grad": True,
+            "hist_method": "pallas_int8",
+        }
+        return lgb.Booster(params, b.train_set)
+
+    perf_r3._make_booster = _mk
+    try:
+        print("int8 ", end="")
+        bench_train(rows, iters)
+    finally:
+        perf_r3._make_booster = orig
+
+
 _STEPS = [
     ("train_10p5M", lambda: bench_train(10_500_000, 8)),
     ("train_1M", lambda: bench_train(1_000_000, 8)),
+    ("train_10p5M_int8", lambda: bench_train_int8(10_500_000, 8)),
     ("predict", lambda: bench_predict()),
     ("parity_native", parity_native),
     ("partition_perf", bench_partition),
@@ -242,6 +273,11 @@ if __name__ == "__main__":
     elif mode == "train":
         bench_train(int(sys.argv[2]) if len(sys.argv) > 2 else 10_500_000,
                     int(sys.argv[3]) if len(sys.argv) > 3 else 8)
+    elif mode == "train_int8":
+        bench_train_int8(
+            int(sys.argv[2]) if len(sys.argv) > 2 else 10_500_000,
+            int(sys.argv[3]) if len(sys.argv) > 3 else 8,
+        )
     elif mode == "overhead":
         bench_overhead()
     elif mode == "profile":
