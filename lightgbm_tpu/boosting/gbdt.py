@@ -2169,6 +2169,28 @@ class Booster:
                     )
                 except ValueError:
                     pass
+        # parameters block round-trips (reference GBDT::LoadModelFromString
+        # restores loaded_parameter_); explicitly passed ctor params win,
+        # alias-aware (shrinkage_rate passed + learning_rate in the file
+        # must not override each other)
+        head, marker, rest = s.rpartition("\nparameters:\n")
+        file_params = {}
+        if marker:
+            from ..config import _PARAM_ALIASES as PARAM_ALIASES
+
+            have = {
+                PARAM_ALIASES.get(str(k), str(k)) for k in self.params
+            }
+            for line in rest.partition("end of parameters")[0].splitlines():
+                line = line.strip()
+                if line.startswith("[") and line.endswith("]") and ":" in line:
+                    pk, pv = line[1:-1].split(":", 1)
+                    pk = pk.strip()
+                    if PARAM_ALIASES.get(pk, pk) not in have:
+                        file_params[pk] = pv.strip()
+        if file_params:
+            self.params.update(file_params)
+            self.config = Config.from_params(self.params)
         header, _, rest = s.partition("Tree=")
         kv = {}
         for line in header.splitlines():

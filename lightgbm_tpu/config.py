@@ -240,7 +240,10 @@ def _to_int_list(v: Any) -> List[int]:
         return []
     if isinstance(v, (list, tuple)):
         return [int(x) for x in v]
-    return [int(x) for x in str(v).split(",") if x != ""]
+    # "(1,0,-1)" / "[1, 0, -1]" forms round-trip from the model file's
+    # parameters block (python repr of a list param)
+    sv = str(v).strip().strip("[]()")
+    return [int(x) for x in sv.split(",") if x.strip() != ""]
 
 
 def _to_float_list(v: Any) -> List[float]:
@@ -248,6 +251,7 @@ def _to_float_list(v: Any) -> List[float]:
         return []
     if isinstance(v, (list, tuple)):
         return [float(x) for x in v]
+    v = str(v).strip().strip("[]()")
     return [float(x) for x in str(v).split(",") if x != ""]
 
 

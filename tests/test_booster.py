@@ -211,3 +211,25 @@ def test_constructed_dataset_rejects_conflicting_binning_params():
         )
     # same params re-train is fine
     lgb.train({"objective": "regression", "verbosity": -1, "max_bin": 63}, d, 2)
+
+
+def test_parameters_block_round_trips():
+    """Loaded boosters keep the parameters block on re-save (reference
+    GBDT::LoadModelFromString restores loaded_parameter_), including
+    list-valued params; explicitly passed ctor params (alias-aware) win."""
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, size=(600, 3))
+    y = X[:, 0] - X[:, 2]
+    p = {
+        "objective": "regression",
+        "verbosity": -1,
+        "monotone_constraints": [1, 0, -1],
+        "metric": "none",
+    }
+    b = lgb.train(p, lgb.Dataset(X, y, params=p), 4)
+    s1 = b.model_to_string()
+    b2 = lgb.Booster(model_str=s1)
+    assert b2.model_to_string() == s1
+    assert np.array_equal(b.predict(X), b2.predict(X))
+    b3 = lgb.Booster(params={"shrinkage_rate": 0.3}, model_str=s1)
+    assert float(b3.config.learning_rate) == 0.3

@@ -80,7 +80,7 @@ def bench_partition():
         )
 
 
-def _make_booster(rows):
+def _make_booster(rows, extra_params=None):
     import lightgbm_tpu as lgb
 
     rng = np.random.default_rng(42)
@@ -96,6 +96,8 @@ def _make_booster(rows):
         "verbosity": -1,
         "metric": "none",
     }
+    if extra_params:
+        params.update(extra_params)
     d = lgb.Dataset(X, y, params=params)
     return lgb.Booster(params, d)
 

@@ -204,18 +204,17 @@ def bench_train_int8(rows, iters=8):
     delta is the quantized-gradient int8 kernel, so the two numbers are
     directly comparable."""
     import perf_r3
-    import lightgbm_tpu as lgb
 
     orig = perf_r3._make_booster
 
     def _mk(rows_):
-        b = orig(rows_)
-        params = {
-            **b.params,
-            "use_quantized_grad": True,
-            "hist_method": "pallas_int8",
-        }
-        return lgb.Booster(params, b.train_set)
+        return orig(
+            rows_,
+            extra_params={
+                "use_quantized_grad": True,
+                "hist_method": "pallas_int8",
+            },
+        )
 
     perf_r3._make_booster = _mk
     try:
