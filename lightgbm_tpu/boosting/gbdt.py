@@ -472,7 +472,7 @@ class Booster:
         # recorded category orders (pandas categoricals / Arrow dictionary
         # columns) so predict on a fresh frame remaps codes identically
         self.pandas_categorical = (
-            train_set.pandas_categorical
+            getattr(train_set, "pandas_categorical", None)
             or getattr(train_set, "arrow_categories", None)
         )
         self.average_output = cfg.boosting == "rf"
@@ -2178,6 +2178,10 @@ class Booster:
         if marker:
             from ..config import _PARAM_ALIASES as PARAM_ALIASES
 
+            # a RELOAD must not keep the previous file's params: only the
+            # user's own (non-file) params shield against the new file
+            for k in getattr(self, "_file_param_keys", ()):
+                self.params.pop(k, None)
             have = {
                 PARAM_ALIASES.get(str(k), str(k)) for k in self.params
             }
@@ -2188,7 +2192,8 @@ class Booster:
                     pk = pk.strip()
                     if PARAM_ALIASES.get(pk, pk) not in have:
                         file_params[pk] = pv.strip()
-        if file_params:
+        self._file_param_keys = tuple(file_params)
+        if marker:
             self.params.update(file_params)
             self.config = Config.from_params(self.params)
         header, _, rest = s.partition("Tree=")

@@ -251,8 +251,8 @@ def _to_float_list(v: Any) -> List[float]:
         return []
     if isinstance(v, (list, tuple)):
         return [float(x) for x in v]
-    v = str(v).strip().strip("[]()")
-    return [float(x) for x in str(v).split(",") if x != ""]
+    sv = str(v).strip().strip("[]()")
+    return [float(x) for x in sv.split(",") if x.strip() != ""]
 
 
 def _to_str_list(v: Any) -> List[str]:

@@ -1007,6 +1007,8 @@ class Dataset:
                     "weight": self.metadata.weight,
                     "init_score": self.metadata.init_score,
                     "query_boundaries": self.metadata.query_boundaries,
+                    "arrow_categories": self.arrow_categories,
+                    "pandas_categorical": self.pandas_categorical,
                     "raw": self.raw,
                 },
                 fh,
@@ -1034,6 +1036,8 @@ class Dataset:
         ds.reference = None
         ds.free_raw_data = True
         ds._constructed = True
+        ds.arrow_categories = blob.get("arrow_categories")
+        ds.pandas_categorical = blob.get("pandas_categorical")
         ds.bin_mappers = blob["bin_mappers"]
         ds.used_features = blob["used_features"]
         ds.bins = blob["bins"]
@@ -1067,6 +1071,8 @@ class Dataset:
         ds.reference = self
         ds.free_raw_data = self.free_raw_data
         ds._constructed = True
+        ds.arrow_categories = self.arrow_categories
+        ds.pandas_categorical = self.pandas_categorical
         ds.bin_mappers = self.bin_mappers
         ds.used_features = self.used_features
         ds.bins = self.bins[idx]

@@ -233,3 +233,24 @@ def test_parameters_block_round_trips():
     assert np.array_equal(b.predict(X), b2.predict(X))
     b3 = lgb.Booster(params={"shrinkage_rate": 0.3}, model_str=s1)
     assert float(b3.config.learning_rate) == 0.3
+
+
+def test_model_from_string_reload_swaps_params():
+    """Reloading a different model replaces the previous FILE params (only
+    user-passed ctor params shield against the new file's block)."""
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(400, 3))
+    y = X[:, 0]
+    s = {}
+    for lr in (0.1, 0.5):
+        b = lgb.train(
+            {"objective": "regression", "learning_rate": lr, "verbosity": -1},
+            lgb.Dataset(X, y),
+            3,
+        )
+        s[lr] = b.model_to_string()
+    b = lgb.Booster(model_str=s[0.1])
+    assert float(b.config.learning_rate) == 0.1
+    b.model_from_string(s[0.5])
+    assert float(b.config.learning_rate) == 0.5
+    assert b.model_to_string() == s[0.5]

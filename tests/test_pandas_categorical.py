@@ -162,3 +162,19 @@ def test_reference_style_list_maps_predict():
     df2 = df.copy()
     df2["c"] = df2["c"].cat.reorder_categories(["y", "z", "x"])
     assert np.array_equal(bst.predict(df), bst2.predict(df2))
+
+
+def test_subset_and_binary_keep_category_maps(cat_model, tmp_path):
+    """Dataset.subset / save_binary+load carry the recorded category maps
+    (a subset-trained booster must still remap predict frames)."""
+    df, y, ds, _ = cat_model
+    sub = ds.subset(np.arange(0, len(df), 2))
+    b = lgb.train(
+        {"objective": "regression", "num_leaves": 7, "verbose": -1}, sub, 5
+    )
+    assert b.pandas_categorical == {"c": ["x", "y", "z"]}
+    f = str(tmp_path / "d.bin")
+    ds.save_binary(f)
+    d2 = lgb.Dataset(f)
+    d2.construct()
+    assert d2.pandas_categorical == {"c": ["x", "y", "z"]}
