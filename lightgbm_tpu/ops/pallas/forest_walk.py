@@ -294,7 +294,7 @@ def _walk_kernel(
             fval = (packed >> ((feat & 3) * 8)) & 0xFF
             gl = (fval <= thr) | ((dl != 0) & (nb >= 0) & (fval == nb))
             if has_cat:
-                def cat_gl(g):
+                def cat_gl(g32):
                     # one bitset word per row: 8 word-tables gathered by
                     # node, selected by fval>>5, tested at bit fval&31 (the
                     # vectorized CategoricalDecision, tree.h:346; bins >= the
@@ -313,11 +313,17 @@ def _walk_kernel(
                             for i in range(len(words) // 2)
                         ]
                         bit += 1
-                    catgo = ((words[0] >> (fval & 31)) & 1) != 0
+                    catgo = (words[0] >> (fval & 31)) & 1
                     isc = (p1 >> 28) & 1
-                    return jnp.where(isc != 0, catgo, g)
+                    # i32-operand select: Mosaic cannot truncate to the i1
+                    # operands the direct boolean select would need
+                    return jnp.where(isc != 0, catgo, g32)
 
-                gl = lax.cond(tree_cat, cat_gl, lambda g: g, gl)
+                # the cond carries i32, not i1: Mosaic cannot legalize an
+                # scf.if whose result is an i1 vector
+                gl = lax.cond(
+                    tree_cat, cat_gl, lambda g: g, gl.astype(jnp.int32)
+                ) != 0
             p2 = _lookup(pk2, curc, h)
             child = jnp.where(gl, p2 & 0xFFFF, (p2 >> 16) & 0xFFFF) - m_nodes
             return jnp.where(cur >= 0, child, cur)

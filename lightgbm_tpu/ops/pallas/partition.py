@@ -104,7 +104,11 @@ def _seg_partition_kernel(
     nt = (off + cnt + T - 1) // T
 
     iota_j = jax.lax.broadcasted_iota(jnp.int32, (1, T), 1)
-    iota_w = jax.lax.broadcasted_iota(jnp.float32, (T, W), 1)
+    # tpu.iota only produces integers; cast for the f32 dest compare.
+    # [W, T] orientation: dest stays a [1, T] row (Mosaic cannot legalize
+    # the [1, T] -> [T, 1] transpose) and the compact matmul contracts the
+    # shared T dim of lo/hi and Q ("NT" form).
+    iota_q = jax.lax.broadcasted_iota(jnp.int32, (W, T), 0).astype(jnp.float32)
 
     stage_lo[...] = jnp.zeros_like(stage_lo)
     stage_hi[...] = jnp.zeros_like(stage_hi)
@@ -125,18 +129,19 @@ def _seg_partition_kernel(
             preferred_element_type=jnp.float32,
         )  # [1, T] inclusive cumsum
         nkeep = csum[0, T - 1].astype(jnp.int32)
-        dest = csum + (fill - 1).astype(jnp.float32)  # [1, T]
-        dest_col = jnp.transpose(dest)  # [T, 1]
-        keep_col = jnp.transpose(keep)  # [T, 1] bool
-        P = jnp.where(
-            keep_col & (dest_col == iota_w), jnp.bfloat16(1), jnp.bfloat16(0)
-        )  # [T, W]
+        # fold `keep` into dest arithmetically (dropped rows -> -1, matching
+        # no staging lane): kept rows have csum >= 1 so dest >= fill >= 0
+        keep32 = keep.astype(jnp.float32)
+        dest = (csum + (fill - 1).astype(jnp.float32)) * keep32 - (
+            1.0 - keep32
+        )  # [1, T]
+        Q = (iota_q == dest).astype(jnp.bfloat16)  # [W, T] one-hot rows
         slo[...] += jax.lax.dot_general(
-            lo, P, dimension_numbers=(((1,), (0,)), ((), ())),
+            lo, Q, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         shi[...] += jax.lax.dot_general(
-            hi, P, dimension_numbers=(((1,), (0,)), ((), ())),
+            hi, Q, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         return fill + nkeep
@@ -202,14 +207,30 @@ def _seg_partition_kernel(
             dma.wait()
             go = gl_stage[...] > 0.5  # [1, T]
         else:
+            # Mosaic has no value-level dynamic_slice: extract the feature's
+            # lane with a one-hot row matmul over the exact bf16 byte planes
+            # (0..255 each — the MXU as a dynamic row gather)
+            lane = feat if wide else feat >> 1
+            lane_oh = (
+                jax.lax.broadcasted_iota(jnp.int32, (1, sub), 1) == lane
+            ).astype(jnp.bfloat16)
+            xlo, xhi = _bytes_bf16(xu)
+            row_lo = jax.lax.dot_general(
+                lane_oh, xlo, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)  # [1, T]
+            row_hi = jax.lax.dot_general(
+                lane_oh, xhi, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
             if wide:
                 # one u16 plane per feature (max_bin > 256)
-                colv = jax.lax.dynamic_slice(xu, (feat, 0), (1, T))  # [1, T]
+                colv = row_lo | (row_hi << 8)  # [1, T]
             else:
-                lane = feat >> 1
-                sh = (feat & 1) * 8
-                colrow = jax.lax.dynamic_slice(xu, (lane, 0), (1, T))
-                colv = (colrow >> sh) & 0xFF
+                # scalar-cond select over a vector fails Mosaic
+                # legalization; broadcast the condition first
+                odd = jnp.broadcast_to((feat & 1) != 0, row_lo.shape)
+                colv = jnp.where(odd, row_hi, row_lo)
             go = (colv <= tbin) | ((dl != 0) & (nanb >= 0) & (colv == nanb))
             if use_cat:
                 oh = (
@@ -220,7 +241,13 @@ def _seg_partition_kernel(
                     dimension_numbers=(((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
                 )  # [1, T]
-                go = jnp.where(iscat != 0, catv > 0.5, go)
+                # select over f32 operands: an i1-operand select needs an
+                # i1 truncation Mosaic does not implement
+                gof = jnp.where(
+                    jnp.broadcast_to(iscat != 0, go.shape),
+                    catv, go.astype(jnp.float32),
+                )
+                go = gof > 0.5
         keep_l = (rpos < off) | (in_seg & go)
         keep_r = jnp.logical_not(keep_l)
         nl = nl + jnp.sum((in_seg & go).astype(jnp.int32))
@@ -297,7 +324,8 @@ def seg_partition_pallas(
     outside the window keeps its value.
     """
     use_gl = gl_vec is not None
-    sub = 2 * ((used_lanes(f, wide) + 1) // 2)
+    # Mosaic requires second-minor DMA slice shapes in 8-sublane multiples
+    sub = -(-used_lanes(f, wide) // 8) * 8
     lanes = seg.shape[0]
     tri = jnp.tril(jnp.ones((T, T), jnp.bfloat16)).T  # tri[i, j] = i <= j
     gl_arr = (

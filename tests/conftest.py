@@ -18,7 +18,11 @@ if "xla_force_host_platform_device_count" not in _flags:
 # Run the whole suite on the virtual CPU mesh: correctness tests don't need
 # the (remote-tunneled, slow-compile) TPU, and serial-vs-sharded comparisons
 # must run on ONE platform so reduction-order diffs don't flip tied splits.
-os.environ["JAX_PLATFORMS"] = "cpu"
+# LGBM_TPU_NATIVE=1 keeps the TPU visible instead, expanding the suite with
+# the `native_tpu` tier:  LGBM_TPU_NATIVE=1 pytest -m native_tpu
+_NATIVE_RUN = os.environ.get("LGBM_TPU_NATIVE") == "1"
+if not _NATIVE_RUN:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 # The env var alone is NOT enough: a TPU-tunnel shim (sitecustomize) may have
 # already set the jax_platforms CONFIG to prefer its backend, which overrides
@@ -27,10 +31,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # initializes — jax may be imported, but its backends are still lazy here.
 import jax  # noqa: E402
 
-try:
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
+if not _NATIVE_RUN:
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
 
 # Persistent compilation cache: the full suite compiles ~1000+ XLA programs
 # in one process, which can segfault XLA:CPU's LLVM JIT near the end of the
@@ -54,6 +59,11 @@ def pytest_configure(config):
         "markers",
         "slow: compile-heavy / multi-process tests — the default tier is "
         "`-m 'not slow'` (<5 min); run the full suite without the filter",
+    )
+    config.addinivalue_line(
+        "markers",
+        "native_tpu: needs a real TPU; run with "
+        "`LGBM_TPU_NATIVE=1 pytest -m native_tpu` when hardware is attached",
     )
 
 
@@ -137,11 +147,29 @@ _SLOW_TESTS = {
 def pytest_collection_modifyitems(config, items):
     import pytest as _pytest
 
+    on_tpu = False
+    if _NATIVE_RUN:
+        # time-box the device probe: the axon tunnel can be down for hours
+        # and jax.devices() blocks inside backend init, which would hang
+        # collection of the whole suite
+        from concurrent.futures import ThreadPoolExecutor
+
+        try:
+            with ThreadPoolExecutor(max_workers=1) as ex:
+                devs = ex.submit(jax.devices).result(timeout=60)
+            on_tpu = any(d.platform == "tpu" for d in devs)
+        except Exception:
+            on_tpu = False
+    skip_native = _pytest.mark.skip(
+        reason="needs a real TPU (set LGBM_TPU_NATIVE=1 with hardware attached)"
+    )
     for item in items:
         rel = item.nodeid.split("/")[-1]
         base = rel.split("[")[0]
         if rel in _SLOW_TESTS or base in _SLOW_TESTS:
             item.add_marker(_pytest.mark.slow)
+        if "native_tpu" in item.keywords and not on_tpu:
+            item.add_marker(skip_native)
 
 
 @pytest.fixture(scope="session")

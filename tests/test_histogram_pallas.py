@@ -15,9 +15,6 @@ import jax.numpy as jnp  # noqa: E402
 from lightgbm_tpu.ops.histogram import leaf_histogram_segment  # noqa: E402
 from lightgbm_tpu.ops.pallas.histogram import histogram_pallas  # noqa: E402
 
-_ON_TPU = any(d.platform == "tpu" for d in jax.devices())
-
-
 def _problem(n, f, b, seed=0, mask_frac=0.8, grad_scale=1.0):
     rng = np.random.default_rng(seed)
     bins = rng.integers(0, b, size=(n, f), dtype=np.int32)
@@ -55,7 +52,7 @@ def test_pallas_interpret_matches_segment(n, f, b):
     np.testing.assert_allclose(got[..., 2], ref[..., 2], rtol=0, atol=1e-3)
 
 
-@pytest.mark.skipif(not _ON_TPU, reason="needs a real TPU for the native kernel")
+@pytest.mark.native_tpu
 @pytest.mark.parametrize("n,f,b", CASES)
 def test_pallas_native_matches_segment(n, f, b):
     bins, grad, hess, mask = _problem(n, f, b, seed=7)
@@ -68,7 +65,7 @@ def test_pallas_native_matches_segment(n, f, b):
     np.testing.assert_allclose(got[..., 2], ref[..., 2], rtol=0, atol=0.01)
 
 
-@pytest.mark.skipif(not _ON_TPU, reason="needs a real TPU for the native kernel")
+@pytest.mark.native_tpu
 def test_pallas_native_all_masked_and_large_grads():
     n, f, b = 1024, 8, 32
     bins, grad, hess, _ = _problem(n, f, b, seed=3, grad_scale=1e3)
