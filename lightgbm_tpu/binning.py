@@ -179,6 +179,94 @@ def _find_bin_zero_as_one(
     return out
 
 
+def _find_bin_forced(
+    values: np.ndarray,
+    counts_total: int,
+    max_bin: int,
+    min_data_in_bin: int,
+    forced: List[float],
+) -> List[float]:
+    """Numerical binning honoring user-forced bin upper bounds.
+
+    Reference: FindBinWithPredefinedBin (src/io/bin.cpp:161-244) — seed the
+    bound list with the zero-band bounds plus the forced bounds (capped at
+    max_bin), then spread the remaining bin budget across the regions
+    BETWEEN consecutive seeded bounds proportionally to their sample mass,
+    greedy-binning each region independently.
+    """
+    values = values[np.isfinite(values)]
+    dv, cnt = np.unique(values, return_counts=True)
+    n = len(dv)
+    n_zero = counts_total - len(values)
+    if n_zero > 0:
+        # implied zeros from sparse inputs join the distinct-value list
+        zi = int(np.searchsorted(dv, 0.0))
+        if zi < n and dv[zi] == 0.0:
+            cnt[zi] += n_zero
+        else:
+            dv = np.insert(dv, zi, 0.0)
+            cnt = np.insert(cnt, zi, n_zero)
+            n += 1
+    if n == 0:
+        return [np.inf]
+
+    # zero-band bounds (bin.cpp:168-200)
+    left_cnt = int(np.searchsorted(dv, -K_ZERO_THRESHOLD, side="right"))
+    right_start = int(np.searchsorted(dv, K_ZERO_THRESHOLD, side="right"))
+    has_right = right_start < n
+    bounds: List[float] = []
+    if max_bin == 2:
+        bounds.append(K_ZERO_THRESHOLD if left_cnt == 0 else -K_ZERO_THRESHOLD)
+    elif max_bin >= 3:
+        if left_cnt > 0:
+            bounds.append(-K_ZERO_THRESHOLD)
+        if has_right:
+            bounds.append(K_ZERO_THRESHOLD)
+    bounds.append(np.inf)
+
+    # forced bounds, zeros excluded, capped at the remaining budget
+    max_to_insert = max_bin - len(bounds)
+    inserted = 0
+    for b in forced:
+        if inserted >= max_to_insert:
+            break
+        if abs(b) > K_ZERO_THRESHOLD:
+            bounds.append(float(b))
+            inserted += 1
+    bounds.sort()
+
+    # spread the remaining budget across inter-bound regions (bin.cpp:218)
+    free_bins = max_bin - len(bounds)
+    to_add: List[float] = []
+    value_ind = 0
+    total = max(1, counts_total)
+    for i, ub in enumerate(bounds):
+        start = value_ind
+        cnt_in_bin = 0
+        while value_ind < n and dv[value_ind] < ub:
+            cnt_in_bin += int(cnt[value_ind])
+            value_ind += 1
+        remaining = max_bin - len(bounds) - len(to_add)
+        if i == len(bounds) - 1:
+            sub = remaining + 1
+        else:
+            sub = min(int(round(cnt_in_bin * free_bins / total)), remaining) + 1
+        if sub > 1 and value_ind > start:
+            new_b = _greedy_find_bin(
+                dv[start:value_ind], cnt[start:value_ind], sub,
+                cnt_in_bin, min_data_in_bin,
+            )
+            to_add.extend(new_b[:-1])  # last bound is +inf
+    bounds.extend(to_add)
+    bounds.sort()
+    # dedupe while preserving order
+    out: List[float] = []
+    for x in bounds:
+        if not out or x > out[-1]:
+            out.append(x)
+    return out
+
+
 @dataclasses.dataclass
 class BinMapper:
     """Per-feature value->bin mapping (reference: include/LightGBM/bin.h:85)."""
@@ -210,6 +298,7 @@ class BinMapper:
         use_missing: bool = True,
         zero_as_missing: bool = False,
         total_cnt: Optional[int] = None,
+        forced_bounds: Optional[List[float]] = None,
     ) -> "BinMapper":
         values = np.asarray(values, dtype=np.float64).ravel()
         total_cnt = int(total_cnt if total_cnt is not None else len(values))
@@ -239,7 +328,16 @@ class BinMapper:
                 )
             return cls(bin_upper_bound=np.array([np.inf]), num_bins=1)
 
-        if zero_as_missing:
+        if forced_bounds and zero_as_missing:
+            # forced bounds win over the zero-as-missing greedy split, as in
+            # the reference (MissingType::Zero also routes through the
+            # forced overload, bin.cpp:386); the zero/missing bin mapping
+            # below is unchanged
+            bounds = _find_bin_forced(
+                finite, total_cnt - int(nan_mask.sum()), max_bin,
+                min_data_in_bin, forced_bounds,
+            )
+        elif zero_as_missing:
             # zeros are folded into the missing bin: bin the nonzero values,
             # missing bin appended at the end
             nonzero = finite[np.abs(finite) > K_ZERO_THRESHOLD]
@@ -248,6 +346,14 @@ class BinMapper:
             else:
                 dv, cnt = np.unique(nonzero, return_counts=True)
                 bounds = _greedy_find_bin(dv, cnt, max_bin - 1, len(nonzero), min_data_in_bin)
+        elif forced_bounds:
+            # user-forced upper bounds replace the zero-as-one split
+            # entirely (reference FindBinWithZeroAsOneBin's forced overload
+            # dispatches to FindBinWithPredefinedBin, bin.cpp:304-312)
+            bounds = _find_bin_forced(
+                finite, total_cnt - int(nan_mask.sum()), max_bin,
+                min_data_in_bin, forced_bounds,
+            )
         else:
             # total_cnt may exceed len(values) for sparse inputs: the
             # difference is an implied count of zeros (sparse_bin.hpp loaders

@@ -73,6 +73,14 @@ class _EvalEntry:
         return self.dev_bins
 
 
+# forest-walk predict feed size; module-level so tests can shrink it to
+# exercise the multi-chunk lookahead drain without 1M+ rows
+_PREDICT_CHUNK = 1 << 20
+# run the forest-walk kernel in Pallas interpret mode off-TPU (tests only:
+# covers the chunked feed + device-binning pipeline without hardware)
+_WALK_INTERPRET = False
+
+
 class Booster:
     """LightGBM-compatible Booster (train + predict + model IO)."""
 
@@ -1813,7 +1821,7 @@ class Booster:
             walk_reject_reason,
         )
 
-        if _jax.default_backend() != "tpu":
+        if _jax.default_backend() != "tpu" and not _WALK_INTERPRET:
             return None
         n = X.shape[0]
         n_used = len(self.train_set.used_features)
@@ -1856,6 +1864,7 @@ class Booster:
                 n_trees=tables.n_trees,
                 max_depth=tables.max_depth,
                 k=k,
+                interpret=_WALK_INTERPRET,
             )
 
         if dbt is None:
@@ -1867,7 +1876,7 @@ class Booster:
         # prep while chunk i computes overlaps transfer with the walk (the
         # ROUND_NOTES r3 double-buffering plan; jax's async dispatch is the
         # buffer)
-        CHUNK = 1 << 20
+        CHUNK = _PREDICT_CHUNK
         used = self.train_set.used_features
 
         def _bin_chunk(xs_np, x_orig, rows):
@@ -1896,18 +1905,22 @@ class Booster:
             out = _walk(_pack_bins_device(_bin_chunk(xs, X, n), n_pad))
             return unpack_walk_scores(np.asarray(out), n, k).astype(np.float64)
 
-        outs = []
+        # one-chunk lookahead drain: chunk i dispatches asynchronously, then
+        # chunk i-1 transfers to host — compute/transfer overlap without
+        # letting every chunk's device output accumulate in HBM (~32+ MB per
+        # 1M-row chunk; an unbounded predict would OOM the accelerator)
+        parts = []
+        pending = None  # (device_out, rows)
         for lo in range(0, n, CHUNK):
             rows = min(CHUNK, n - lo)
             xo = X[lo : lo + rows]
             xs = np.zeros((CHUNK, len(used)), np.float32)
             xs[:rows] = xo[:, used]
             out = _walk(_pack_bins_device(_bin_chunk(xs, xo, rows), CHUNK))
-            outs.append((out, rows))  # keep device arrays in flight
-        parts = [
-            unpack_walk_scores(np.asarray(o), rows, k)
-            for o, rows in outs
-        ]
+            if pending is not None:
+                parts.append(unpack_walk_scores(np.asarray(pending[0]), pending[1], k))
+            pending = (out, rows)
+        parts.append(unpack_walk_scores(np.asarray(pending[0]), pending[1], k))
         return np.concatenate(parts, axis=0).astype(np.float64)
 
     def _early_stop_type(self, k: int) -> str:
@@ -2086,9 +2099,19 @@ class Booster:
         self,
         num_iteration: Optional[int] = None,
         start_iteration: int = 0,
-        importance_type: str = "split",
+        importance_type: Optional[str] = None,
     ) -> str:
-        """Reference: GBDT::SaveModelToString (gbdt_model_text.cpp:314)."""
+        """Reference: GBDT::SaveModelToString (gbdt_model_text.cpp:314).
+
+        ``importance_type`` defaults to the ``saved_feature_importance_type``
+        param (reference config.h:616 / gbdt.h:169): 0 -> "split", 1 ->
+        "gain"."""
+        if importance_type is None:
+            importance_type = (
+                "gain"
+                if getattr(self.config, "saved_feature_importance_type", 0)
+                else "split"
+            )
         t0, t1 = self._tree_range(start_iteration, num_iteration)
         lines = ["tree"]
         lines.append(f"version={_MODEL_VERSION}")
@@ -2114,10 +2137,10 @@ class Booster:
         body = "\n".join(tree_strs)
         out = "\n".join(lines) + "\n" + body + ("\n" if body else "") + "end of trees\n"
 
-        imp = self.feature_importance(importance_type="split")
+        imp = self.feature_importance(importance_type=importance_type)
         pairs = sorted(
             [
-                (int(imp[i]), self.feature_names[i])
+                (imp[i], self.feature_names[i])
                 for i in range(len(imp))
                 if imp[i] > 0
             ],
@@ -2125,19 +2148,27 @@ class Booster:
         )
         out += "\nfeature_importances:\n"
         for v, name in pairs:
-            out += f"{name}={v}\n"
+            # split counts print as integers (reference
+            # gbdt_model_text.cpp:435 writes size_t; gain writes doubles)
+            out += f"{name}={int(v) if importance_type == 'split' else v}\n"
         out += "\nparameters:\n"
         for key, val in (self.params or {}).items():
             out += f"[{key}: {val}]\n"
         out += "end of parameters\n"
-        # trailing category-order record, same slot as the reference model
-        # file (python-package/lightgbm/basic.py save_model appends
-        # ``pandas_categorical:<json>`` after the parameters block)
+        # trailing category-order record, same slot AND shape as the
+        # reference model file (python-package/lightgbm/basic.py save_model
+        # appends ``pandas_categorical:<json>`` after the parameters block):
+        # a list-of-lists zipped positionally with the frame's categorical
+        # columns — a {name: cats} dict would pass the reference loader's
+        # len check and then silently NaN every category.  Internally the
+        # dict is insertion-ordered by frame column, so values() IS the
+        # positional order; loading accepts both forms.
         import json as _json
 
-        out += "\npandas_categorical:%s\n" % _json.dumps(
-            self.pandas_categorical, default=str
-        )
+        cats = self.pandas_categorical
+        if isinstance(cats, dict):
+            cats = list(cats.values())
+        out += "\npandas_categorical:%s\n" % _json.dumps(cats, default=str)
         return out
 
     def save_model(
@@ -2145,8 +2176,9 @@ class Booster:
         filename: str,
         num_iteration: Optional[int] = None,
         start_iteration: int = 0,
-        importance_type: str = "split",
+        importance_type: Optional[str] = None,
     ) -> "Booster":
+        # None defers to saved_feature_importance_type (model_to_string)
         with open(filename, "w") as f:
             f.write(self.model_to_string(num_iteration, start_iteration, importance_type))
         return self

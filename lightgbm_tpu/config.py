@@ -306,7 +306,9 @@ class Config:
     extra_trees: bool = False
     extra_seed: int = 6
     early_stopping_round: int = 0
+    early_stopping_min_delta: float = 0.0
     first_metric_only: bool = False
+    saved_feature_importance_type: int = 0  # 0=split counts, 1=gain sums
     max_delta_step: float = 0.0
     lambda_l1: float = 0.0
     lambda_l2: float = 0.0
@@ -503,6 +505,21 @@ class Config:
         if self.bagging_freq > 0 and (self.pos_bagging_fraction < 1.0 or self.neg_bagging_fraction < 1.0):
             if self.objective != "binary":
                 raise ValueError("pos/neg bagging fractions require binary objective")
+        if (
+            self.monotone_constraints_method == "advanced"
+            and self.monotone_constraints
+            # all-zero constraints never build adv planes (train-time gate)
+            and any(v != 0 for v in self.monotone_constraints)
+            and self.max_bin > 256
+        ):
+            # adv_planes materializes [refresh_batch, num_leaves, F, B]
+            # slice masks; B > 256 puts that in the tens of GB
+            raise ValueError(
+                "monotone_constraints_method='advanced' supports max_bin <= "
+                "256 (the per-threshold bound planes scale with num_leaves x "
+                "num_features x max_bin); use method='intermediate' or lower "
+                "max_bin"
+            )
 
     @property
     def num_tree_per_iteration(self) -> int:

@@ -716,10 +716,56 @@ class Dataset:
             use_missing=cfg.use_missing,
             zero_as_missing=cfg.zero_as_missing,
             total_cnt=total_cnt,
+            forced_bounds=self._forced_bin_bounds(j, cat_idx),
         )
         self.bin_mappers.append(mapper)
         if not mapper.is_trivial:
             self.used_features.append(j)
+
+    def _forced_bin_bounds(self, j: int, cat_idx: List[int]):
+        """User-forced bin upper bounds for feature j, or None.
+
+        ``forcedbins_filename`` points at a JSON array of
+        ``{"feature": i, "bin_upper_bound": [...]}`` records (reference:
+        DatasetLoader::GetForcedBins, src/io/dataset_loader.cpp:1431);
+        categorical features ignore their record with a warning, duplicate
+        bounds are dropped."""
+        path = getattr(self.config, "forcedbins_filename", "")
+        if not path:
+            return None
+        if getattr(self, "_forced_bins_cache", None) is None:
+            import json
+
+            from .utils.log import log_warning
+
+            table = {}
+            try:
+                with open(path) as fh:
+                    records = json.load(fh)
+            except OSError:
+                log_warning(f"Could not open {path}. Will ignore.")
+                records = []
+            for rec in records:
+                fi = int(rec["feature"])
+                bounds = [float(v) for v in rec.get("bin_upper_bound", [])]
+                # remove consecutive duplicates (reference std::unique)
+                dedup: List[float] = []
+                for b in bounds:
+                    if not dedup or b != dedup[-1]:
+                        dedup.append(b)
+                table[fi] = dedup
+            self._forced_bins_cache = table
+        if j not in self._forced_bins_cache:
+            return None
+        if j in cat_idx:
+            from .utils.log import log_warning
+
+            log_warning(
+                f"Feature {j} is categorical. Will ignore forced bins for "
+                "this feature."
+            )
+            return None
+        return self._forced_bins_cache[j]
 
     def _build_bin_mappers(self, data: np.ndarray, cat_idx: List[int]) -> None:
         cfg = self.config

@@ -68,7 +68,11 @@ def train(
     callbacks = list(callbacks or [])
     if cfg.early_stopping_round and cfg.early_stopping_round > 0:
         callbacks.append(
-            early_stopping(cfg.early_stopping_round, cfg.first_metric_only, verbose=cfg.verbosity > 0)
+            early_stopping(
+                cfg.early_stopping_round, cfg.first_metric_only,
+                verbose=cfg.verbosity > 0,
+                min_delta=cfg.early_stopping_min_delta,
+            )
         )
     if cfg.verbosity > 0 and cfg.metric_freq > 0 and not any(
         getattr(cb, "order", None) == 10 and not getattr(cb, "before_iteration", False)
@@ -342,7 +346,10 @@ def cv(
     results: Dict[str, List[float]] = {}
     callbacks = list(callbacks or [])
     if cfg.early_stopping_round and cfg.early_stopping_round > 0:
-        callbacks.append(early_stopping(cfg.early_stopping_round, cfg.first_metric_only, verbose=False))
+        callbacks.append(early_stopping(
+            cfg.early_stopping_round, cfg.first_metric_only, verbose=False,
+            min_delta=cfg.early_stopping_min_delta,
+        ))
     callbacks_after = sorted(
         [cb for cb in callbacks if not getattr(cb, "before_iteration", False)],
         key=lambda cb: getattr(cb, "order", 0),

@@ -112,3 +112,63 @@ def test_max_bin_respected():
         m = BinMapper.from_sample(vals, max_bin=mb)
         assert m.num_bins <= mb + 1  # +1 for potential nan bin
         assert m.values_to_bins(vals).max() < m.num_bins
+
+
+def test_forced_bounds_in_mapper():
+    """Forced upper bounds land verbatim in the bound list and the budget
+    for free bins is spread across the regions between them (reference
+    FindBinWithPredefinedBin, bin.cpp:161-244)."""
+    rng = np.random.default_rng(9)
+    vals = rng.uniform(-10, 10, size=20000)
+    m = BinMapper.from_sample(vals, max_bin=32, forced_bounds=[-3.0, 5.5])
+    ub = m.bin_upper_bound
+    assert -3.0 in ub and 5.5 in ub
+    assert len(ub) <= 32 and ub[-1] == np.inf
+    # values straddling a forced bound always land in different bins
+    lo = m.values_to_bins(np.array([-3.0 - 1e-9]))
+    hi = m.values_to_bins(np.array([-3.0 + 1e-6]))
+    assert lo[0] < hi[0]
+    # free bins still subdivide the regions: far more bins than seeds
+    assert len(ub) > 8
+
+
+def test_forced_bounds_cap_at_max_bin():
+    rng = np.random.default_rng(10)
+    vals = rng.uniform(0.5, 10, size=5000)
+    forced = [float(x) for x in np.linspace(1, 9, 50)]
+    m = BinMapper.from_sample(vals, max_bin=8, forced_bounds=forced)
+    assert len(m.bin_upper_bound) <= 8
+    # first forced bounds win (insertion order, reference bin.cpp:206)
+    assert forced[0] in m.bin_upper_bound
+
+
+def test_forcedbins_file_end_to_end(tmp_path):
+    """forcedbins_filename flows from params into the dataset mappers; the
+    categorical record is ignored with a warning (dataset_loader.cpp:1447)."""
+    import json
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(11)
+    X = np.column_stack([
+        rng.uniform(-5, 5, size=3000),
+        rng.integers(0, 6, size=3000).astype(float),
+    ])
+    y = (X[:, 0] > 1.25).astype(float) + rng.normal(scale=0.1, size=3000)
+    f = tmp_path / "forced.json"
+    f.write_text(json.dumps([
+        {"feature": 0, "bin_upper_bound": [1.25, 1.25, 2.5]},
+        {"feature": 1, "bin_upper_bound": [2.0]},
+    ]))
+    params = {
+        "objective": "regression", "verbosity": -1, "max_bin": 16,
+        "forcedbins_filename": str(f), "categorical_feature": [1],
+    }
+    ds = lgb.Dataset(X, y, params=params, categorical_feature=[1])
+    ds.construct()
+    ub0 = ds.bin_mappers[0].bin_upper_bound
+    assert 1.25 in ub0 and 2.5 in ub0
+    assert np.sum(ub0 == 1.25) == 1  # duplicate removed
+    assert ds.bin_mappers[1].is_categorical  # record ignored, still cat
+    b = lgb.train(params, ds, 5)
+    assert np.isfinite(b.predict(X)).all()

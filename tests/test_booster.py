@@ -67,6 +67,44 @@ def test_early_stopping_and_best_iteration_predict():
     assert b.num_trees() == b.current_iteration()
 
 
+def test_early_stopping_min_delta_param():
+    """`early_stopping_min_delta` flows from params into the auto-created
+    callback (reference config.h:405): a huge delta stops almost
+    immediately, a zero delta trains longer on the same data."""
+    d = lgb.Dataset(X[:400], Y_REG[:400], free_raw_data=False)
+    dv = d.create_valid(X[400:], Y_REG[400:])
+    base = {**PARAMS, "objective": "regression", "early_stopping_round": 5}
+    b_zero = lgb.train(base, d, 120, valid_sets=[dv])
+    b_huge = lgb.train(
+        {**base, "early_stopping_min_delta": 1e6}, d, 120, valid_sets=[dv]
+    )
+    assert b_huge.best_iteration == 1  # nothing improves by 1e6
+    assert b_zero.best_iteration > b_huge.best_iteration
+
+
+def test_saved_feature_importance_type_param():
+    """`saved_feature_importance_type=1` writes gain (float) importances to
+    the model file instead of split counts (reference config.h:616)."""
+    d = lgb.Dataset(X, Y_REG)
+    b = lgb.train(
+        {**PARAMS, "objective": "regression",
+         "saved_feature_importance_type": 1}, d, 5
+    )
+    s = b.model_to_string()
+    block = s.split("feature_importances:\n", 1)[1].split("\n\n", 1)[0]
+    vals = [line.split("=")[1] for line in block.strip().splitlines() if "=" in line]
+    assert vals and any("." in v for v in vals), block
+    gains = b.feature_importance(importance_type="gain")
+    assert abs(max(float(v) for v in vals) - gains.max()) < 1e-6 * max(1.0, gains.max())
+    # default (0) keeps integer split counts
+    s0 = lgb.train({**PARAMS, "objective": "regression"}, d, 5).model_to_string()
+    block0 = s0.split("feature_importances:\n", 1)[1].split("\n\n", 1)[0]
+    assert all(
+        "." not in line.split("=")[1]
+        for line in block0.strip().splitlines() if "=" in line
+    )
+
+
 def test_weights_change_model():
     w = np.where(X[:, 0] > 0, 5.0, 0.1)
     d1 = lgb.Dataset(X, Y_REG)

@@ -104,6 +104,25 @@ def test_advanced_not_worse_than_intermediate():
     assert out["advanced"] <= out["intermediate"] * 1.02, out
 
 
+def test_advanced_rejects_wide_bins():
+    """advanced + max_bin > 256 would materialize tens-of-GB per-threshold
+    bound planes; the config rejects the combination with a clear error
+    instead of OOMing mid-train (r4 ADVICE)."""
+    X, y = _make_data()
+    params = {
+        "objective": "regression",
+        "verbosity": -1,
+        "max_bin": 1024,
+        "monotone_constraints": [1, 0, -1, 0],
+        "monotone_constraints_method": "advanced",
+    }
+    with pytest.raises(ValueError, match="advanced"):
+        lgb.train(params, lgb.Dataset(X, y, params=params), 2)
+    # without constraints the method param is inert and wide bins are fine
+    params.pop("monotone_constraints")
+    lgb.train(params, lgb.Dataset(X, y, params=params), 2)
+
+
 def test_advanced_monotone_with_path_smooth():
     """Smoothing is applied BEFORE the monotone clip at finalize; the
     advanced bound recompute must see smoothed outputs or cross-leaf

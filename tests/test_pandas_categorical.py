@@ -73,7 +73,8 @@ def test_model_file_roundtrip_preserves_maps(cat_model, tmp_path):
     f = str(tmp_path / "m.txt")
     bst.save_model(f)
     bst2 = lgb.Booster(model_file=f)
-    assert bst2.pandas_categorical == {"c": ["x", "y", "z"]}
+    # the file stores the reference's positional list-of-lists shape
+    assert bst2.pandas_categorical == [["x", "y", "z"]]
     df2 = df.copy()
     df2["c"] = df2["c"].cat.reorder_categories(["z", "x", "y"])
     assert np.array_equal(bst.predict(df), bst2.predict(df2))
@@ -119,7 +120,7 @@ def test_numeric_categories_survive_model_file(tmp_path):
     f = str(tmp_path / "m.txt")
     bst.save_model(f)
     bst2 = lgb.Booster(model_file=f)
-    assert bst2.pandas_categorical == {"c": [10, 20, 30]}
+    assert bst2.pandas_categorical == [[10, 20, 30]]
     assert np.array_equal(p, bst2.predict(df))
 
 
@@ -153,12 +154,20 @@ def test_reference_style_list_maps_predict():
         num_boost_round=10,
     )
     s = bst.model_to_string()
-    s = s.replace(
-        'pandas_categorical:{"c": ["x", "y", "z"]}',
-        'pandas_categorical:[["x", "y", "z"]]',
-    )
+    # the trailer is written in the reference's list-of-lists shape (zipped
+    # positionally with the frame's categorical columns) so reference-package
+    # loads see categories, not NaNs; a {name: cats} dict form is still
+    # accepted on load
+    assert 'pandas_categorical:[["x", "y", "z"]]' in s
     bst2 = lgb.Booster(model_str=s)
     assert bst2.pandas_categorical == [["x", "y", "z"]]
+    bst3 = lgb.Booster(
+        model_str=s.replace(
+            'pandas_categorical:[["x", "y", "z"]]',
+            'pandas_categorical:{"c": ["x", "y", "z"]}',
+        )
+    )
+    assert bst3.pandas_categorical == {"c": ["x", "y", "z"]}
     df2 = df.copy()
     df2["c"] = df2["c"].cat.reorder_categories(["y", "z", "x"])
     assert np.array_equal(bst.predict(df), bst2.predict(df2))

@@ -129,3 +129,35 @@ def test_loaded_categorical_model_device_walker():
     pu = loaded.predict(Xu)
     assert np.isfinite(pu).all()
     np.testing.assert_allclose(pu, b.predict(Xu), rtol=1e-6)
+
+
+def test_chunked_walk_matches_single_chunk(monkeypatch):
+    """The multi-chunk lookahead drain (chunk i dispatches while chunk i-1
+    transfers) must produce exactly the single-chunk result; CHUNK shrinks
+    so CI exercises the loop without 1M rows."""
+    from lightgbm_tpu.boosting import gbdt as gbdt_mod
+
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(3000, 6))
+    X[::13, 2] = np.nan
+    y = X[:, 0] + np.sin(X[:, 1])
+    b = lgb.train(
+        {"objective": "regression", "verbosity": -1, "num_leaves": 31},
+        lgb.Dataset(X, y),
+        8,
+    )
+    p_one = b.predict(X)
+    monkeypatch.setattr(gbdt_mod, "_PREDICT_CHUNK", 1024)
+    monkeypatch.setattr(gbdt_mod, "_WALK_INTERPRET", True)
+    walked = {}
+    orig = b._forest_walk_raw
+
+    def spy(*a, **kw):
+        r = orig(*a, **kw)
+        walked["hit"] = r is not None
+        return r
+
+    monkeypatch.setattr(b, "_forest_walk_raw", spy)
+    p_chunked = b.predict(X)  # 3000 rows -> 3 chunks, last one ragged
+    assert walked.get("hit"), "chunked walk path was not exercised"
+    np.testing.assert_allclose(p_chunked, p_one, rtol=1e-6, atol=1e-7)
