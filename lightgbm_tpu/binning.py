@@ -328,11 +328,12 @@ class BinMapper:
                 )
             return cls(bin_upper_bound=np.array([np.inf]), num_bins=1)
 
-        if forced_bounds and zero_as_missing:
-            # forced bounds win over the zero-as-missing greedy split, as in
-            # the reference (MissingType::Zero also routes through the
-            # forced overload, bin.cpp:386); the zero/missing bin mapping
-            # below is unchanged
+        if forced_bounds:
+            # user-forced upper bounds replace the greedy split entirely —
+            # including under zero_as_missing (reference: MissingType::Zero
+            # also routes through FindBinWithZeroAsOneBin's forced overload,
+            # bin.cpp:304-312/:386; the zero/missing bin mapping below is
+            # unchanged)
             bounds = _find_bin_forced(
                 finite, total_cnt - int(nan_mask.sum()), max_bin,
                 min_data_in_bin, forced_bounds,
@@ -346,14 +347,6 @@ class BinMapper:
             else:
                 dv, cnt = np.unique(nonzero, return_counts=True)
                 bounds = _greedy_find_bin(dv, cnt, max_bin - 1, len(nonzero), min_data_in_bin)
-        elif forced_bounds:
-            # user-forced upper bounds replace the zero-as-one split
-            # entirely (reference FindBinWithZeroAsOneBin's forced overload
-            # dispatches to FindBinWithPredefinedBin, bin.cpp:304-312)
-            bounds = _find_bin_forced(
-                finite, total_cnt - int(nan_mask.sum()), max_bin,
-                min_data_in_bin, forced_bounds,
-            )
         else:
             # total_cnt may exceed len(values) for sparse inputs: the
             # difference is an implied count of zeros (sparse_bin.hpp loaders

@@ -1066,6 +1066,7 @@ class Booster:
             n_forced=0 if self._forced is None else len(self._forced[0]),
             use_cegb=self._cegb_coupled is not None,
             cegb_split_penalty=cfg.cegb_tradeoff * cfg.cegb_penalty_split,
+            fused_split_scan=cfg.fused_split_scan,
         )
 
     def _fit_linear_leaves(

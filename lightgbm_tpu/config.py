@@ -305,6 +305,10 @@ class Config:
     feature_fraction_seed: int = 2
     extra_trees: bool = False
     extra_seed: int = 6
+    # TPU extension: fuse the best-split scan into the Pallas kernel on the
+    # basic numeric path (targets the per-split fixed cost; default off
+    # pending on-chip measurement — see ops/pallas/split_scan.py)
+    fused_split_scan: bool = False
     early_stopping_round: int = 0
     early_stopping_min_delta: float = 0.0
     first_metric_only: bool = False

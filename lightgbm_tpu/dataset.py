@@ -552,6 +552,8 @@ class Dataset:
 
         max_bins = max((m.num_bins for m in self.bin_mappers), default=1)
         dtype = np.uint8 if max_bins <= 256 else np.uint16
+        self._check_binned_footprint(n, len(self.used_features),
+                                     np.dtype(dtype).itemsize)
         if sparse_csc is not None:
             binned = np.zeros((n, len(self.used_features)), dtype=dtype)
             for ci, j in enumerate(self.used_features):
@@ -722,6 +724,35 @@ class Dataset:
         if not mapper.is_trivial:
             self.used_features.append(j)
 
+    def _check_binned_footprint(self, n: int, n_used: int, itemsize: int):
+        """Enforce the dense-layout memory ceiling with an actionable error.
+
+        The TPU build stores bins as ONE dense [N, F] matrix (module
+        docstring) and has no EFB feature bundling (reference
+        dataset.cpp:111 FindGroups) — a genuinely sparse-wide dataset
+        (e.g. 50k one-hot columns) would materialize hundreds of GB here
+        and OOM deep inside allocation.  Fail early and say what to do:
+        exclusive one-hot blocks carry the same information as ONE
+        integer-coded categorical column, which this build supports
+        natively (categorical_feature= + sorted-subset splits)."""
+        import os
+
+        est = n * max(1, n_used) * itemsize
+        ceiling = int(
+            os.environ.get("LGBM_TPU_MAX_BINNED_BYTES", 16 << 30)
+        )
+        if est > ceiling:
+            raise ValueError(
+                f"binned dataset would need {est / (1 << 30):.1f} GiB "
+                f"({n} rows x {n_used} used features, dense layout) — over "
+                f"the {ceiling / (1 << 30):.1f} GiB ceiling. This build has "
+                "no EFB feature bundling: encode exclusive one-hot column "
+                "blocks as a single integer-coded categorical feature "
+                "(categorical_feature=...), drop empty/constant columns, "
+                "or raise LGBM_TPU_MAX_BINNED_BYTES if the footprint is "
+                "intended."
+            )
+
     def _forced_bin_bounds(self, j: int, cat_idx: List[int]):
         """User-forced bin upper bounds for feature j, or None.
 
@@ -742,18 +773,21 @@ class Dataset:
             try:
                 with open(path) as fh:
                     records = json.load(fh)
-            except OSError:
-                log_warning(f"Could not open {path}. Will ignore.")
-                records = []
-            for rec in records:
-                fi = int(rec["feature"])
-                bounds = [float(v) for v in rec.get("bin_upper_bound", [])]
-                # remove consecutive duplicates (reference std::unique)
-                dedup: List[float] = []
-                for b in bounds:
-                    if not dedup or b != dedup[-1]:
-                        dedup.append(b)
-                table[fi] = dedup
+                for rec in records:
+                    fi = int(rec["feature"])
+                    bounds = [float(v) for v in rec.get("bin_upper_bound", [])]
+                    # remove consecutive duplicates (reference std::unique)
+                    dedup: List[float] = []
+                    for b in bounds:
+                        if not dedup or b != dedup[-1]:
+                            dedup.append(b)
+                    table[fi] = dedup
+            except (OSError, ValueError, TypeError, KeyError, AttributeError):
+                # unreadable OR malformed (bad JSON, wrong shape, missing
+                # keys): warn and ignore, as the reference's GetForcedBins
+                # does — never crash construct()
+                log_warning(f"Could not parse {path}. Will ignore.")
+                table = {}
             self._forced_bins_cache = table
         if j not in self._forced_bins_cache:
             return None

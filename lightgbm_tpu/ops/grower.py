@@ -76,6 +76,11 @@ class GrowerParams:
     # argmax; a negative-gain forced split aborts the remaining forced steps
     # (reference abort_last_forced_split) and normal growth resumes
     n_forced: int = 0
+    # fuse the best-split scan into one Pallas kernel on the basic numeric
+    # path (ops/pallas/split_scan.py — the CUDA FindBestSplitsForLeafKernel
+    # shape); targets the per-split fixed cost, default off pending on-chip
+    # measurement
+    fused_split_scan: bool = False
     # CEGB (cost_effective_gradient_boosting.hpp): per-split data cost is
     # static; the per-feature coupled penalty arrives as a runtime operand
     use_cegb: bool = False
@@ -314,6 +319,38 @@ def _candidate_for_leaf(
     reference voting_parallel_tree_learner.cpp:152 GlobalVoting + :396
     elected-feature ReduceScatter)."""
     f = hist.shape[0]
+    fused_ok = (
+        p.fused_split_scan
+        # basic numeric path only — every feature below changes the gain
+        # math or the candidate set in ways the kernel does not implement
+        and monotone is None
+        and not p.use_cat
+        and not p.use_cegb
+        and not p.extra_trees
+        and p.path_smooth == 0.0
+        and p.max_delta_step == 0.0
+        and lb is None and ub is None and adv is None
+        and not voting_active(p, f)
+        # the kernel unrolls one [16, B] x [B, B] matmul per feature into a
+        # single Mosaic program — cap the program size / VMEM footprint and
+        # fall back to best_split beyond it
+        and f <= 64
+        and p.max_bin <= 256
+    )
+    if fused_ok:
+        from .pallas import split_scan as _ss
+
+        on_tpu = jax.default_backend() == "tpu"
+        if on_tpu or _ss._INTERPRET:
+            return _ss.fused_best_split(
+                hist, g, h, c, num_bins, nan_bins, feature_mask,
+                lambda_l1=p.lambda_l1,
+                lambda_l2=p.lambda_l2,
+                min_data_in_leaf=p.min_data_in_leaf,
+                min_sum_hessian_in_leaf=p.min_sum_hessian_in_leaf,
+                min_gain_to_split=p.min_gain_to_split,
+                interpret=not on_tpu,
+            )
     common = dict(
         lambda_l1=p.lambda_l1,
         lambda_l2=p.lambda_l2,

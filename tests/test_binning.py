@@ -172,3 +172,21 @@ def test_forcedbins_file_end_to_end(tmp_path):
     assert ds.bin_mappers[1].is_categorical  # record ignored, still cat
     b = lgb.train(params, ds, 5)
     assert np.isfinite(b.predict(X)).all()
+
+
+def test_forcedbins_malformed_file_ignored(tmp_path):
+    """Unparseable forced-bins content warns and is ignored — construct()
+    never crashes on it (reference GetForcedBins behavior)."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(500, 3))
+    y = rng.normal(size=500)
+    for content in ("not json[", '{"feature": 0}', '[{"bin_upper_bound": [1]}]'):
+        f = tmp_path / "bad.json"
+        f.write_text(content)
+        p = {"objective": "regression", "verbosity": -1,
+             "forcedbins_filename": str(f)}
+        ds = lgb.Dataset(X, y, params=p)
+        ds.construct()  # must not raise
+        assert len(ds.bin_mappers) == 3

@@ -159,6 +159,18 @@ def _c8(topo):
     )
 
 
+@check("split_scan fused best-split (F=28, B=256)")
+def _c10(topo):
+    from lightgbm_tpu.ops.pallas.split_scan import split_scan_pallas
+
+    return compile_on_topo(
+        topo, split_scan_pallas,
+        s((28, 256, 3), jnp.float32), s((3,), jnp.float32),
+        s((28,), jnp.int32), s((28,), jnp.int32), s((28,), jnp.float32),
+        f=28, num_bins_pad=256, l1=0.1, l2=1.0, min_data=20, min_hess=1e-3,
+    )
+
+
 @check("forest_walk predictor (T=64 trees, F=28, cat)")
 def _c9(topo):
     from lightgbm_tpu.ops.pallas.forest_walk import (

@@ -197,6 +197,50 @@ def bench_overhead():
     )
 
 
+def bench_train_fused(rows, iters=8):
+    """fused_split_scan A/B against bench_train's default scan — the
+    per-split fixed-cost bet (ops/pallas/split_scan.py; VERDICT r4 #4).
+    Identical data/shape/warmup; the only delta is the fused kernel."""
+    import perf_r3
+
+    orig = perf_r3._make_booster
+
+    def _mk(rows_):
+        return orig(rows_, extra_params={"fused_split_scan": True})
+
+    perf_r3._make_booster = _mk
+    try:
+        print("fused ", end="")
+        bench_train(rows, iters)
+    finally:
+        perf_r3._make_booster = orig
+
+
+def parity_native_fused():
+    """Native run of the fused split-scan kernel vs the XLA best_split on a
+    real trained tree: structure equality end-to-end."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(200_000, 28))
+    X[::9, 5] = np.nan
+    y = X[:, 0] + np.sin(X[:, 1]) + 0.3 * np.isnan(X[:, 5])
+    base = {"objective": "regression", "verbosity": -1, "num_leaves": 255,
+            "min_data_in_leaf": 100}
+    b0 = lgb.train(base, lgb.Dataset(X, y, params=base), 4)
+    pf = {**base, "fused_split_scan": True}
+    b1 = lgb.train(pf, lgb.Dataset(X, y, params=pf), 4)
+
+    def _structure(bst):
+        return [
+            line for line in bst.model_to_string().splitlines()
+            if line.startswith(("split_feature=", "threshold="))
+        ]
+
+    assert _structure(b0) == _structure(b1), "fused split-scan tree diverges"
+    print("fused split-scan NATIVE parity: tree structure identical")
+
+
 def bench_train_int8(rows, iters=8):
     """Quantized training with the int8 seg-hist grid kernel — the measured
     A/B against bench_train's bf16 path (expected ~2x histogram
@@ -230,6 +274,8 @@ _STEPS = [
     ("train_10p5M_int8", lambda: bench_train_int8(10_500_000, 8)),
     ("predict", lambda: bench_predict()),
     ("parity_native", parity_native),
+    ("parity_native_fused", parity_native_fused),
+    ("train_10p5M_fused", lambda: bench_train_fused(10_500_000, 8)),
     ("partition_perf", bench_partition),
     ("overhead", bench_overhead),
     ("profile_10p5M", lambda: bench_profile(10_500_000)),

@@ -1,24 +1,35 @@
 #!/bin/bash
 # Probe the axon TPU tunnel every 3 minutes; touch /tmp/tpu_up when alive.
-# The FIRST time the tunnel comes up, immediately run the round-4
-# measurement program (tools/perf_r4.py all — crash-tolerant, appends to
-# tools/PERF_R4_RESULTS.md) so a brief tunnel window still captures the
-# headline numbers. Logs to /tmp/tpu_probe.log.
+# The FIRST time the tunnel comes up, immediately run the measurement
+# program (tools/perf_r4.py all — crash-tolerant, appends to
+# tools/PERF_R4_RESULTS.md), then bench.py (the driver artifact's number)
+# and the native_tpu pytest tier, so a brief tunnel window still captures
+# the headline numbers.  Logs to /tmp/tpu_probe.log.
 cd /root/repo || exit 1
 while true; do
   if timeout 90 python -c "import jax; d=jax.devices(); assert d[0].platform=='tpu'" 2>/dev/null; then
     date -u +"%FT%TZ up" >> /tmp/tpu_probe.log
     touch /tmp/tpu_up
-    if [ ! -f /tmp/perf_r4_done ]; then
+    if [ ! -f /tmp/perf_r5_done ]; then
       date -u +"%FT%TZ launching perf_r4" >> /tmp/tpu_probe.log
-      PYTHONPATH=/root/repo timeout 5400 python tools/perf_r4.py all \
+      PYTHONPATH=/root/repo timeout 7200 python tools/perf_r4.py all \
         >> /tmp/perf_r4.log 2>&1
       rc=$?
       date -u +"%FT%TZ perf_r4 done rc=$rc" >> /tmp/tpu_probe.log
-      # mark done only on success: a tunnel flap mid-run retries next time
-      # it comes up (individual steps are idempotent and append results)
-      if [ "$rc" -eq 0 ]; then
-        touch /tmp/perf_r4_done
+      date -u +"%FT%TZ launching bench.py" >> /tmp/tpu_probe.log
+      timeout 3600 python bench.py > /tmp/bench_tpu.json 2>/tmp/bench_tpu.err
+      brc=$?
+      date -u +"%FT%TZ bench done rc=$brc ($(tail -c 200 /tmp/bench_tpu.json))" >> /tmp/tpu_probe.log
+      date -u +"%FT%TZ launching native_tpu tier" >> /tmp/tpu_probe.log
+      LGBM_TPU_NATIVE=1 timeout 3600 python -m pytest tests -m native_tpu -q \
+        > /tmp/native_tier.log 2>&1
+      nrc=$?
+      date -u +"%FT%TZ native tier done rc=$nrc ($(tail -n 1 /tmp/native_tier.log))" >> /tmp/tpu_probe.log
+      # mark done only when ALL THREE stages succeeded: a tunnel flap
+      # mid-run retries the whole block next time it comes up (steps are
+      # idempotent and append results)
+      if [ "$rc" -eq 0 ] && [ "$brc" -eq 0 ] && [ "$nrc" -eq 0 ]; then
+        touch /tmp/perf_r5_done
       fi
     fi
   else
