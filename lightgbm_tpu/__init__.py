@@ -38,6 +38,7 @@ from .plotting import (
     plot_split_value_histogram,
     plot_tree,
 )
+from .parser import register_parser
 from .utils.log import register_logger
 from .utils.timer import global_timer
 
@@ -63,6 +64,7 @@ __all__ = [
     "reset_parameter",
     "EarlyStopException",
     "register_logger",
+    "register_parser",
     "global_timer",
     "plot_importance",
     "plot_metric",

@@ -376,6 +376,7 @@ class Config:
     forcedbins_filename: str = ""
     save_binary: bool = False
     precise_float_parser: bool = False
+    parser_config_file: str = ""
 
     # Predict
     start_iteration_predict: int = 0

@@ -308,6 +308,44 @@ def _is_binary_dataset_file(path: str) -> bool:
     return head[:1] == b"\x80" and b"lightgbm_tpu.dataset.v1" in head
 
 
+def _label_column_index(config: Config, header_line: Optional[str]) -> int:
+    """Resolve label_column to a 0-based index: plain int, ``column=N``,
+    or the reference's ``name:<colname>`` form (needs the header line)."""
+    if config.label_column in ("", None):
+        return 0
+    lc = str(config.label_column)
+    if lc.startswith("name:"):
+        name = lc[len("name:"):]
+        if not header_line:
+            raise ValueError(
+                "label_column='name:...' requires header=true so the column "
+                "name can be resolved"
+            )
+        delim = "\t" if "\t" in header_line else ","
+        names = [t.strip() for t in header_line.split(delim)]
+        if name not in names:
+            raise ValueError(
+                f"label_column names {name!r} but the header has {names}"
+            )
+        return names.index(name)
+    return int(lc.split("=")[-1]) if "=" in lc else int(lc)
+
+
+def _attach_sidecars(out: Dict[str, Any], path: str) -> Dict[str, Any]:
+    """Load the reference's sidecar files (train.txt.query/.weight/.init)
+    next to any text data file (reference Metadata::LoadQueryBoundaries)."""
+    qpath = Path(str(path) + ".query")
+    if qpath.exists():
+        out["group"] = np.loadtxt(qpath, dtype=np.int64, ndmin=1)
+    wpath = Path(str(path) + ".weight")
+    if wpath.exists():
+        out["weight"] = np.loadtxt(wpath, dtype=np.float64, ndmin=1)
+    ipath = Path(str(path) + ".init")
+    if ipath.exists():
+        out["init_score"] = np.loadtxt(ipath, dtype=np.float64, ndmin=1)
+    return out
+
+
 def _load_text_file(path: str, config: Config) -> Dict[str, Any]:
     """Parse a CSV/TSV/LibSVM training file (reference src/io/parser.cpp);
     LibSVM rows load into a CSR matrix (sparse path), dense CSV/TSV into a
@@ -316,6 +354,69 @@ def _load_text_file(path: str, config: Config) -> Dict[str, Any]:
     text = p.read_text()
     lines = text.splitlines()
     skip = 1 if config.header else 0
+    header_line = lines[0] if (config.header and lines) else None
+    if config.parser_config_file:
+        # custom parser plugin (Parser::CreateParser's add-on dispatch,
+        # src/io/parser.cpp:288): className routes lines through a
+        # registered Python parser; the config str persists with the
+        # dataset like the reference's parser_config_str_
+        from .parser import create_parser, generate_parser_config_str
+
+        pcs = generate_parser_config_str(
+            config.parser_config_file, config.header,
+            _label_column_index(config, header_line),
+        )
+        parse_line = create_parser(pcs)
+        if parse_line is not None:
+            labels, rows = [], []
+            max_col = -1
+            for ln in lines[skip:]:
+                if not ln.strip():
+                    continue
+                feats, lab = parse_line(ln)
+                labels.append(float(lab))
+                rows.append(list(feats))
+            # decide sparse from ANY row, not the first (a legal label-only
+            # row parses to []); mixed outputs normalize to pairs
+            sparse = any(r and isinstance(r[0], tuple) for r in rows)
+            if sparse:
+                rows = [
+                    r if (not r or isinstance(r[0], tuple))
+                    else list(enumerate(r))
+                    for r in rows
+                ]
+                for r in rows:
+                    for ci, _ in r:
+                        max_col = max(max_col, int(ci))
+            else:
+                for r in rows:
+                    max_col = max(max_col, len(r) - 1)
+            n, f = len(rows), max_col + 1
+            if sparse:
+                try:
+                    import scipy.sparse as sp
+                except Exception as exc:  # pragma: no cover
+                    raise ValueError(
+                        "custom parser returned sparse rows but scipy is "
+                        "unavailable"
+                    ) from exc
+                data_v, indices, indptr = [], [], [0]
+                for feats in rows:
+                    for ci, v in feats:
+                        indices.append(int(ci))
+                        data_v.append(float(v))
+                    indptr.append(len(indices))
+                mat = sp.csr_matrix(
+                    (data_v, indices, indptr), shape=(n, f)
+                )
+                out = {"data": mat, "label": np.asarray(labels)}
+            else:
+                dense = np.zeros((n, f), np.float64)
+                for i, feats in enumerate(rows):
+                    dense[i, : len(feats)] = feats
+                out = {"data": dense, "label": np.asarray(labels)}
+            out["parser_config_str"] = pcs
+            return _attach_sidecars(out, path)
     # scan a few rows: a leading label-only line is legal LibSVM (all-zero
     # sample), so one line is not enough to decide the format
     probe = [ln for ln in lines[skip:] if ln.strip()][:20]
@@ -327,23 +428,11 @@ def _load_text_file(path: str, config: Config) -> Dict[str, Any]:
     first = lines[0] if lines else ""
     delim = "\t" if "\t" in first else ("," if "," in first else None)
     arr = np.loadtxt(path, delimiter=delim, skiprows=skip, dtype=np.float64, ndmin=2)
-    label_col = 0
-    if config.label_column not in ("", None):
-        lc = str(config.label_column)
-        label_col = int(lc.split("=")[-1]) if "=" in lc else int(lc)
+    label_col = _label_column_index(config, header_line)
     label = arr[:, label_col]
     feats = np.delete(arr, label_col, axis=1)
     out: Dict[str, Any] = {"data": feats, "label": label}
-    qpath = Path(str(path) + ".query")
-    if qpath.exists():
-        out["group"] = np.loadtxt(qpath, dtype=np.int64, ndmin=1)
-    wpath = Path(str(path) + ".weight")
-    if wpath.exists():
-        out["weight"] = np.loadtxt(wpath, dtype=np.float64, ndmin=1)
-    ipath = Path(str(path) + ".init")
-    if ipath.exists():
-        out["init_score"] = np.loadtxt(ipath, dtype=np.float64, ndmin=1)
-    return out
+    return _attach_sidecars(out, path)
 
 
 class Dataset:
@@ -457,6 +546,7 @@ class Dataset:
         if isinstance(data, (str, Path)):
             loaded = _load_text_file(str(data), self.config)
             data = loaded["data"]
+            self.parser_config_str = loaded.get("parser_config_str", "")
             if label is None:
                 label = loaded.get("label")
             if self._group is None:
@@ -1089,6 +1179,11 @@ class Dataset:
                     "query_boundaries": self.metadata.query_boundaries,
                     "arrow_categories": self.arrow_categories,
                     "pandas_categorical": self.pandas_categorical,
+                    # parser_config_str_ persists with the binary dataset
+                    # (reference dataset.cpp SaveBinaryFile / :875 load)
+                    "parser_config_str": getattr(
+                        self, "parser_config_str", ""
+                    ),
                     "raw": self.raw,
                 },
                 fh,
@@ -1118,6 +1213,7 @@ class Dataset:
         ds._constructed = True
         ds.arrow_categories = blob.get("arrow_categories")
         ds.pandas_categorical = blob.get("pandas_categorical")
+        ds.parser_config_str = blob.get("parser_config_str", "")
         ds.bin_mappers = blob["bin_mappers"]
         ds.used_features = blob["used_features"]
         ds.bins = blob["bins"]
@@ -1153,6 +1249,7 @@ class Dataset:
         ds._constructed = True
         ds.arrow_categories = self.arrow_categories
         ds.pandas_categorical = self.pandas_categorical
+        ds.parser_config_str = getattr(self, "parser_config_str", "")
         ds.bin_mappers = self.bin_mappers
         ds.used_features = self.used_features
         ds.bins = self.bins[idx]

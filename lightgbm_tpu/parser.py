@@ -1,0 +1,97 @@
+"""Custom text-parser plugin registry.
+
+Reference analog: ``Parser::CreateParser``'s customized-parser add-on
+(include/LightGBM/dataset.h:445-455, src/io/parser.cpp:288) — the
+reference resolves a ``className`` from ``parser_config_file`` against a
+C++ ``ParserFactory`` of linked-in parser classes.  The TPU build's
+plugin surface is Python-native: register a factory callable under a
+class name, and any text-file load whose ``parser_config_file`` names it
+routes every data line through the returned parser instead of the
+CSV/TSV/LibSVM auto-detection.
+
+    import lightgbm_tpu as lgb
+
+    def my_factory(config_str):
+        # config_str = the parser_config_file content (+ the loader's
+        # appended label_idx/header lines, as GenerateParserConfigStr does)
+        def parse_line(line):
+            toks = line.split("|")
+            return [float(t) for t in toks[1:]], float(toks[0])
+        return parse_line
+
+    lgb.register_parser("MyParser", my_factory)
+    lgb.train({"parser_config_file": "my_parser.conf"}, lgb.Dataset("x.txt"))
+
+``parse_line`` returns ``(features, label)`` where features is either a
+dense list of floats or a sparse list of ``(col_idx, value)`` pairs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_parser(class_name: str, factory: Callable) -> None:
+    """Register ``factory(config_str) -> parse_line`` under ``class_name``
+    (the reference's ParserFactory::addParser)."""
+    _REGISTRY[class_name] = factory
+
+
+def get_from_parser_config(config_str: str, key: str) -> str:
+    """key=value lookup in a parser config blob
+    (Common::GetFromParserConfig, include/LightGBM/utils/common.h)."""
+    for line in config_str.splitlines():
+        if "=" in line:
+            k, v = line.split("=", 1)
+            if k.strip() == key:
+                return v.strip()
+    return ""
+
+
+def generate_parser_config_str(
+    config_path: str, header: bool, label_idx: int
+) -> str:
+    """Read the parser config file and append loader context
+    (Parser::GenerateParserConfigStr — the reference saves header/label_idx
+    into the persisted config string)."""
+    try:
+        with open(config_path) as fh:
+            s = fh.read()
+    except OSError:
+        # warn loudly: silently falling back to format auto-detection on a
+        # typo'd path would feed custom-format files to the CSV parser
+        from .utils.log import log_warning
+
+        log_warning(
+            f"Could not open parser_config_file {config_path!r}; falling "
+            "back to CSV/TSV/LibSVM auto-detection."
+        )
+        return ""
+    if s and not s.endswith("\n"):
+        s += "\n"
+    if get_from_parser_config(s, "header") == "":
+        s += f"header={'true' if header else 'false'}\n"
+    if get_from_parser_config(s, "label_idx") == "":
+        s += f"label_idx={label_idx}\n"
+    return s
+
+
+def create_parser(parser_config_str: str):
+    """Instantiate the registered parser named by the config's className,
+    or None when the config names none (falls back to format
+    auto-detection, matching CreateParser's dispatch)."""
+    if not parser_config_str:
+        return None
+    name = get_from_parser_config(parser_config_str, "className")
+    if not name:
+        return None
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"parser_config_file names className={name!r} but no parser "
+            f"with that name is registered — call "
+            f"lightgbm_tpu.register_parser({name!r}, factory) first "
+            f"(registered: {sorted(_REGISTRY)})"
+        )
+    return _REGISTRY[name](parser_config_str)
