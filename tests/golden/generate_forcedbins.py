@@ -1,0 +1,93 @@
+"""Generate the forced-bins golden fixture from the reference CLI.
+
+Run ONCE with the reference built (cmake out-of-tree works — copy the
+source somewhere writable and lower cmake_minimum_required if the local
+cmake is older):
+
+    python tests/golden/generate_forcedbins.py /path/to/lightgbm-cli
+
+Writes: forcedbins.train.csv (label first), forcedbins.bounds.json (the
+forced bounds file), forcedbins.model.txt, forcedbins.preds.txt.
+tests/test_consistency.py's forced-bins golden test then compares our
+forced-bins training against these without needing the binary.
+"""
+
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+OUT = Path(__file__).parent
+
+FORCED = '[{"feature": 0, "bin_upper_bound": [-3.0, 1.25, 2.5]}]'
+
+
+def make_data():
+    rng = np.random.default_rng(42)
+    n = 2000
+    f0 = rng.uniform(-10, 10, size=n)
+    f1 = rng.normal(size=n)
+    f2 = rng.uniform(0, 1, size=n)
+    # the informative step sits at a forced boundary (1.25): both engines
+    # must be able to split exactly there
+    y = 2.0 * (f0 > 1.25) + 0.5 * f1 + rng.normal(scale=0.1, size=n)
+    return np.column_stack([y, f0, f1, f2])
+
+
+PARAMS = """task = train
+objective = regression
+data = train.csv
+num_trees = 8
+learning_rate = 0.2
+num_leaves = 8
+max_bin = 16
+min_data_in_leaf = 20
+forcedbins_filename = forced.json
+is_training_metric = true
+metric = l2
+verbosity = 2
+output_model = model.txt
+"""
+
+
+def main(cli: str) -> None:
+    cli = str(Path(cli).resolve())  # subprocess cwd changes; pin the binary
+    arr = make_data()
+    with tempfile.TemporaryDirectory() as td:
+        work = Path(td)
+        np.savetxt(work / "train.csv", arr, delimiter=",", fmt="%.8f")
+        (work / "forced.json").write_text(FORCED)
+        (work / "train.conf").write_text(PARAMS)
+        p = subprocess.run(
+            [cli, "config=train.conf"], cwd=work, capture_output=True,
+            text=True,
+        )
+        if p.returncode != 0:
+            raise RuntimeError(p.stdout + p.stderr)
+        (work / "pred.conf").write_text(
+            "task = predict\ndata = train.csv\ninput_model = model.txt\n"
+            "output_result = preds.txt\n"
+        )
+        p2 = subprocess.run(
+            [cli, "config=pred.conf"], cwd=work, capture_output=True,
+            text=True,
+        )
+        if p2.returncode != 0:
+            raise RuntimeError(p2.stdout + p2.stderr)
+        OUT.joinpath("forcedbins.train.csv").write_text(
+            (work / "train.csv").read_text()
+        )
+        OUT.joinpath("forcedbins.bounds.json").write_text(FORCED)
+        OUT.joinpath("forcedbins.model.txt").write_text(
+            (work / "model.txt").read_text()
+        )
+        OUT.joinpath("forcedbins.preds.txt").write_text(
+            (work / "preds.txt").read_text()
+        )
+    print("forced-bins goldens written")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

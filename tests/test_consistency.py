@@ -152,3 +152,50 @@ def test_training_parity_on_example(name):
         assert ours_final >= ref_final * (1 - rtol), (ours_final, ref_final)
     else:
         assert ours_final <= ref_final * (1 + rtol), (ours_final, ref_final)
+
+
+def test_forcedbins_golden_parity():
+    """Forced bin bounds vs the reference CLI on identical data: the
+    reference's model (trained with forcedbins_filename) cross-loads and
+    reproduces its predictions, our forced-bins training splits at the
+    same forced thresholds, and final train l2 matches within tolerance
+    (fixtures from tests/golden/generate_forcedbins.py)."""
+    model_file = GOLDEN / "forcedbins.model.txt"
+    if not model_file.exists():
+        pytest.skip("forced-bins goldens not generated")
+    arr = np.loadtxt(GOLDEN / "forcedbins.train.csv", delimiter=",")
+    y, X = arr[:, 0], arr[:, 1:]
+    # cross-load: reference model + its own predictions
+    ref = lgb.Booster(model_str=model_file.read_text())
+    want = np.loadtxt(GOLDEN / "forcedbins.preds.txt", ndmin=1)
+    np.testing.assert_allclose(ref.predict(X), want, rtol=1e-4, atol=1e-5)
+    # the reference's feature-0 split thresholds honor the forced bounds:
+    # every 1.25-adjacent threshold IS a forced bound
+    params = {
+        "objective": "regression", "learning_rate": 0.2, "num_leaves": 8,
+        "max_bin": 16, "min_data_in_leaf": 20, "verbosity": -1,
+        "forcedbins_filename": str(GOLDEN / "forcedbins.bounds.json"),
+    }
+    ds = lgb.Dataset(X, y, params=params)
+    b = lgb.train(params, ds, 8)
+    ub0 = ds.bin_mappers[0].bin_upper_bound
+    for forced in (-3.0, 1.25, 2.5):
+        assert forced in ub0
+    # both engines must find the step at the forced 1.25 boundary: compare
+    # the feature-0 thresholds used by the first tree
+    def _f0_thresholds(booster):
+        s = booster.model_to_string()
+        tree0 = s.split("Tree=1")[0]
+        feats, thrs = None, None
+        for line in tree0.splitlines():
+            if line.startswith("split_feature="):
+                feats = [int(t) for t in line.split("=")[1].split()]
+            if line.startswith("threshold="):
+                thrs = [float(t) for t in line.split("=")[1].split()]
+        return {t for f, t in zip(feats, thrs) if f == 0}
+    ours, refs = _f0_thresholds(b), _f0_thresholds(ref)
+    assert 1.25 in refs and 1.25 in ours
+    # training quality parity on the same data/params
+    mse_ref = float(np.mean((ref.predict(X) - y) ** 2))
+    mse_ours = float(np.mean((b.predict(X) - y) ** 2))
+    assert mse_ours <= mse_ref * 1.05, (mse_ours, mse_ref)
