@@ -1,0 +1,135 @@
+"""Generate golden fixtures from the reference CLI for feature scenarios
+beyond the four stock examples: monotone constraints, CEGB, quantized
+gradients, wide bins (max_bin 1024), and GOSS.
+
+    python tests/golden/generate_scenarios.py /path/to/lightgbm-cli
+
+Per scenario writes: scen_<name>.train.csv, scen_<name>.model.txt,
+scen_<name>.preds.txt, scen_<name>.evals.json.
+tests/test_consistency.py::test_scenario_golden_parity consumes them
+(cross-load + quality parity) without needing the binary.
+"""
+
+import json
+import re
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+OUT = Path(__file__).parent
+
+BASE = """task = train
+objective = regression
+data = train.csv
+label_column = 0
+num_trees = 10
+learning_rate = 0.15
+num_leaves = 31
+min_data_in_leaf = 20
+is_training_metric = true
+metric = l2
+verbosity = 2
+output_model = model.txt
+"""
+
+
+def _data(seed=7, n=4000, f=4):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (
+        1.2 * X[:, 0] - 0.8 * X[:, 1] + 0.5 * np.sin(2 * X[:, 2])
+        + rng.normal(scale=0.2, size=n)
+    )
+    return np.column_stack([y, X])
+
+
+# per-scenario EXTRA params, single source of truth: the CLI conf is
+# rendered from these AND they are emitted as scen_<name>.params.json for
+# the parity test to rebuild its param dict from — nothing to keep in sync
+# by hand
+SCENARIOS = {
+    # advanced monotone ladder evidence against the reference's own result
+    "monotone_basic": ({"monotone_constraints": [1, -1, 0, 0],
+                        "monotone_constraints_method": "basic"}, _data),
+    "monotone_advanced": ({"monotone_constraints": [1, -1, 0, 0],
+                           "monotone_constraints_method": "advanced"},
+                          _data),
+    "cegb": ({"cegb_tradeoff": 1.0,
+              "cegb_penalty_feature_coupled": [0.5, 0.5, 0.5, 0.5],
+              "cegb_penalty_split": 1e-5}, _data),
+    "quantized": ({"use_quantized_grad": True, "num_grad_quant_bins": 4},
+                  _data),
+    "widebin": ({"max_bin": 1024}, lambda: _data(seed=9, n=20000, f=4)),
+    "goss": ({"boosting": "goss", "top_rate": 0.2, "other_rate": 0.1},
+             lambda: _data(seed=11, n=8000, f=4)),
+}
+
+
+def _conf_value(v):
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, list):
+        return ",".join(str(x) for x in v)
+    return str(v)
+
+
+def main(cli: str) -> None:
+    cli = str(Path(cli).resolve())
+    for name, (extra, mk) in SCENARIOS.items():
+        conf = BASE + "".join(
+            f"{k} = {_conf_value(v)}\n" for k, v in extra.items()
+        )
+        arr = mk()
+        with tempfile.TemporaryDirectory() as td:
+            work = Path(td)
+            np.savetxt(work / "train.csv", arr, delimiter=",", fmt="%.8f")
+            (work / "train.conf").write_text(conf)
+            p = subprocess.run(
+                [cli, "config=train.conf"], cwd=work, capture_output=True,
+                text=True,
+            )
+            if p.returncode != 0:
+                raise RuntimeError(f"{name}:\n{p.stdout}{p.stderr}")
+            log = p.stdout + p.stderr
+            evals = {}
+            for m in re.finditer(
+                r"Iteration:(\d+), (\S+) (\S+) : ([-\d.eE]+)", log
+            ):
+                it, dsname, metric, val = m.groups()
+                evals.setdefault(f"{dsname}:{metric}", []).append(
+                    [int(it), float(val)]
+                )
+            (work / "pred.conf").write_text(
+                "task = predict\ndata = train.csv\n"
+                "input_model = model.txt\noutput_result = preds.txt\n"
+            )
+            p2 = subprocess.run(
+                [cli, "config=pred.conf"], cwd=work, capture_output=True,
+                text=True,
+            )
+            if p2.returncode != 0:
+                raise RuntimeError(f"{name} predict:\n{p2.stdout}{p2.stderr}")
+            OUT.joinpath(f"scen_{name}.train.csv").write_text(
+                (work / "train.csv").read_text()
+            )
+            OUT.joinpath(f"scen_{name}.model.txt").write_text(
+                (work / "model.txt").read_text()
+            )
+            OUT.joinpath(f"scen_{name}.preds.txt").write_text(
+                (work / "preds.txt").read_text()
+            )
+            OUT.joinpath(f"scen_{name}.evals.json").write_text(
+                json.dumps(evals, indent=1)
+            )
+            OUT.joinpath(f"scen_{name}.params.json").write_text(
+                json.dumps(extra, indent=1)
+            )
+            final = {k: v[-1][1] for k, v in evals.items()}
+            print(f"{name}: {final}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

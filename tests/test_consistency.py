@@ -199,3 +199,54 @@ def test_forcedbins_golden_parity():
     mse_ref = float(np.mean((ref.predict(X) - y) ** 2))
     mse_ours = float(np.mean((b.predict(X) - y) ** 2))
     assert mse_ours <= mse_ref * 1.05, (mse_ours, mse_ref)
+
+
+# scenario names only; the per-scenario params travel WITH the fixtures
+# (scen_<name>.params.json, written by generate_scenarios.py from its
+# single SCENARIOS table) so regenerating goldens can never desync the
+# test's training configuration
+_SCENARIO_NAMES = [
+    "cegb", "goss", "monotone_advanced", "monotone_basic", "quantized",
+    "widebin",
+]
+
+
+@pytest.mark.parametrize("name", _SCENARIO_NAMES)
+def test_scenario_golden_parity(name):
+    """Feature-scenario goldens (tests/golden/generate_scenarios.py): the
+    reference's model cross-loads bit-consistently, and our training with
+    the same feature engaged reaches the reference's final train l2 within
+    tolerance.  Covers monotone (basic+advanced), CEGB, quantized
+    gradients, max_bin=1024, and GOSS against the reference's own runs."""
+    model_file = GOLDEN / f"scen_{name}.model.txt"
+    if not model_file.exists():
+        pytest.skip("scenario goldens not generated")
+    arr = np.loadtxt(GOLDEN / f"scen_{name}.train.csv", delimiter=",")
+    y, X = arr[:, 0], arr[:, 1:]
+    ref = lgb.Booster(model_str=model_file.read_text())
+    want = np.loadtxt(GOLDEN / f"scen_{name}.preds.txt", ndmin=1)
+    np.testing.assert_allclose(ref.predict(X), want, rtol=1e-4, atol=1e-5)
+    evals = json.loads((GOLDEN / f"scen_{name}.evals.json").read_text())
+    ref_l2 = evals["training:l2"][-1][1]
+    extra = json.loads((GOLDEN / f"scen_{name}.params.json").read_text())
+    params = {
+        "objective": "regression", "learning_rate": 0.15, "num_leaves": 31,
+        "min_data_in_leaf": 20, "verbosity": -1, **extra,
+    }
+    ds = lgb.Dataset(X, y, params=params)
+    b = lgb.train(params, ds, 10)
+    ours_l2 = float(np.mean((b.predict(X) - y) ** 2))
+    # stochastic modes (goss, quantized) and different tie-breaks leave
+    # some slack; deterministic modes track much closer in practice
+    rtol = 0.15 if name in ("goss", "quantized") else 0.05
+    assert ours_l2 <= ref_l2 * (1 + rtol), (ours_l2, ref_l2)
+    if name.startswith("monotone"):
+        # the produced model must actually satisfy the constraints
+        rng2 = np.random.default_rng(0)
+        base_pts = rng2.normal(size=(200, X.shape[1]))
+        for fi, sign in ((0, 1), (1, -1)):
+            lo, hi = base_pts.copy(), base_pts.copy()
+            lo[:, fi] -= 1.0
+            hi[:, fi] += 1.0
+            d = b.predict(hi) - b.predict(lo)
+            assert (sign * d >= -1e-9).all(), f"constraint violated on f{fi}"

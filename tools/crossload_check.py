@@ -52,13 +52,21 @@ def main(cli):
     failures = []
 
     def check(name, booster, X, ours, atol=1e-6, rtol=1e-5):
-        with tempfile.TemporaryDirectory() as td:
-            got = ref_predict(cli, booster.model_to_string(), X, td)
-        if got.ndim == 1 and ours.ndim == 2:
+        # a crash (CLI rejecting the file, shape mismatch) IS the bug
+        # class this tool hunts — record it as FAIL, keep going
+        try:
+            with tempfile.TemporaryDirectory() as td:
+                got = ref_predict(cli, booster.model_to_string(), X, td)
+            if got.size != ours.size:
+                raise ValueError(
+                    f"shape mismatch: ref {got.shape} vs ours {ours.shape}"
+                )
             got = got.reshape(ours.shape)
-        ok = np.allclose(got, ours, atol=atol, rtol=rtol)
-        print(f"{'OK  ' if ok else 'FAIL'} {name}: "
-              f"max diff {np.abs(got - ours).max():.2e}")
+            ok = np.allclose(got, ours, atol=atol, rtol=rtol)
+            detail = f"max diff {np.abs(got - ours).max():.2e}"
+        except Exception as e:
+            ok, detail = False, f"{type(e).__name__}: {e}"
+        print(f"{'OK  ' if ok else 'FAIL'} {name}: {detail}")
         if not ok:
             failures.append(name)
 
