@@ -1783,6 +1783,17 @@ class Booster:
                 if pred_leaf:
                     return np.asarray(predict_real_leaves(batch, Xd), dtype=np.int32)
                 per_tree = np.asarray(predict_real_raw(batch, Xd), dtype=np.float64)
+                # f32-boundary exactness: the device walker compares f32
+                # values against f32-cast thresholds; rows within f32
+                # rounding of a double threshold (~1e-5 of rows at 376
+                # trees, measured vs the reference CLI) re-walk on host in
+                # f64, matching NumericalDecision's double compare exactly
+                sus = self._real_walk_suspects(np.asarray(X, np.float64), t0, t1)
+                if sus.size:
+                    per_tree[sus] = np.stack(
+                        [t.predict(X[sus]) for t in self.models_[t0:t1]],
+                        axis=1,
+                    )
 
         n = X.shape[0]
         if es_requested:
@@ -1790,6 +1801,50 @@ class Booster:
         else:
             raw = per_tree.reshape(n, -1, k).sum(axis=1)  # [N, K]
         return self._finish_predict(raw, t0, t1, k, raw_score)
+
+    def _real_walk_suspects(self, X: np.ndarray, t0: int, t1: int) -> np.ndarray:
+        """Row indices whose f32 walk could disagree with the reference's
+        f64 NumericalDecision: some feature value lies within f32 rounding
+        distance of some numeric threshold on that feature (categorical
+        splits compare exact small integers and cannot flip)."""
+        key = ("thr", t0, t1, self._model_version)
+        if key not in self._stack_cache:
+            # one live entry: staged-prediction loops would otherwise pin a
+            # threshold map per (t0, t1) range forever
+            self._stack_cache = {
+                kk: v for kk, v in self._stack_cache.items()
+                if kk[0] != "thr"
+            }
+            per_feat: Dict[int, list] = {}
+            for tr in self.models_[t0:t1]:
+                cat = (np.asarray(tr.decision_type) & 1) != 0
+                for f_, th in zip(
+                    np.asarray(tr.split_feature)[~cat],
+                    np.asarray(tr.threshold, np.float64)[~cat],
+                ):
+                    per_feat.setdefault(int(f_), []).append(float(th))
+            self._stack_cache[key] = {
+                f_: np.unique(np.asarray(v, np.float64))
+                for f_, v in per_feat.items()
+            }
+        sus = np.zeros(X.shape[0], bool)
+        for f_, thr in self._stack_cache[key].items():
+            if f_ >= X.shape[1] or thr.size == 0:
+                continue
+            x = X[:, f_]
+            j = np.clip(np.searchsorted(thr, x), 0, thr.size - 1)
+            jm = np.clip(j - 1, 0, thr.size - 1)
+            near = np.minimum(np.abs(x - thr[j]), np.abs(x - thr[jm]))
+            # a flip needs |x - thr| within the f32 rounding of either
+            # operand; 8 ulps is comfortably conservative and still keeps
+            # the suspect rate ~1e-5
+            eps = 8.0 * np.float64(
+                np.spacing(
+                    np.maximum(np.abs(x), np.abs(thr[j])).astype(np.float32)
+                )
+            )
+            sus |= near <= eps
+        return np.flatnonzero(sus)
 
     def _finish_predict(self, raw: np.ndarray, t0, t1, k, raw_score):
         if self.average_output:

@@ -1,0 +1,54 @@
+"""Generate SHAP-contribution goldens from the reference CLI.
+
+    python tests/golden/generate_contribs.py /path/to/lightgbm-cli
+
+For existing golden models (forcedbins + monotone_basic scenario), runs
+``task=predict predict_contrib=true`` over the model's own train.csv and
+stores the per-feature contribution matrix.  Contributions are
+DETERMINISTIC given the model file, so the parity test compares our
+TreeSHAP (shap.py pred_contrib) tightly against the reference's — much
+stronger than quality-band checks."""
+
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+OUT = Path(__file__).parent
+
+MODELS = ["forcedbins", "scen_monotone_basic"]
+
+
+def main(cli: str) -> None:
+    cli = str(Path(cli).resolve())
+    for stem in MODELS:
+        model = OUT / f"{stem}.model.txt"
+        data = OUT / f"{stem}.train.csv"
+        with tempfile.TemporaryDirectory() as td:
+            work = Path(td)
+            # contributions are computed on the FEATURE columns; the train
+            # csv has the label first, which predict would treat as a
+            # feature — strip it
+            import numpy as np
+
+            arr = np.loadtxt(data, delimiter=",")
+            np.savetxt(work / "pred.csv", arr[:500, 1:], delimiter=",",
+                       fmt="%.8f")
+            (work / "model.txt").write_text(model.read_text())
+            (work / "pred.conf").write_text(
+                "task = predict\ndata = pred.csv\ninput_model = model.txt\n"
+                "output_result = contribs.txt\npredict_contrib = true\n"
+                "header = false\n"
+            )
+            p = subprocess.run([cli, "config=pred.conf"], cwd=work,
+                               capture_output=True, text=True)
+            if p.returncode != 0:
+                raise RuntimeError(f"{stem}:\n{p.stdout}{p.stderr}")
+            OUT.joinpath(f"{stem}.contribs.txt").write_text(
+                (work / "contribs.txt").read_text()
+            )
+        print(f"{stem}: contribs written")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

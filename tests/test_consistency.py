@@ -250,3 +250,24 @@ def test_scenario_golden_parity(name):
             hi[:, fi] += 1.0
             d = b.predict(hi) - b.predict(lo)
             assert (sign * d >= -1e-9).all(), f"constraint violated on f{fi}"
+
+
+@pytest.mark.parametrize("stem", ["forcedbins", "scen_monotone_basic"])
+def test_shap_contrib_golden_parity(stem):
+    """TreeSHAP contributions vs the reference CLI's predict_contrib=true
+    on the SAME model file — deterministic, so the comparison is tight
+    (fixtures from tests/golden/generate_contribs.py; reference analog
+    src/treelearner/../tree.cpp TreeSHAP / pred_contrib)."""
+    contribs_file = GOLDEN / f"{stem}.contribs.txt"
+    if not contribs_file.exists():
+        pytest.skip("contrib goldens not generated")
+    arr = np.loadtxt(GOLDEN / f"{stem}.train.csv", delimiter=",")
+    X = arr[:500, 1:]
+    b = lgb.Booster(model_str=(GOLDEN / f"{stem}.model.txt").read_text())
+    want = np.loadtxt(contribs_file, delimiter="\t", ndmin=2)
+    got = b.predict(X, pred_contrib=True)
+    assert got.shape == want.shape  # [n, F+1] incl. the expected-value col
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # contributions must sum to the raw prediction (SHAP identity)
+    raw = b.predict(X, raw_score=True)
+    np.testing.assert_allclose(got.sum(axis=1), raw, rtol=1e-6, atol=1e-6)

@@ -1,0 +1,96 @@
+"""Same-machine predict A/B: reference CLI vs our Booster, SAME model file.
+
+    python tools/ref_predict_bench.py /path/to/lightgbm-cli
+
+The fork's 84k preds/s target (original.md) was measured on its own AVX
+machine; this gives the denominator on THIS machine.  The reference
+trains a 376-tree binary model (the fork benchmark's tree count) on
+bench.py-shaped data, then both engines predict the same 500k rows from
+the same model.txt — cross-engine model compatibility makes the
+comparison exact.
+"""
+
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+TRAIN = """task = train
+objective = binary
+data = train.csv
+label_column = 0
+num_leaves = 31
+learning_rate = 0.1
+min_data_in_leaf = 100
+num_trees = 376
+metric = none
+num_threads = 1
+verbosity = -1
+output_model = model.txt
+"""
+
+PRED = """task = predict
+data = pred.csv
+input_model = model.txt
+output_result = preds.txt
+num_threads = 1
+header = false
+"""
+
+
+def main(cli):
+    cli = str(Path(cli).resolve())
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import lightgbm_tpu as lgb
+    from bench import _make_data
+
+    X, y = _make_data(500_000, 28)
+    with tempfile.TemporaryDirectory() as td:
+        work = Path(td)
+        np.savetxt(
+            work / "train.csv",
+            np.column_stack([y[:300_000], X[:300_000].astype(np.float64)]),
+            delimiter=",", fmt="%.7g",
+        )
+        (work / "train.conf").write_text(TRAIN)
+        t0 = time.perf_counter()
+        p = subprocess.run([cli, "config=train.conf"], cwd=work,
+                           capture_output=True, text=True)
+        if p.returncode != 0:
+            raise RuntimeError(p.stdout + p.stderr)
+        print(f"reference trained 376 trees in {time.perf_counter()-t0:.0f}s")
+        np.savetxt(work / "pred.csv", X.astype(np.float64), delimiter=",",
+                   fmt="%.10g")  # f32 needs 9 sig digits to round-trip
+        (work / "pred.conf").write_text(PRED)
+        # reference predict: time includes CSV parse (its real pipeline);
+        # run twice, second run quotes the steady state
+        for tag in ("cold", "warm"):
+            t0 = time.perf_counter()
+            p = subprocess.run([cli, "config=pred.conf"], cwd=work,
+                               capture_output=True, text=True)
+            dt = time.perf_counter() - t0
+            if p.returncode != 0:
+                raise RuntimeError(p.stdout + p.stderr)
+            print(f"reference predict 500k ({tag}): {dt:.1f}s = "
+                  f"{500_000/dt:,.0f} preds/s (incl. CSV parse)")
+        ref_preds = np.loadtxt(work / "preds.txt", ndmin=1)
+
+        b = lgb.Booster(model_file=str(work / "model.txt"))
+        ours = b.predict(X)  # warmup + correctness
+        np.testing.assert_allclose(ours, ref_preds, rtol=1e-5, atol=1e-6)
+        t0 = time.perf_counter()
+        ours = b.predict(X)
+        dt = time.perf_counter() - t0
+        print(f"ours predict 500k (warm, ndarray in memory): {dt:.1f}s = "
+              f"{500_000/dt:,.0f} preds/s")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
