@@ -1,0 +1,77 @@
+"""Generate the refit golden from the reference CLI (task=refit).
+
+    python tests/golden/generate_refit.py /path/to/lightgbm-cli
+
+Trains a model on data A, refits its leaf values on shifted-label data B
+(reference GBDT::RefitTree, src/application/application.cpp:229), and
+stores both model files + data.  Refit is deterministic given the model
+and data, so the parity test compares our Booster.refit leaf values
+directly against the reference's refit output."""
+
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+OUT = Path(__file__).parent
+
+TRAIN = """task = train
+objective = regression
+data = train.csv
+label_column = 0
+num_trees = 6
+learning_rate = 0.2
+num_leaves = 15
+min_data_in_leaf = 20
+verbosity = -1
+output_model = model.txt
+"""
+
+REFIT = """task = refit
+data = refit.csv
+label_column = 0
+input_model = model.txt
+output_model = refit_model.txt
+refit_decay_rate = 0.9
+verbosity = -1
+"""
+
+
+def main(cli: str) -> None:
+    cli = str(Path(cli).resolve())
+    rng = np.random.default_rng(17)
+    n = 3000
+    X = rng.normal(size=(n, 4))
+    y = 1.5 * X[:, 0] - X[:, 1] + rng.normal(scale=0.2, size=n)
+    y2 = y + 0.8 * np.sin(X[:, 2])  # shifted labels for the refit
+    with tempfile.TemporaryDirectory() as td:
+        work = Path(td)
+        np.savetxt(work / "train.csv", np.column_stack([y, X]),
+                   delimiter=",", fmt="%.8f")
+        np.savetxt(work / "refit.csv", np.column_stack([y2, X]),
+                   delimiter=",", fmt="%.8f")
+        (work / "train.conf").write_text(TRAIN)
+        p = subprocess.run([cli, "config=train.conf"], cwd=work,
+                           capture_output=True, text=True)
+        if p.returncode != 0:
+            raise RuntimeError(p.stdout + p.stderr)
+        (work / "refit.conf").write_text(REFIT)
+        p2 = subprocess.run([cli, "config=refit.conf"], cwd=work,
+                            capture_output=True, text=True)
+        if p2.returncode != 0:
+            raise RuntimeError(p2.stdout + p2.stderr)
+        OUT.joinpath("refit.train.csv").write_text(
+            (work / "train.csv").read_text())
+        OUT.joinpath("refit.refit.csv").write_text(
+            (work / "refit.csv").read_text())
+        OUT.joinpath("refit.model.txt").write_text(
+            (work / "model.txt").read_text())
+        OUT.joinpath("refit.refit_model.txt").write_text(
+            (work / "refit_model.txt").read_text())
+    print("refit goldens written")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -21,19 +21,25 @@ import numpy as np
 
 OUT = Path(__file__).parent
 
-BASE = """task = train
-objective = regression
+IO_CONF = """task = train
 data = train.csv
 label_column = 0
-num_trees = 10
-learning_rate = 0.15
-num_leaves = 31
-min_data_in_leaf = 20
 is_training_metric = true
-metric = l2
 verbosity = 2
 output_model = model.txt
 """
+
+# training params shared by every scenario; the per-scenario extras merge
+# OVER these (single dict — the reference CLI warns on duplicate keys).
+# num_trees rides along so the parity test trains the same round count.
+BASE_PARAMS = {
+    "objective": "regression",
+    "num_trees": 10,
+    "learning_rate": 0.15,
+    "num_leaves": 31,
+    "min_data_in_leaf": 20,
+    "metric": "l2",
+}
 
 
 def _data(seed=7, n=4000, f=4):
@@ -68,6 +74,29 @@ SCENARIOS = {
 }
 
 
+def _pos_data(seed=13, n=4000, f=4):
+    """Positive labels for the count/positive-continuous objectives."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    mu = np.exp(0.6 * X[:, 0] - 0.4 * X[:, 1])
+    y = rng.poisson(mu).astype(np.float64) + rng.uniform(0, 0.2, size=n)
+    return np.column_stack([y, X])
+
+
+# objective-family trajectories: metric name must match the objective's
+# default so the eval key in the fixture is predictable
+SCENARIOS.update({
+    "obj_tweedie": ({"objective": "tweedie", "tweedie_variance_power": 1.3,
+                     "metric": "tweedie"}, _pos_data),
+    "obj_poisson": ({"objective": "poisson", "metric": "poisson"},
+                    _pos_data),
+    "obj_quantile": ({"objective": "quantile", "alpha": 0.7,
+                      "metric": "quantile"}, _data),
+    "obj_huber": ({"objective": "huber", "alpha": 0.9, "metric": "huber"},
+                  _data),
+})
+
+
 def _conf_value(v):
     if isinstance(v, bool):
         return "true" if v else "false"
@@ -79,8 +108,9 @@ def _conf_value(v):
 def main(cli: str) -> None:
     cli = str(Path(cli).resolve())
     for name, (extra, mk) in SCENARIOS.items():
-        conf = BASE + "".join(
-            f"{k} = {_conf_value(v)}\n" for k, v in extra.items()
+        merged = {**BASE_PARAMS, **extra}
+        conf = IO_CONF + "".join(
+            f"{k} = {_conf_value(v)}\n" for k, v in merged.items()
         )
         arr = mk()
         with tempfile.TemporaryDirectory() as td:
@@ -125,7 +155,7 @@ def main(cli: str) -> None:
                 json.dumps(evals, indent=1)
             )
             OUT.joinpath(f"scen_{name}.params.json").write_text(
-                json.dumps(extra, indent=1)
+                json.dumps(merged, indent=1)
             )
             final = {k: v[-1][1] for k, v in evals.items()}
             print(f"{name}: {final}")
