@@ -94,6 +94,18 @@ SCENARIOS.update({
                       "metric": "quantile"}, _data),
     "obj_huber": ({"objective": "huber", "alpha": 0.9, "metric": "huber"},
                   _data),
+    "obj_gamma": ({"objective": "gamma", "metric": "gamma"}, _pos_data),
+    "obj_fair": ({"objective": "fair", "fair_c": 1.5, "metric": "fair"},
+                 _data),
+    "obj_mape": ({"objective": "mape", "metric": "mape"}, _pos_data),
+    "obj_l1": ({"objective": "regression_l1", "metric": "l1"}, _data),
+    # stochastic modes: cross-engine RNG streams differ by design, the
+    # parity test's band absorbs it
+    "dart": ({"boosting": "dart", "drop_rate": 0.15, "metric": "l2"},
+             _data),
+    "bagging": ({"bagging_fraction": 0.7, "bagging_freq": 1,
+                 "feature_fraction": 0.8, "metric": "l2"},
+                lambda: _data(seed=21, n=6000, f=4)),
 })
 
 

@@ -208,6 +208,7 @@ def test_forcedbins_golden_parity():
 _SCENARIO_NAMES = [
     "cegb", "goss", "monotone_advanced", "monotone_basic", "quantized",
     "widebin", "obj_tweedie", "obj_poisson", "obj_quantile", "obj_huber",
+    "obj_gamma", "obj_fair", "obj_mape", "obj_l1", "dart", "bagging",
 ]
 
 
@@ -243,12 +244,13 @@ def test_scenario_golden_parity(name):
     )
     metric_key = next(k for k in ev["training"] if metric in k)
     ours_final = ev["training"][metric_key][-1]
-    # stochastic modes (goss, quantized) and different tie-breaks leave
-    # some slack; deterministic modes track much closer in practice.
-    # additive-over-|ref| band: all these metrics are lower-is-better but
-    # NLL-style ones (poisson/tweedie) can go NEGATIVE, where a
-    # multiplicative bound would invert into a stricter-than-parity test
-    rtol = 0.15 if name in ("goss", "quantized") else 0.05
+    # stochastic modes (goss, quantized, dart drops, bagging draws) run
+    # different RNG streams by design and get a wider band; deterministic
+    # modes track much closer in practice.  additive-over-|ref| band: all
+    # these metrics are lower-is-better but NLL-style ones
+    # (poisson/tweedie/gamma) can go NEGATIVE, where a multiplicative
+    # bound would invert into a stricter-than-parity test
+    rtol = 0.15 if name in ("goss", "quantized", "dart", "bagging") else 0.05
     assert ours_final <= ref_final + rtol * abs(ref_final) + 1e-9, (
         ours_final, ref_final,
     )
