@@ -26,6 +26,11 @@ COMBOS = [
     {"cegb_tradeoff": 1.0, "cegb_penalty_split": 0.01,
      "feature_fraction_bynode": 0.8},
     {"path_smooth": 2.0, "max_delta_step": 0.5, "extra_trees": True},
+    # round-5 params riding existing machinery
+    {"saved_feature_importance_type": 1, "early_stopping_round": 3,
+     "early_stopping_min_delta": 0.001},
+    {"monotone_constraints": [1, 0, -1, 0],
+     "monotone_constraints_method": "advanced", "lambda_l2": 0.5},
 ]
 
 
