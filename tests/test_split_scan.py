@@ -122,3 +122,41 @@ def test_fused_grower_matches_default_end_to_end():
         ]
 
     assert _structure(b0) == _structure(b1)
+
+
+def test_fused_scan_inside_data_parallel_mesh():
+    """The fused kernel must trace and run inside the shard_map'd
+    data-parallel grower (the on-chip A/B will run it there): sharded
+    fused training == serial fused == serial default on integer data."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.ops.pallas import split_scan
+
+    devs = jax.devices("cpu")
+    if len(devs) < 4:
+        pytest.skip("needs the virtual CPU mesh")
+    rng = np.random.default_rng(3)
+    n = 4000
+    X = rng.integers(0, 63, size=(n, 6)).astype(np.float64)
+    y = (0.4 * X[:, 0] - 0.2 * X[:, 1] + rng.normal(scale=2.0, size=n))
+
+    def _structure(bst):
+        return [
+            line for line in bst.model_to_string().splitlines()
+            if line.startswith(("split_feature=", "threshold="))
+        ]
+
+    split_scan._INTERPRET = True
+    try:
+        base = {"objective": "regression", "verbosity": -1,
+                "num_leaves": 15, "min_data_in_leaf": 20,
+                "fused_split_scan": True}
+        serial = lgb.train(base, lgb.Dataset(X, y, params=base), 4)
+        dp = {**base, "tree_learner": "data"}
+        sharded = lgb.train(dp, lgb.Dataset(X, y, params=dp), 4)
+    finally:
+        split_scan._INTERPRET = False
+    plain = {"objective": "regression", "verbosity": -1,
+             "num_leaves": 15, "min_data_in_leaf": 20}
+    default = lgb.train(plain, lgb.Dataset(X, y, params=plain), 4)
+    assert _structure(serial) == _structure(default)
+    assert _structure(sharded) == _structure(serial)
