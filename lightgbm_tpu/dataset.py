@@ -343,6 +343,21 @@ def _attach_sidecars(out: Dict[str, Any], path: str) -> Dict[str, Any]:
     ipath = Path(str(path) + ".init")
     if ipath.exists():
         out["init_score"] = np.loadtxt(ipath, dtype=np.float64, ndmin=1)
+    ppath = Path(str(path) + ".position")
+    if ppath.exists():
+        # result positions for unbiased lambdarank (reference
+        # Metadata::LoadPositions, src/io/metadata.cpp:663); string
+        # position ids map to dense codes like the reference's
+        # position_ids_
+        raw = [
+            ln.strip() for ln in ppath.read_text().splitlines() if ln.strip()
+        ]
+        try:
+            out["position"] = np.asarray([int(v) for v in raw], np.int32)
+        except (ValueError, OverflowError):
+            ids = sorted(set(raw))
+            code = {v: i for i, v in enumerate(ids)}
+            out["position"] = np.asarray([code[v] for v in raw], np.int32)
     return out
 
 
@@ -535,6 +550,7 @@ class Dataset:
                 "weight": self._weight,
                 "group": self._group,
                 "init_score": self._init_score,
+                "position": self._position,
             }
             loaded_ds = Dataset.load_binary(str(data), params=self.params)
             self.__dict__.update(loaded_ds.__dict__)
@@ -555,6 +571,8 @@ class Dataset:
                 self._weight = loaded.get("weight")
             if self._init_score is None:
                 self._init_score = loaded.get("init_score")
+            if self._position is None:
+                self._position = loaded.get("position")
         if isinstance(data, Sequence):
             data = _materialize_sequences([data])
         elif isinstance(data, list) and data and all(
@@ -1121,6 +1139,7 @@ class Dataset:
             "weight": self.get_weight,
             "group": self.get_group,
             "init_score": self.get_init_score,
+            "position": self.get_position,
         }
         if name not in getters:
             raise KeyError(name)
@@ -1132,6 +1151,7 @@ class Dataset:
             "weight": self.set_weight,
             "group": self.set_group,
             "init_score": self.set_init_score,
+            "position": self.set_position,
         }
         if name not in setters:
             raise KeyError(name)
@@ -1177,6 +1197,7 @@ class Dataset:
                     "weight": self.metadata.weight,
                     "init_score": self.metadata.init_score,
                     "query_boundaries": self.metadata.query_boundaries,
+                    "position": getattr(self.metadata, "position", None),
                     "arrow_categories": self.arrow_categories,
                     "pandas_categorical": self.pandas_categorical,
                     # parser_config_str_ persists with the binary dataset
@@ -1220,12 +1241,14 @@ class Dataset:
         ds.raw = blob.get("raw")
         ds.feature_names = blob["feature_names"]
         ds.num_total_features = blob["num_total_features"]
+        ds._position = None
         ds.metadata = Metadata(
             label=blob["label"],
             weight=blob["weight"],
             init_score=blob["init_score"],
             query_boundaries=blob["query_boundaries"],
         )
+        ds.metadata.position = blob.get("position")
         ds._device_cache = {}
         return ds
 

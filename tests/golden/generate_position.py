@@ -1,0 +1,92 @@
+"""Generate the position-debias golden from the reference CLI.
+
+    python tests/golden/generate_position.py /path/to/lightgbm-cli
+
+Unbiased lambdarank activates in the reference when a ``<data>.position``
+sidecar is present (Metadata::LoadPositions, src/io/metadata.cpp:663).
+Writes position.train.csv + .query + .position sidecars, the reference's
+model, and its eval trajectory (ndcg@3)."""
+
+import json
+import re
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+import numpy as np
+
+OUT = Path(__file__).parent
+
+CONF = """task = train
+objective = lambdarank
+data = train.csv
+label_column = 0
+num_trees = 10
+learning_rate = 0.15
+num_leaves = 31
+min_data_in_leaf = 10
+is_training_metric = true
+metric = ndcg
+eval_at = 3
+verbosity = 2
+output_model = model.txt
+lambdarank_position_bias_regularization = 0.5
+"""
+
+
+def make_data():
+    rng = np.random.default_rng(29)
+    groups, per = 100, 30
+    n = groups * per
+    X = rng.normal(size=(n, 4))
+    rel = 1.2 * X[:, 0] + 0.6 * X[:, 1] + rng.normal(scale=0.5, size=n)
+    y = np.digitize(rel, np.quantile(rel, [0.5, 0.8, 0.95])).astype(float)
+    # synthetic presentation positions: mostly relevance-ordered with noise,
+    # so the position signal is informative but not degenerate
+    pos = np.zeros(n, np.int32)
+    for g in range(groups):
+        sl = slice(g * per, (g + 1) * per)
+        order = np.argsort(-(rel[sl] + rng.normal(scale=1.0, size=per)))
+        pos[sl][order] = np.arange(per)
+    return X, y, np.full(groups, per), pos
+
+
+def main(cli: str) -> None:
+    cli = str(Path(cli).resolve())
+    X, y, group, pos = make_data()
+    with tempfile.TemporaryDirectory() as td:
+        work = Path(td)
+        np.savetxt(work / "train.csv", np.column_stack([y, X]),
+                   delimiter=",", fmt="%.8f")
+        np.savetxt(work / "train.csv.query", group, fmt="%d")
+        np.savetxt(work / "train.csv.position", pos, fmt="%d")
+        (work / "train.conf").write_text(CONF)
+        p = subprocess.run([cli, "config=train.conf"], cwd=work,
+                           capture_output=True, text=True)
+        if p.returncode != 0:
+            raise RuntimeError(p.stdout + p.stderr)
+        log = p.stdout + p.stderr
+        evals = {}
+        for m in re.finditer(
+            r"Iteration:(\d+), (\S+) (\S+) : ([-\d.eE]+)", log
+        ):
+            it, dsname, metric, val = m.groups()
+            evals.setdefault(f"{dsname}:{metric}", []).append(
+                [int(it), float(val)]
+            )
+        for src, dst in (
+            ("train.csv", "position.train.csv"),
+            ("train.csv.query", "position.train.csv.query"),
+            ("train.csv.position", "position.train.csv.position"),
+            ("model.txt", "position.model.txt"),
+        ):
+            OUT.joinpath(dst).write_text((work / src).read_text())
+        OUT.joinpath("position.evals.json").write_text(
+            json.dumps(evals, indent=1)
+        )
+        print("position goldens:", {k: v[-1] for k, v in evals.items()})
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -315,3 +315,39 @@ def test_refit_golden_parity():
     np.testing.assert_allclose(
         ours.predict(X), ref.predict(X), rtol=1e-5, atol=1e-6
     )
+
+
+def test_position_debias_golden_parity():
+    """Unbiased lambdarank vs the reference on the same data + .position
+    sidecar (reference Metadata::LoadPositions + RankingObjective position
+    bias factors): their model cross-loads, the .position sidecar loads
+    through our text path, and our final train ndcg@3 lands within
+    tolerance of the reference's trajectory."""
+    model_file = GOLDEN / "position.model.txt"
+    if not model_file.exists():
+        pytest.skip("position goldens not generated")
+    evals = json.loads((GOLDEN / "position.evals.json").read_text())
+    ref_ndcg = evals["training:ndcg@3"][-1][1]
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import _load_text_file
+
+    loaded = _load_text_file(str(GOLDEN / "position.train.csv"),
+                             Config.from_params({}))
+    X, y = np.asarray(loaded["data"]), np.asarray(loaded["label"])
+    assert loaded.get("position") is not None  # sidecar picked up
+    ref = lgb.Booster(model_str=model_file.read_text())
+    assert np.isfinite(ref.predict(X)).all()
+    params = {
+        "objective": "lambdarank", "learning_rate": 0.15, "num_leaves": 31,
+        "min_data_in_leaf": 10, "verbosity": -1, "metric": "ndcg",
+        "eval_at": [3], "lambdarank_position_bias_regularization": 0.5,
+    }
+    ds = lgb.Dataset(str(GOLDEN / "position.train.csv"), params=params)
+    ev = {}
+    lgb.train(
+        params, ds, 10, valid_sets=[ds], valid_names=["training"],
+        callbacks=[lgb.record_evaluation(ev)],
+    )
+    key = next(k for k in ev["training"] if "ndcg" in k)
+    ours = ev["training"][key][-1]
+    assert ours >= ref_ndcg * 0.95, (ours, ref_ndcg)

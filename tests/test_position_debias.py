@@ -65,3 +65,29 @@ def test_position_none_unchanged():
     b1 = lgb.train(params, lgb.Dataset(X, y, group=group), 5)
     b2 = lgb.train(params, lgb.Dataset(X, y, group=group), 5)
     np.testing.assert_allclose(b1.predict(X), b2.predict(X))
+
+
+def test_position_survives_binary_roundtrip(tmp_path):
+    """The .position sidecar loads through the text path and survives
+    save_binary/load (silently dropping it would disable debias on the
+    reference CLI's standard binary-dataset workflow)."""
+    rng = np.random.default_rng(5)
+    n, per = 300, 30
+    X = rng.normal(size=(n, 3))
+    y = (X[:, 0] > 0).astype(float)
+    data = tmp_path / "t.csv"
+    np.savetxt(data, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+    np.savetxt(str(data) + ".query", np.full(n // per, per), fmt="%d")
+    pos = np.tile(np.arange(per), n // per)
+    np.savetxt(str(data) + ".position", pos, fmt="%d")
+    p = {"objective": "lambdarank", "verbosity": -1}
+    ds = lgb.Dataset(str(data), params=p)
+    ds.construct()
+    np.testing.assert_array_equal(ds.get_position(), pos)
+    f = str(tmp_path / "t.bin")
+    ds.save_binary(f)
+    d2 = lgb.Dataset(f, params=p)
+    d2.construct()
+    np.testing.assert_array_equal(d2.get_position(), pos)
+    b = lgb.train(p, d2, 3)
+    assert b.num_trees() >= 1
