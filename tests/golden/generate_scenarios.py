@@ -109,6 +109,32 @@ SCENARIOS.update({
 })
 
 
+def _prob_data(seed=31, n=4000, f=4):
+    """Labels in [0, 1] for the cross-entropy family."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    p = 1.0 / (1.0 + np.exp(-(1.1 * X[:, 0] - 0.7 * X[:, 1])))
+    y = np.clip(p + rng.normal(scale=0.08, size=n), 0.0, 1.0)
+    return np.column_stack([y, X])
+
+
+def _weighted_data(seed=37, n=4000, f=4):
+    """(arr, sidecars): per-row weights emphasizing half the rows."""
+    arr = _data(seed=seed, n=n, f=f)
+    rng = np.random.default_rng(seed + 1)
+    w = np.where(rng.random(n) < 0.5, 3.0, 0.5)
+    return arr, {"weight": w}
+
+
+SCENARIOS.update({
+    "obj_xentropy": ({"objective": "cross_entropy",
+                      "metric": "cross_entropy"}, _prob_data),
+    "obj_xentlambda": ({"objective": "cross_entropy_lambda",
+                        "metric": "cross_entropy_lambda"}, _prob_data),
+    "weighted": ({"metric": "l2"}, _weighted_data),
+})
+
+
 def _conf_value(v):
     if isinstance(v, bool):
         return "true" if v else "false"
@@ -124,10 +150,13 @@ def main(cli: str) -> None:
         conf = IO_CONF + "".join(
             f"{k} = {_conf_value(v)}\n" for k, v in merged.items()
         )
-        arr = mk()
+        made = mk()
+        arr, sidecars = made if isinstance(made, tuple) else (made, {})
         with tempfile.TemporaryDirectory() as td:
             work = Path(td)
             np.savetxt(work / "train.csv", arr, delimiter=",", fmt="%.8f")
+            for side, vals in sidecars.items():
+                np.savetxt(work / f"train.csv.{side}", vals, fmt="%.8f")
             (work / "train.conf").write_text(conf)
             p = subprocess.run(
                 [cli, "config=train.conf"], cwd=work, capture_output=True,
@@ -157,6 +186,10 @@ def main(cli: str) -> None:
             OUT.joinpath(f"scen_{name}.train.csv").write_text(
                 (work / "train.csv").read_text()
             )
+            for side in sidecars:
+                OUT.joinpath(f"scen_{name}.train.csv.{side}").write_text(
+                    (work / f"train.csv.{side}").read_text()
+                )
             OUT.joinpath(f"scen_{name}.model.txt").write_text(
                 (work / "model.txt").read_text()
             )

@@ -209,6 +209,7 @@ _SCENARIO_NAMES = [
     "cegb", "goss", "monotone_advanced", "monotone_basic", "quantized",
     "widebin", "obj_tweedie", "obj_poisson", "obj_quantile", "obj_huber",
     "obj_gamma", "obj_fair", "obj_mape", "obj_l1", "dart", "bagging",
+    "obj_xentropy", "obj_xentlambda", "weighted",
 ]
 
 
@@ -236,7 +237,9 @@ def test_scenario_golden_parity(name):
     evals = json.loads((GOLDEN / f"scen_{name}.evals.json").read_text())
     ref_key = next(k for k in evals if k.endswith(metric))
     ref_final = evals[ref_key][-1][1]
-    ds = lgb.Dataset(X, y, params=params)
+    wfile = GOLDEN / f"scen_{name}.train.csv.weight"
+    weight = np.loadtxt(wfile, ndmin=1) if wfile.exists() else None
+    ds = lgb.Dataset(X, y, weight=weight, params=params)
     ev = {}
     b = lgb.train(
         params, ds, rounds, valid_sets=[ds], valid_names=["training"],
