@@ -132,6 +132,15 @@ SCENARIOS.update({
     "obj_xentlambda": ({"objective": "cross_entropy_lambda",
                         "metric": "cross_entropy_lambda"}, _prob_data),
     "weighted": ({"metric": "l2"}, _weighted_data),
+    # 3-tuples carry AUX FILES the conf references by bare filename; the
+    # parity test rewrites *_filename params to the fixture copies
+    "interaction": ({"interaction_constraints": "[0,1],[2,3]"}, _data),
+    "forcedsplits": (
+        {"forcedsplits_filename": "forced_splits.json"}, _data,
+        {"forced_splits.json":
+         '{"feature": 2, "threshold": 0.5, '
+         '"left": {"feature": 3, "threshold": -0.25}}'},
+    ),
 })
 
 
@@ -145,7 +154,9 @@ def _conf_value(v):
 
 def main(cli: str) -> None:
     cli = str(Path(cli).resolve())
-    for name, (extra, mk) in SCENARIOS.items():
+    for name, scen in SCENARIOS.items():
+        extra, mk = scen[0], scen[1]
+        aux_files = scen[2] if len(scen) > 2 else {}
         merged = {**BASE_PARAMS, **extra}
         conf = IO_CONF + "".join(
             f"{k} = {_conf_value(v)}\n" for k, v in merged.items()
@@ -157,6 +168,9 @@ def main(cli: str) -> None:
             np.savetxt(work / "train.csv", arr, delimiter=",", fmt="%.8f")
             for side, vals in sidecars.items():
                 np.savetxt(work / f"train.csv.{side}", vals, fmt="%.8f")
+            for fname, content in aux_files.items():
+                (work / fname).write_text(content)
+                OUT.joinpath(f"scen_{name}.{fname}").write_text(content)
             (work / "train.conf").write_text(conf)
             p = subprocess.run(
                 [cli, "config=train.conf"], cwd=work, capture_output=True,

@@ -209,7 +209,8 @@ _SCENARIO_NAMES = [
     "cegb", "goss", "monotone_advanced", "monotone_basic", "quantized",
     "widebin", "obj_tweedie", "obj_poisson", "obj_quantile", "obj_huber",
     "obj_gamma", "obj_fair", "obj_mape", "obj_l1", "dart", "bagging",
-    "obj_xentropy", "obj_xentlambda", "weighted",
+    "obj_xentropy", "obj_xentlambda", "weighted", "interaction",
+    "forcedsplits",
 ]
 
 
@@ -233,6 +234,10 @@ def test_scenario_golden_parity(name):
     params = json.loads((GOLDEN / f"scen_{name}.params.json").read_text())
     params["verbosity"] = -1
     rounds = int(params.pop("num_trees", 10))
+    # aux files travel as scen_<name>.<filename>; rewrite path params
+    for k, v in list(params.items()):
+        if k.endswith("_filename") and v:
+            params[k] = str(GOLDEN / f"scen_{name}.{v}")
     metric = params.get("metric", "l2")
     evals = json.loads((GOLDEN / f"scen_{name}.evals.json").read_text())
     ref_key = next(k for k in evals if k.endswith(metric))
@@ -257,6 +262,23 @@ def test_scenario_golden_parity(name):
     assert ours_final <= ref_final + rtol * abs(ref_final) + 1e-9, (
         ours_final, ref_final,
     )
+    if name == "forcedsplits":
+        # both engines must root at the forced feature 2 with the SAME
+        # bin-snapped threshold (both snap the forced 0.5 to the nearest
+        # bin upper bound; equal-count binning on identical data agrees)
+        roots = []
+        for bst in (ref, b):
+            tree0 = bst.model_to_string().split("Tree=1")[0]
+            feats = thrs = None
+            for line in tree0.splitlines():
+                if line.startswith("split_feature="):
+                    feats = [int(t) for t in line.split("=")[1].split()]
+                if line.startswith("threshold="):
+                    thrs = [float(t) for t in line.split("=")[1].split()]
+            roots.append((feats[0], thrs[0]))
+        assert roots[0][0] == roots[1][0] == 2, roots
+        assert abs(roots[0][1] - 0.5) < 0.05, roots  # snapped near 0.5
+        assert abs(roots[0][1] - roots[1][1]) < 1e-6, roots
     if name.startswith("monotone"):
         # the produced model must actually satisfy the constraints
         rng2 = np.random.default_rng(0)
