@@ -118,6 +118,18 @@ def _prob_data(seed=31, n=4000, f=4):
     return np.column_stack([y, X])
 
 
+def _cat_data(seed=41, n=4000):
+    """Feature 3 is an integer category whose subset {2, 5, 9} drives y."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4))
+    X[:, 3] = rng.integers(0, 12, size=n)
+    y = (
+        0.8 * X[:, 0] + np.where(np.isin(X[:, 3], [2, 5, 9]), 1.5, -0.5)
+        + rng.normal(scale=0.3, size=n)
+    )
+    return np.column_stack([y, X])
+
+
 def _weighted_data(seed=37, n=4000, f=4):
     """(arr, sidecars): per-row weights emphasizing half the rows."""
     arr = _data(seed=seed, n=n, f=f)
@@ -135,6 +147,10 @@ SCENARIOS.update({
     # 3-tuples carry AUX FILES the conf references by bare filename; the
     # parity test rewrites *_filename params to the fixture copies
     "interaction": ({"interaction_constraints": "[0,1],[2,3]"}, _data),
+    "categorical": (
+        {"categorical_feature": "3", "min_data_per_group": 5,
+         "cat_smooth": 2.0}, lambda: _cat_data(),
+    ),
     "forcedsplits": (
         {"forcedsplits_filename": "forced_splits.json"}, _data,
         {"forced_splits.json":

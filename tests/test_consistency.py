@@ -210,7 +210,7 @@ _SCENARIO_NAMES = [
     "widebin", "obj_tweedie", "obj_poisson", "obj_quantile", "obj_huber",
     "obj_gamma", "obj_fair", "obj_mape", "obj_l1", "dart", "bagging",
     "obj_xentropy", "obj_xentlambda", "weighted", "interaction",
-    "forcedsplits",
+    "forcedsplits", "categorical",
 ]
 
 
@@ -262,6 +262,10 @@ def test_scenario_golden_parity(name):
     assert ours_final <= ref_final + rtol * abs(ref_final) + 1e-9, (
         ours_final, ref_final,
     )
+    if name == "categorical":
+        # both engines must actually have used categorical (bitset) splits
+        for bst in (ref, b):
+            assert "cat_threshold=" in bst.model_to_string()
     if name == "forcedsplits":
         # both engines must root at the forced feature 2 with the SAME
         # bin-snapped threshold (both snap the forced 0.5 to the nearest
