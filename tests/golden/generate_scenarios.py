@@ -151,6 +151,9 @@ SCENARIOS.update({
         {"categorical_feature": "3", "min_data_per_group": 5,
          "cat_smooth": 2.0}, lambda: _cat_data(),
     ),
+    # the reference build links Eigen (tensorflow wheel headers), so
+    # linear trees golden-compare too
+    "linear": ({"linear_tree": True, "linear_lambda": 0.1}, _data),
     "forcedsplits": (
         {"forcedsplits_filename": "forced_splits.json"}, _data,
         {"forced_splits.json":

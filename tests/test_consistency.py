@@ -210,7 +210,7 @@ _SCENARIO_NAMES = [
     "widebin", "obj_tweedie", "obj_poisson", "obj_quantile", "obj_huber",
     "obj_gamma", "obj_fair", "obj_mape", "obj_l1", "dart", "bagging",
     "obj_xentropy", "obj_xentlambda", "weighted", "interaction",
-    "forcedsplits", "categorical",
+    "forcedsplits", "categorical", "linear",
 ]
 
 
