@@ -190,6 +190,7 @@ class Booster:
                     ta_host,
                     self.train_set.bin_mappers,
                     self.train_set.used_features,
+                    bundle_layout=self._bundle,
                 )
                 if self.config.verbosity >= 2:
                     tree.validate()  # debug CHECK paths (tree.py)
@@ -362,7 +363,7 @@ class Booster:
                 # (reference feature_parallel_tree_learner.cpp:37 — every
                 # machine holds the full data).  The mesh shrinks to the
                 # largest device count dividing the used-feature count.
-                f_used_cnt = len(train_set.used_features)
+                f_used_cnt = train_set.num_planes
                 dn = 0
                 for d in range(min(len(devices), max(f_used_cnt, 1)), 0, -1):
                     if f_used_cnt % d == 0:
@@ -550,24 +551,31 @@ class Booster:
         else:
             self._score = jnp.asarray(init)
             self._bins = train_set.device_bins()
-        nb = train_set.num_bins_per_feature()
-        self._num_bins = jnp.asarray(nb, dtype=jnp.int32)
-        nan_bins = np.array(
-            [train_set.bin_mappers[j].nan_bin for j in train_set.used_features],
-            dtype=np.int32,
+        # per-COLUMN operand arrays: with EFB a bin-matrix column is a
+        # bundle plane, without it a used feature (dataset plane accessors
+        # return the right thing either way)
+        self._bundle = getattr(train_set, "bundle_layout", None)
+        self._has_bundle = bool(
+            self._bundle is not None and self._bundle.has_bundles
         )
+        nb = train_set.plane_num_bins()
+        self._num_bins = jnp.asarray(nb, dtype=jnp.int32)
+        nan_bins = train_set.plane_nan_bins()
         if len(nan_bins) == 0:
             nan_bins = np.array([-1], dtype=np.int32)  # pairs with the dummy column
         self._nan_bins = jnp.asarray(nan_bins)
-        isc = np.array(
-            [train_set.bin_mappers[j].is_categorical for j in train_set.used_features],
-            dtype=bool,
-        )
+        isc = train_set.plane_is_cat()
         if len(isc) == 0:
             isc = np.array([False])
         self._has_cat = bool(isc.any())
         self._is_cat = jnp.asarray(isc) if self._has_cat else None
         self._max_bin_padded = _ceil_pow2(int(nb.max()) if len(nb) else 2)
+        self._bundle_end = (
+            jnp.asarray(self._bundle.bundle_end_array(self._max_bin_padded))
+            if self._has_bundle
+            else None
+        )
+        self._check_bundle_compat()
         self._setup_constraints()
         self._forced = self._build_forced_splits()
         self._setup_cegb()
@@ -679,6 +687,48 @@ class Booster:
             for kk in range(k)
         ]
 
+    def _check_bundle_compat(self) -> None:
+        """EFB-bundled datasets reuse the numeric gain path + mask partition;
+        modes that reinterpret the column axis per-feature (or per-candidate)
+        are not wired through bundle planes — fail with the fix spelled out
+        (the grower re-checks statically as a backstop)."""
+        if not self._has_bundle:
+            return
+        cfg = self.config
+        conflicts = [
+            (
+                cfg.monotone_constraints
+                and any(v != 0 for v in cfg.monotone_constraints),
+                "monotone_constraints",
+            ),
+            (
+                isinstance(cfg.interaction_constraints, str)
+                and cfg.interaction_constraints.strip() != ""
+                or isinstance(cfg.interaction_constraints, (list, tuple))
+                and len(cfg.interaction_constraints) > 0,
+                "interaction_constraints",
+            ),
+            (bool(cfg.forcedsplits_filename), "forcedsplits_filename"),
+            (cfg.extra_trees, "extra_trees"),
+            (
+                cfg.cegb_tradeoff < 1.0
+                or cfg.cegb_penalty_split > 0.0
+                or bool(cfg.cegb_penalty_feature_coupled),
+                "CEGB penalties",
+            ),
+            (
+                cfg.tree_learner in ("feature", "voting"),
+                f"tree_learner='{cfg.tree_learner}'",
+            ),
+        ]
+        for bad, what in conflicts:
+            if bad:
+                raise ValueError(
+                    f"{what} is not supported together with EFB feature "
+                    "bundling; pass enable_bundle=false in the Dataset "
+                    "params to train this configuration"
+                )
+
     def _setup_constraints(self) -> None:
         """Map per-original-feature constraints onto used columns."""
         cfg = self.config
@@ -735,6 +785,11 @@ class Booster:
             self._is_cat
             if self._is_cat is not None
             else jnp.zeros((f_used,), bool)
+        )
+        self._bundle_end_arg = (
+            self._bundle_end
+            if self._bundle_end is not None
+            else jnp.full((1, 1), -1, jnp.int32)  # static no-op dummy
         )
 
     def _quant_grow_inputs(self, grad_k, hess_k):
@@ -814,6 +869,7 @@ class Booster:
                 self._forced,
                 *self._cegb_args(),
                 self._quant_scales_arg(),
+                self._bundle_end_arg,
             )
         return grow_tree(
             self._bins,
@@ -830,6 +886,7 @@ class Booster:
             is_cat=self._is_cat,
             forced=self._forced,
             quant_scales=getattr(self, "_quant_scales", None),
+            bundle_end=self._bundle_end,
             **(
                 dict(zip(("cegb_penalty", "cegb_used"), self._cegb_args()))
                 if self._cegb_coupled is not None
@@ -950,8 +1007,10 @@ class Booster:
         # segment-resident mode (streaming partition + histogram kernels,
         # ops/pallas/) is the fast path on TPU: eligible whenever bins fit
         # a byte and the packed row fits 128 i16 lanes; hist_method
-        # 'pallas_int8' rides the seg path's own int8 grid kernel (r3)
-        n_used = len(self.train_set.used_features) if self.train_set else 0
+        # 'pallas_int8' rides the seg path's own int8 grid kernel (r3).
+        # The budget counts bin-matrix COLUMNS — with EFB that is bundle
+        # planes, which is exactly how 50k one-hot columns fit the seg path.
+        n_used = int(self._bins.shape[1]) if self.train_set else 0
         import jax as _jax
 
         # the ONE config-time validation for int8 kernels (both seg and
@@ -1067,6 +1126,7 @@ class Booster:
             use_cegb=self._cegb_coupled is not None,
             cegb_split_penalty=cfg.cegb_tradeoff * cfg.cegb_penalty_split,
             fused_split_scan=cfg.fused_split_scan,
+            use_bundle=self._has_bundle,
         )
 
     def _fit_linear_leaves(
@@ -1429,6 +1489,7 @@ class Booster:
                     ta_host,
                     self.train_set.bin_mappers,
                     self.train_set.used_features,
+                    bundle_layout=self._bundle,
                 )
                 if cfg.verbosity >= 2:
                     tree.validate()  # debug CHECK paths (tree.py)
@@ -1879,8 +1940,12 @@ class Booster:
 
         if _jax.default_backend() != "tpu" and not _WALK_INTERPRET:
             return None
+        if getattr(self, "_has_bundle", False):
+            # EFB models carry plane-membership nodes the walk kernel's
+            # threshold tables don't model; the XLA bin walker handles them
+            return None
         n = X.shape[0]
-        n_used = len(self.train_set.used_features)
+        n_used = self.train_set.num_planes
         recs = self._bin_records[t0:t1]
         nanb = np.asarray(self._nan_bins)
         reason = walk_reject_reason(recs, nanb, n_used, self._max_bin_padded)
@@ -2084,8 +2149,8 @@ class Booster:
             # resize() would mutate the caller's matrix
             csc = csc.copy()
             csc.resize(csc.shape[0], ds.num_total_features)
-        cols = []
-        for j in ds.used_features:
+
+        def _feature_bins(j):
             mapper = ds.bin_mappers[j]
             if csc is not None:
                 sl = slice(csc.indptr[j], csc.indptr[j + 1])
@@ -2104,7 +2169,16 @@ class Booster:
                 known = np.isin(iv, mapper.bin_to_cat) & (iv >= 0)
                 sentinel = np.int32(1 << 20)
                 b = np.where(known | (nan_mask & (mapper.nan_bin >= 0)), b, sentinel)
-            cols.append(b)
+            return b
+
+        layout = getattr(ds, "bundle_layout", None)
+        if layout is not None:
+            # EFB: predict input packs into the SAME plane columns training
+            # used, so bin-space walks see identical decisions
+            return layout.pack_columns(X.shape[0], _feature_bins).astype(
+                np.int32
+            )
+        cols = [_feature_bins(j) for j in ds.used_features]
         mat = (
             np.stack(cols, axis=1)
             if cols
@@ -2726,9 +2800,21 @@ class Booster:
         # reconstruct representative values from bins (inverse binning):
         # exact for the tree decisions because thresholds are bin bounds
         cols = np.zeros((ds.num_data, ds.num_total_features))
+        layout = getattr(ds, "bundle_layout", None)
         for ci, j in enumerate(ds.used_features):
             mapper = ds.bin_mappers[j]
-            b = ds.bins[:, ci].astype(np.int64)
+            if layout is None:
+                b = ds.bins[:, ci].astype(np.int64)
+            else:
+                # unpack the feature's local bins from its EFB plane column
+                p, k = layout.feature_position(j)
+                pb = ds.bins[:, p].astype(np.int64)
+                if layout.is_bundle(p):
+                    s = layout.starts[p][k]
+                    w = layout.widths[p][k]
+                    b = np.where((pb >= s) & (pb < s + w), pb - s + 1, 0)
+                else:
+                    b = pb
             if mapper.is_categorical:
                 table = np.asarray(mapper.bin_to_cat, dtype=np.float64)
                 table = np.concatenate([table, [np.nan]])
@@ -2742,6 +2828,7 @@ class Booster:
     def _bin_record_from_tree(self, tree: Tree) -> dict:
         """Re-express a real-valued tree in bin space for the device predictor."""
         ds = self.train_set
+        layout = getattr(ds, "bundle_layout", None)
         nn = tree.num_leaves - 1
         sf_used = np.zeros(nn, dtype=np.int32)
         sbin = np.zeros(nn, dtype=np.int32)
@@ -2756,7 +2843,32 @@ class Booster:
                 ok = False
                 break
             mapper = ds.bin_mappers[orig]
-            sf_used[t] = orig_to_used[orig]
+            if layout is not None:
+                p, k = layout.feature_position(orig)
+                sf_used[t] = p
+                if layout.is_bundle(p) and not (tree.decision_type[t] & 1):
+                    # numeric split on a bundled member -> plane-bin
+                    # membership mask (left = everything except the member's
+                    # bins above the threshold), mirroring training's form
+                    ub = np.asarray(mapper.bin_upper_bound)
+                    thr = float(tree.threshold[t])
+                    tl = int(np.searchsorted(ub, thr, side="left"))
+                    bval = ub[tl] if tl < len(ub) else np.inf
+                    if not (
+                        bval == thr
+                        or abs(bval - thr) <= 1e-10 * max(1.0, abs(thr))
+                    ):
+                        ok = False
+                        break
+                    s = layout.starts[p][k]
+                    w = layout.widths[p][k]
+                    has_cat = True
+                    sic[t] = True
+                    bids = np.arange(self._max_bin_padded)
+                    cmask[t] = ~((bids >= s + tl) & (bids < s + w))
+                    continue
+            else:
+                sf_used[t] = orig_to_used[orig]
             if tree.decision_type[t] & 1:
                 # categorical: map the cat_threshold value-bitset back onto
                 # this dataset's bins (cat value -> bin via cat_to_bin)

@@ -362,6 +362,9 @@ class Config:
     data_random_seed: int = 1
     is_enable_sparse: bool = True
     enable_bundle: bool = True
+    # EFB conflict budget: fraction of rows of a bundle allowed to carry two
+    # nonzero members (reference config.h max_conflict_rate; 0.0 = exact)
+    max_conflict_rate: float = 0.0
     use_missing: bool = True
     zero_as_missing: bool = False
     feature_pre_filter: bool = True

@@ -15,7 +15,35 @@ trains with ``pre_partition`` process-local data — collectives ride XLA
 dask itself is optional and duck-typed: any object with
 ``scheduler_info()`` and ``submit(fn, *args, workers=[addr])`` returning
 futures with ``.result()`` works (the test suite drives the whole flow
-with a mock client whose "workers" are local subprocesses)."""
+with a mock client whose "workers" are local subprocesses).
+
+Partition contract
+------------------
+Training quality and determinism depend on HOW rows land on workers, so
+the split rules are explicit:
+
+* plain array-likes (numpy / scipy) are split into ``n_workers``
+  CONTIGUOUS row chunks in the caller's row order (``_partition_data``)
+  — no shuffling, so a sorted-by-time frame stays time-ordered per
+  worker and the model is reproducible for a fixed worker count;
+* ranking (``group=``) never splits a query across workers: chunk cuts
+  snap to query boundaries, and because multi-process training pads no
+  rows, the per-worker row counts must come out EQUAL — otherwise the
+  fit fails fast with the offending cut points (rearrange groups or
+  change the worker count);
+* the per-worker partition is the unit the distributed binner samples
+  from (`pre_partition`), so pathological per-worker distributions
+  (e.g. one worker holding all positives) are the caller's to avoid —
+  same contract as the reference's dask.py, which follows the
+  collection's existing partitioning;
+* actual dask collections (``dask.array`` / ``dask.dataframe``) are
+  REJECTED with guidance rather than silently ``compute()``d on the
+  driver: honoring their own partitioning needs ``to_delayed()`` and a
+  per-partition scatter, which requires dask at runtime — this
+  environment ships without dask, so that path stays unimplemented
+  behind the type check in ``_partition_data`` (first thing to lift if
+  dask becomes available: map each delayed partition to one worker and
+  skip ``_split_rows`` entirely)."""
 
 from __future__ import annotations
 

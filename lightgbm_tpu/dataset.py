@@ -5,11 +5,19 @@ Reference analogs: ``Dataset``/``Metadata`` (include/LightGBM/dataset.h:487,
 
 TPU-first design: instead of per-feature Bin column objects with col-wise /
 row-wise layout heuristics (reference dataset.cpp:619 GetShareStates), the
-whole dataset is ONE dense ``[num_rows, num_used_features]`` uint8/uint16
-device array of bin indices.  Binning happens host-side in NumPy at
-construction from a row sample (reference bin_construct_sample_cnt), then the
-binned matrix is pushed to HBM once.  EFB/feature-bundling is unnecessary in
-this layout (a dense uint8 matrix is already the bundled form).
+whole dataset is ONE dense ``[num_rows, num_planes]`` uint8/uint16 device
+array of bin indices.  Binning happens host-side in NumPy at construction
+from a row sample (reference bin_construct_sample_cnt), then the binned
+matrix is pushed to HBM once.
+
+Exclusive Feature Bundling (EFB, reference dataset.cpp FindGroups /
+FastFeatureBundling): with ``enable_bundle`` (default true), mutually
+exclusive sparse columns share one bin plane — plane bin 0 is the shared
+all-default bin and each member owns a contiguous sub-range (bundling.py).
+Wide one-hot data then trains with #bundles planes instead of #columns,
+which is both the histogram-volume win and what keeps the dense [N, P]
+layout viable at 50k+ columns.  Dense data never bundles (eligibility in
+bundling.py), so its bin matrix stays byte-identical to the unbundled form.
 """
 
 from __future__ import annotations
@@ -331,14 +339,92 @@ def _label_column_index(config: Config, header_line: Optional[str]) -> int:
     return int(lc.split("=")[-1]) if "=" in lc else int(lc)
 
 
+def _resolve_data_columns(
+    spec, header_line: Optional[str], label_col: int, what: str
+) -> List[int]:
+    """Resolve a weight/group/ignore column spec to RAW file-column indices
+    (reference DatasetLoader::SetHeader, src/io/dataset_loader.cpp:111-160):
+    integer indices do NOT count the label column; ``name:a,b`` forms need
+    ``header=true`` and resolve against the header names."""
+    if spec in ("", None):
+        return []
+    s = str(spec)
+    if s.startswith("name:"):
+        if not header_line:
+            raise ValueError(
+                f"{what}='name:...' requires header=true so column names "
+                "can be resolved"
+            )
+        delim = "\t" if "\t" in header_line else ","
+        names = [t.strip() for t in header_line.split(delim)]
+        out = []
+        for nm in s[len("name:"):].split(","):
+            nm = nm.strip()
+            if nm == "":
+                continue
+            if nm not in names:
+                raise ValueError(f"{what} names {nm!r} but the header has {names}")
+            out.append(names.index(nm))
+        return out
+    out = []
+    for tok in s.split(","):
+        tok = tok.strip()
+        if tok == "":
+            continue
+        idx = int(tok)
+        # "doesn't count the label column": data column i is raw column
+        # i when i < label_col, else i + 1
+        out.append(idx if idx < label_col else idx + 1)
+    return out
+
+
+def _extract_column_fields(
+    arr: np.ndarray, config: Config, header_line: Optional[str], label_col: int
+) -> Dict[str, Any]:
+    """weight_column / group_column / ignore_column extraction for the dense
+    text path (reference dataset_loader.cpp:111-160).  Extracted columns
+    REMAIN in the feature matrix but are marked ignored (trivial mappers),
+    preserving the reference's original feature numbering in models."""
+    out: Dict[str, Any] = {}
+    ignore_raw: List[int] = []
+    wcols = _resolve_data_columns(
+        config.weight_column, header_line, label_col, "weight_column"
+    )
+    if wcols:
+        out["weight"] = arr[:, wcols[0]].astype(np.float64)
+        ignore_raw += wcols[:1]
+    gcols = _resolve_data_columns(
+        config.group_column, header_line, label_col, "group_column"
+    )
+    if gcols:
+        # the group column holds per-row query ids; consecutive runs become
+        # query sizes (reference Metadata::SetQueryId)
+        q = arr[:, gcols[0]].astype(np.int64)
+        change = np.nonzero(np.diff(q))[0] + 1
+        bounds = np.concatenate([[0], change, [len(q)]])
+        out["group"] = np.diff(bounds)
+        ignore_raw += gcols[:1]
+    ignore_raw += _resolve_data_columns(
+        config.ignore_column, header_line, label_col, "ignore_column"
+    )
+    if ignore_raw:
+        # raw file column -> feature index after the label column is removed
+        out["ignore"] = sorted(
+            {c - (1 if c > label_col else 0) for c in ignore_raw
+             if c != label_col}
+        )
+    return out
+
+
 def _attach_sidecars(out: Dict[str, Any], path: str) -> Dict[str, Any]:
     """Load the reference's sidecar files (train.txt.query/.weight/.init)
     next to any text data file (reference Metadata::LoadQueryBoundaries)."""
     qpath = Path(str(path) + ".query")
-    if qpath.exists():
+    if qpath.exists() and "group" not in out:
+        # an explicit group_column wins over the sidecar
         out["group"] = np.loadtxt(qpath, dtype=np.int64, ndmin=1)
     wpath = Path(str(path) + ".weight")
-    if wpath.exists():
+    if wpath.exists() and "weight" not in out:
         out["weight"] = np.loadtxt(wpath, dtype=np.float64, ndmin=1)
     ipath = Path(str(path) + ".init")
     if ipath.exists():
@@ -447,6 +533,7 @@ def _load_text_file(path: str, config: Config) -> Dict[str, Any]:
     label = arr[:, label_col]
     feats = np.delete(arr, label_col, axis=1)
     out: Dict[str, Any] = {"data": feats, "label": label}
+    out.update(_extract_column_fields(arr, config, header_line, label_col))
     return _attach_sidecars(out, path)
 
 
@@ -490,7 +577,11 @@ class Dataset:
         self._constructed = False
         self.bin_mappers: List[BinMapper] = []
         self.used_features: List[int] = []  # original feature idx per used column
-        self.bins: Optional[np.ndarray] = None  # [N, num_used] uint8/uint16
+        # EFB plane layout (bundling.py), or None for the identity layout
+        # (bins column ci <=> used_features[ci])
+        self.bundle_layout = None
+        self._ignore_set: set = set()  # ignore_column / weight_column / group_column
+        self.bins: Optional[np.ndarray] = None  # [N, num_planes] uint8/uint16
         self.raw: Optional[np.ndarray] = None  # raw values (for linear trees / predict checks)
         self.metadata: Optional[Metadata] = None
         self.feature_names: List[str] = []
@@ -519,6 +610,52 @@ class Dataset:
     def num_bins_per_feature(self) -> np.ndarray:
         self.construct()
         return np.array([self.bin_mappers[i].num_bins for i in self.used_features], dtype=np.int32)
+
+    # -------------------------------------------------- plane-space accessors
+    # The trainer consumes bins COLUMN-wise; with EFB a column is a bundle
+    # plane, without it a used feature (identity).  These return per-column
+    # arrays either way (boosting/gbdt.py builds its device operands here).
+    @property
+    def num_planes(self) -> int:
+        self.construct()
+        return int(self.bins.shape[1])
+
+    def plane_num_bins(self) -> np.ndarray:
+        self.construct()
+        if self.bundle_layout is not None:
+            return np.asarray(self.bundle_layout.plane_bins, dtype=np.int32)
+        return self.num_bins_per_feature()
+
+    def plane_nan_bins(self) -> np.ndarray:
+        self.construct()
+        if self.bundle_layout is None:
+            return np.array(
+                [self.bin_mappers[j].nan_bin for j in self.used_features],
+                dtype=np.int32,
+            )
+        # bundle planes never carry a NaN bin (bundling eligibility)
+        return np.array(
+            [
+                self.bin_mappers[feats[0]].nan_bin if len(feats) == 1 else -1
+                for feats in self.bundle_layout.planes
+            ],
+            dtype=np.int32,
+        )
+
+    def plane_is_cat(self) -> np.ndarray:
+        self.construct()
+        if self.bundle_layout is None:
+            return np.array(
+                [self.bin_mappers[j].is_categorical for j in self.used_features],
+                dtype=bool,
+            )
+        return np.array(
+            [
+                len(feats) == 1 and self.bin_mappers[feats[0]].is_categorical
+                for feats in self.bundle_layout.planes
+            ],
+            dtype=bool,
+        )
 
     # ------------------------------------------------------------ construct
     def construct(self) -> "Dataset":
@@ -563,6 +700,7 @@ class Dataset:
             loaded = _load_text_file(str(data), self.config)
             data = loaded["data"]
             self.parser_config_str = loaded.get("parser_config_str", "")
+            self._ignore_set = set(loaded.get("ignore", []))
             if label is None:
                 label = loaded.get("label")
             if self._group is None:
@@ -643,6 +781,7 @@ class Dataset:
             ref = self.reference.construct()
             self.bin_mappers = ref.bin_mappers
             self.used_features = ref.used_features
+            self.bundle_layout = getattr(ref, "bundle_layout", None)
             self.feature_names = ref.feature_names
             self.num_total_features = ref.num_total_features
             if sparse_csc is not None and sparse_csc.shape[1] < self.num_total_features:
@@ -658,21 +797,48 @@ class Dataset:
             self._build_bin_mappers(data, cat_idx)
         self._sync_mappers_across_processes()
 
-        max_bins = max((m.num_bins for m in self.bin_mappers), default=1)
+        # ---- EFB (reference dataset.cpp FindGroups): bundle mutually
+        # exclusive sparse columns into shared planes BEFORE the footprint
+        # check — bundling is exactly what makes sparse-wide data fit the
+        # dense plane layout.  Validation sets inherit the reference layout
+        # above so planes bin identically.
+        if self.reference is None and self.config.enable_bundle \
+                and self._bundling_allowed():
+            self.bundle_layout = self._find_bundle_layout(data, sparse_csc, n)
+        layout = self.bundle_layout
+        if layout is not None:
+            max_bins = max(layout.plane_bins)
+            n_cols = layout.num_planes
+        else:
+            max_bins = max((m.num_bins for m in self.bin_mappers), default=1)
+            n_cols = len(self.used_features)
         dtype = np.uint8 if max_bins <= 256 else np.uint16
-        self._check_binned_footprint(n, len(self.used_features),
-                                     np.dtype(dtype).itemsize)
+        self._check_binned_footprint(n, n_cols, np.dtype(dtype).itemsize)
         if sparse_csc is not None:
-            binned = np.zeros((n, len(self.used_features)), dtype=dtype)
+            binned = np.zeros((n, n_cols), dtype=dtype)
             for ci, j in enumerate(self.used_features):
                 mapper = self.bin_mappers[j]
                 sl = slice(sparse_csc.indptr[j], sparse_csc.indptr[j + 1])
-                zb = mapper.values_to_bins(np.zeros(1))[0]
-                if zb:
-                    binned[:, ci] = zb
-                binned[sparse_csc.indices[sl], ci] = mapper.values_to_bins(
-                    sparse_csc.data[sl]
-                ).astype(dtype)
+                if layout is None:
+                    p, bundled = ci, False
+                else:
+                    p, k = layout.feature_position(j)
+                    bundled = layout.is_bundle(p)
+                if not bundled:
+                    zb = mapper.values_to_bins(np.zeros(1))[0]
+                    if zb:
+                        binned[:, p] = zb
+                    binned[sparse_csc.indices[sl], p] = mapper.values_to_bins(
+                        sparse_csc.data[sl]
+                    ).astype(dtype)
+                else:
+                    # bundle member: non-default bins land at start + b - 1;
+                    # zeros stay in the shared plane bin 0 (default_bin == 0
+                    # is a bundling-eligibility invariant)
+                    local = mapper.values_to_bins(sparse_csc.data[sl])
+                    layout.pack_sparse_members(
+                        binned, p, k, sparse_csc.indices[sl], local
+                    )
             self.bins = binned
             if self.config.linear_tree:
                 raise ValueError("linear_tree is not supported for sparse input")
@@ -680,14 +846,21 @@ class Dataset:
             # cv()'s fold slicing works; the dense float is still never built
             self.raw = None if self.free_raw_data else sparse_csc.tocsr()
         else:
-            cols = []
-            for j in self.used_features:
-                cols.append(self.bin_mappers[j].values_to_bins(data[:, j]))
-            if cols:
-                binned = np.stack(cols, axis=1)
+            if layout is not None:
+                binned = layout.pack_columns(
+                    n,
+                    lambda j: self.bin_mappers[j].values_to_bins(data[:, j]),
+                )
+                self.bins = binned.astype(dtype)
             else:
-                binned = np.zeros((n, 0), dtype=np.int32)
-            self.bins = binned.astype(dtype)
+                cols = []
+                for j in self.used_features:
+                    cols.append(self.bin_mappers[j].values_to_bins(data[:, j]))
+                if cols:
+                    binned = np.stack(cols, axis=1)
+                else:
+                    binned = np.zeros((n, 0), dtype=np.int32)
+                self.bins = binned.astype(dtype)
             self.raw = (
                 data
                 if (self.config.linear_tree or not self.free_raw_data)
@@ -805,6 +978,14 @@ class Dataset:
         """Shared per-feature mapper construction for the dense and sparse
         builders (max_bin_by_feature lookup + trivial-feature pruning)."""
         cfg = self.config
+        if j in self._ignore_set:
+            # ignore_column / weight_column / group_column features stay in
+            # the column count (reference keeps original feature numbering)
+            # but never train: a trivial mapper drops them from used_features
+            self.bin_mappers.append(
+                BinMapper(bin_upper_bound=np.array([np.inf]), num_bins=1)
+            )
+            return
         owned = self._owned_feature_range(self.num_total_features)
         if owned is not None and not (owned[0] <= j < owned[1]):
             # another rank bins this feature; a placeholder keeps indices
@@ -832,34 +1013,90 @@ class Dataset:
         if not mapper.is_trivial:
             self.used_features.append(j)
 
-    def _check_binned_footprint(self, n: int, n_used: int, itemsize: int):
+    def _check_binned_footprint(self, n: int, n_cols: int, itemsize: int):
         """Enforce the dense-layout memory ceiling with an actionable error.
 
-        The TPU build stores bins as ONE dense [N, F] matrix (module
-        docstring) and has no EFB feature bundling (reference
-        dataset.cpp:111 FindGroups) — a genuinely sparse-wide dataset
-        (e.g. 50k one-hot columns) would materialize hundreds of GB here
-        and OOM deep inside allocation.  Fail early and say what to do:
-        exclusive one-hot blocks carry the same information as ONE
-        integer-coded categorical column, which this build supports
-        natively (categorical_feature= + sorted-subset splits)."""
+        The TPU build stores bins as ONE dense [N, P] matrix (module
+        docstring); the check runs AFTER the EFB bundling decision, so the
+        column count already reflects the bundled plane count.  A dataset
+        still over the ceiling (bundling off, or columns that are not
+        mutually exclusive) would materialize hundreds of GB and OOM deep
+        inside allocation — fail early and say what to do: exclusive
+        one-hot blocks bundle away with enable_bundle=true (or carry the
+        same information as ONE integer-coded categorical column,
+        categorical_feature= + sorted-subset splits)."""
         import os
 
-        est = n * max(1, n_used) * itemsize
+        est = n * max(1, n_cols) * itemsize
         ceiling = int(
             os.environ.get("LGBM_TPU_MAX_BINNED_BYTES", 16 << 30)
         )
         if est > ceiling:
+            bundled = (
+                f" after bundling into {n_cols} planes"
+                if self.bundle_layout is not None
+                else ""
+            )
             raise ValueError(
                 f"binned dataset would need {est / (1 << 30):.1f} GiB "
-                f"({n} rows x {n_used} used features, dense layout) — over "
-                f"the {ceiling / (1 << 30):.1f} GiB ceiling. This build has "
-                "no EFB feature bundling: encode exclusive one-hot column "
-                "blocks as a single integer-coded categorical feature "
-                "(categorical_feature=...), drop empty/constant columns, "
-                "or raise LGBM_TPU_MAX_BINNED_BYTES if the footprint is "
-                "intended."
+                f"({n} rows x {n_cols} columns{bundled}, dense layout) — "
+                f"over the {ceiling / (1 << 30):.1f} GiB ceiling. Enable "
+                "EFB feature bundling (enable_bundle=true, on by default) "
+                "for mutually-exclusive sparse columns, encode exclusive "
+                "one-hot column blocks as a single integer-coded "
+                "categorical feature (categorical_feature=...), drop "
+                "empty/constant columns, or raise LGBM_TPU_MAX_BINNED_BYTES "
+                "if the footprint is intended."
             )
+
+    def _bundling_allowed(self) -> bool:
+        """EFB is skipped under multi-process pre_partition feeding: the
+        conflict scan sees only local rows, so per-process layouts would
+        disagree (the mapper allgather has no layout channel yet)."""
+        if not self.config.pre_partition:
+            return True
+        try:
+            import jax
+
+            return jax.process_count() <= 1
+        except Exception:  # pragma: no cover
+            return True
+
+    def _find_bundle_layout(self, data, sparse_csc, n: int):
+        """Greedy conflict-count bundling over a row sample (reference
+        DatasetLoader FindGroups; bundling.py has the algorithm)."""
+        from .bundling import build_layout
+
+        cfg = self.config
+        if sparse_csc is not None:
+            indptr = sparse_csc.indptr
+            indices = sparse_csc.indices
+            vals = sparse_csc.data
+
+            def nonzeros_of(j):
+                sl = slice(indptr[j], indptr[j + 1])
+                idx = indices[sl]
+                return np.sort(idx[vals[sl] != 0])
+        else:
+
+            def nonzeros_of(j):
+                return np.flatnonzero(data[:, j])
+
+        sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+        sample_rows = None
+        if sample_cnt < n:
+            rng = np.random.default_rng(cfg.data_random_seed)
+            sample_rows = np.sort(
+                rng.choice(n, size=sample_cnt, replace=False)
+            )
+        return build_layout(
+            self.used_features,
+            self.bin_mappers,
+            nonzeros_of,
+            n,
+            sample_rows=sample_rows,
+            max_conflict_rate=cfg.max_conflict_rate,
+        )
 
     def _forced_bin_bounds(self, j: int, cat_idx: List[int]):
         """User-forced bin upper bounds for feature j, or None.
@@ -1071,6 +1308,12 @@ class Dataset:
         other.construct()
         if self.num_data != other.num_data:
             raise ValueError("datasets must have the same number of rows")
+        if self.bundle_layout is not None or other.bundle_layout is not None:
+            raise ValueError(
+                "add_features_from is not supported on EFB-bundled datasets "
+                "(plane columns are not per-feature); construct with "
+                "enable_bundle=false to merge"
+            )
         base_f = self.num_total_features
         self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
         self.used_features = list(self.used_features) + [
@@ -1190,6 +1433,7 @@ class Dataset:
                     "format": "lightgbm_tpu.dataset.v1",
                     "bins": self.bins,
                     "used_features": self.used_features,
+                    "bundle_layout": self.bundle_layout,
                     "bin_mappers": self.bin_mappers,
                     "feature_names": self.feature_names,
                     "num_total_features": self.num_total_features,
@@ -1237,6 +1481,8 @@ class Dataset:
         ds.parser_config_str = blob.get("parser_config_str", "")
         ds.bin_mappers = blob["bin_mappers"]
         ds.used_features = blob["used_features"]
+        ds.bundle_layout = blob.get("bundle_layout")
+        ds._ignore_set = set()
         ds.bins = blob["bins"]
         ds.raw = blob.get("raw")
         ds.feature_names = blob["feature_names"]
@@ -1275,6 +1521,8 @@ class Dataset:
         ds.parser_config_str = getattr(self, "parser_config_str", "")
         ds.bin_mappers = self.bin_mappers
         ds.used_features = self.used_features
+        ds.bundle_layout = self.bundle_layout
+        ds._ignore_set = set()
         ds.bins = self.bins[idx]
         ds.raw = None if self.raw is None else self.raw[idx]
         ds.feature_names = self.feature_names

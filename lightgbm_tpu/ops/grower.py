@@ -70,6 +70,10 @@ class GrowerParams:
     # False keeps every cat-related array at width 1 (static no-op)
     use_cat: bool = False
     cat_params: Optional[CatParams] = None
+    # EFB bundle planes (bundling.py): the bundle_end operand routes bundled
+    # split candidates through ops/split.py and their partitions through the
+    # categorical-mask machinery (masks widen to B like use_cat)
+    use_bundle: bool = False
     # forced splits (forcedsplits_filename JSON BFS,
     # serial_tree_learner.cpp:627): the first n_forced loop steps apply the
     # host-precomputed (leaf, feature, bin) splits instead of the best-gain
@@ -311,7 +315,7 @@ def adv_planes(box, boxes, outs, mono, valid, b: int):
 def _candidate_for_leaf(
     hist, g, h, c, num_bins, nan_bins, feature_mask, p: GrowerParams,
     monotone=None, lb=None, ub=None, parent_output=0.0, is_cat=None,
-    cegb_penalty=None, rand_bins=None, adv=None,
+    cegb_penalty=None, rand_bins=None, adv=None, bundle_end=None,
 ):
     """Best split for one leaf.  ``hist`` is the GLOBAL (psummed) histogram
     normally; under voting-parallel it is the LOCAL histogram and only the
@@ -330,6 +334,7 @@ def _candidate_for_leaf(
         and p.path_smooth == 0.0
         and p.max_delta_step == 0.0
         and lb is None and ub is None and adv is None
+        and bundle_end is None
         and not voting_active(p, f)
         # the kernel unrolls one [16, B] x [B, B] matmul per feature into a
         # single Mosaic program — cap the program size / VMEM footprint and
@@ -373,6 +378,7 @@ def _candidate_for_leaf(
             cegb_penalty=cegb_penalty if p.use_cegb else None,
             rand_bins=rand_bins if p.extra_trees else None,
             adv_bounds=adv,
+            bundle_end=bundle_end,
             **common,
         )
     # ---- PV-Tree election.  1) local per-feature best gains from the LOCAL
@@ -539,11 +545,36 @@ def grow_tree(
     cegb_penalty: Optional[jnp.ndarray] = None,  # [F] f32 (use_cegb)
     cegb_used: Optional[jnp.ndarray] = None,  # [F] bool — already-bought features
     quant_scales=None,  # (g_scale, h_scale) for hist_method='pallas_int8'
+    bundle_end: Optional[jnp.ndarray] = None,  # [F, B] i32 — EFB sub-range
+    #   ends per plane bin (bundling.py / ops/split.py), -1 off-bundle
 ):
     """Grow one tree. Returns (TreeArrays, leaf_id[N])."""
     p = params
     n, f = bins.shape
     L, B = p.num_leaves, p.max_bin
+    use_bundle = p.use_bundle and bundle_end is not None
+    if not use_bundle:
+        bundle_end = None
+    else:
+        # bundled split candidates reuse the categorical-mask partition and
+        # the plain numeric gain path; modes that reinterpret the feature
+        # axis or the candidate set per-feature are host-gated off
+        # (boosting/gbdt.py raises first with friendlier messages)
+        incompatible = [
+            (p.n_forced > 0, "forced splits"),
+            (p.extra_trees, "extra_trees"),
+            (p.use_interaction, "interaction constraints"),
+            (p.use_monotone and monotone is not None, "monotone constraints"),
+            (p.use_cegb, "CEGB feature penalties"),
+            (p.feature_shard > 1, "feature-parallel training"),
+            (voting_active(p, bins.shape[1]), "voting-parallel training"),
+        ]
+        for bad, what in incompatible:
+            if bad:
+                raise ValueError(
+                    f"EFB feature bundling does not support {what}; "
+                    "construct the Dataset with enable_bundle=false"
+                )
     use_mono = p.use_monotone and monotone is not None
     use_inter_mono = use_mono and p.monotone_method in ("intermediate", "advanced")
     # advanced = intermediate propagation machinery + recomputed-from-boxes
@@ -565,7 +596,9 @@ def grow_tree(
             out = out * ratio / (ratio + 1.0) + pouts / (ratio + 1.0)
         return jnp.clip(out, lb_, ub_)
     use_cat = p.use_cat and is_cat is not None
-    Bm = B if use_cat else 1  # cat-mask width (1 = static no-op)
+    # cat-mask width (1 = static no-op); bundle splits ride the same mask
+    # machinery, so bundling widens it too
+    Bm = B if (use_cat or use_bundle) else 1
     is_cat_arr = is_cat if use_cat else None
     use_cegb = p.use_cegb and cegb_penalty is not None
 
@@ -693,7 +726,7 @@ def grow_tree(
                 hist, g, h, c, num_bins, nan_bins, fm, p,
                 monotone=mono_arr, lb=lb, ub=ub, parent_output=pout,
                 is_cat=is_cat_arr, cegb_penalty=cpen, rand_bins=rand,
-                adv=adv,
+                adv=adv, bundle_end=bundle_end,
             )
         cand = _candidate_for_leaf(
             hist, g, h, c, _fslice(num_bins), _fslice(nan_bins),
@@ -733,7 +766,7 @@ def grow_tree(
         if jax.default_backend() == "tpu":
             from .pallas.seg import seg_vmem_ok
 
-            if not seg_vmem_ok(f_seg, B, use_cat):
+            if not seg_vmem_ok(f_seg, B, use_cat or use_bundle):
                 raise ValueError(
                     f"hist_mode='seg' at {f_seg} features x max_bin {B} "
                     "exceeds the histogram kernel's VMEM scratch budget — "
@@ -829,7 +862,7 @@ def grow_tree(
                 colv = featrow[idx]
                 nb = nan_bins[feat]
                 gl = (colv <= tbin) | (dl & (nb >= 0) & (colv == nb))
-                if use_cat:
+                if use_cat or use_bundle:
                     gl = jnp.where(cis, cmask[jnp.minimum(colv, Bm - 1)], gl)
                 gl = gl & valid
                 gr = valid & ~gl
@@ -1217,7 +1250,7 @@ def grow_tree(
             col = lax.dynamic_slice_in_dim(bins_t_cols, feat, 1, axis=0)[0]
             nb = nan_bins[feat]
             go_left = (col <= tbin) | (dl & (nb >= 0) & (col == nb))
-            if use_cat:
+            if use_cat or use_bundle:
                 go_left = jnp.where(
                     cis, cmask[jnp.minimum(col, Bm - 1)], go_left
                 )
@@ -1259,7 +1292,7 @@ def grow_tree(
             col = lax.dynamic_slice_in_dim(bins_t_cols, feat, 1, axis=0)[0]
             nb = nan_bins[feat]
             go_left = (col <= tbin) | (dl & (nb >= 0) & (col == nb))
-            if use_cat:
+            if use_cat or use_bundle:
                 go_left = jnp.where(
                     cis, cmask[jnp.minimum(col, Bm - 1)], go_left
                 )

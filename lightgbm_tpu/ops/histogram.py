@@ -114,14 +114,20 @@ def leaf_histogram(
         # the tpu= branch covers it (verified empirically).
         from .pallas.histogram import histogram_pallas
 
-        hist = jax.lax.platform_dependent(
-            bins,
-            grad,
-            hess,
-            mask,
-            tpu=functools.partial(histogram_pallas, num_bins=num_bins),
-            default=functools.partial(leaf_histogram_segment, num_bins=num_bins),
-        )
+        if jax.default_backend() != "tpu":
+            # no TPU registered at all: skip platform_dependent — older jax
+            # lowers EVERY branch per platform and the Pallas one refuses to
+            # lower for CPU ("Only interpret mode is supported")
+            hist = leaf_histogram_segment(bins, grad, hess, mask, num_bins)
+        else:
+            hist = jax.lax.platform_dependent(
+                bins,
+                grad,
+                hess,
+                mask,
+                tpu=functools.partial(histogram_pallas, num_bins=num_bins),
+                default=functools.partial(leaf_histogram_segment, num_bins=num_bins),
+            )
         if axis_name is not None:
             hist = jax.lax.psum(hist, axis_name)
         return hist

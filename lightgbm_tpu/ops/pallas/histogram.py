@@ -40,6 +40,14 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
+# jax renamed TPUCompilerParams -> CompilerParams; support both
+_CompilerParams = (
+    getattr(pltpu, "CompilerParams", None)
+    or getattr(pltpu, "TPUCompilerParams", None)
+    if pltpu is not None
+    else None
+)
+
 _TILE_ROWS = 1024
 _TARGET_LANES = 2048  # FG*B_pad per matmul
 
@@ -128,7 +136,7 @@ def tile_pallas_histogram(
         scratch_shapes=[pltpu.VMEM((tr, group * bpad), scratch_dtype)],
         interpret=interpret,
         compiler_params=(
-            pltpu.CompilerParams(dimension_semantics=("arbitrary",))
+            _CompilerParams(dimension_semantics=("arbitrary",))
             if not interpret
             else None
         ),

@@ -439,6 +439,11 @@ def seg_hist(seg, scal, *, f: int, num_bins: int, n_pad: int,
         if quantized
         else jnp.ones((2,), jnp.float32)
     )
+    if jax.default_backend() != "tpu":
+        # no TPU registered: older jax lowers every platform_dependent
+        # branch and the Pallas one cannot lower for CPU
+        return seg_hist_ref(seg, scal, f=f, num_bins=num_bins, n_pad=n_pad,
+                            wide=wide)
     return jax.lax.platform_dependent(
         seg,
         scal,

@@ -196,6 +196,10 @@ def sort_partition(
     args = (seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask)
     if use_gl:
         args = args + (gl_vec,)
+    if jax.default_backend() != "tpu":
+        # no TPU registered: older jax lowers every platform_dependent
+        # branch and the Pallas one cannot lower for CPU
+        return _xla(*args)
     return jax.lax.platform_dependent(*args, tpu=_pallas, default=_xla)
 
 

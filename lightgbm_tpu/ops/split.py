@@ -136,6 +136,15 @@ def best_split(
     #                   AdvancedLeafConstraints / CumulativeFeatureConstraint,
     #                   monotone_constraints.hpp:858/:146) — applied to the
     #                   numeric candidates instead of the scalar leaf bounds
+    bundle_end: Optional[jnp.ndarray] = None,  # [F, B] i32 — EFB planes
+    #                   (bundling.py): for a bundle-plane bin inside a member
+    #                   feature's sub-range, the sub-range's LAST bin; -1
+    #                   elsewhere.  A candidate at bundle bin t means
+    #                   "member-local bin <= t - start goes left", i.e. left
+    #                   child = everything except plane bins [t, end] — the
+    #                   reference's per-feature scan over a feature group's
+    #                   histogram with the out-of-range mass folded into the
+    #                   feature's default bin.
 ) -> SplitCandidate:
     """cegb_*: Cost-Effective Gradient Boosting (reference:
     cost_effective_gradient_boosting.hpp DeltaGain — gain is reduced by
@@ -163,6 +172,24 @@ def best_split(
     # candidate threshold at bin t is valid for t in [0, num_ordered_bins-2]
     num_ordered = num_bins - has_nan.astype(jnp.int32)
     valid_bin = bin_ids < (num_ordered[:, None] - 1)
+    if bundle_end is not None:
+        # EFB bundle planes: left child at bundle bin t = parent minus the
+        # owning member's plane bins [t, end] (everything else — the shared
+        # default bin 0 and every OTHER member's mass — is "member at its
+        # default", which goes left).  left = parent - (cum[end] - cum[t-1]).
+        # Non-bundle bins keep the plain cumsum; every sub-range bin is a
+        # valid candidate (t = start encodes "default alone goes left").
+        bundled_bin = bundle_end >= 0  # [F, B]
+        plane_bundled = bundled_bin.any(axis=1)  # [F]
+        cum_end = jnp.take_along_axis(
+            cum, jnp.clip(bundle_end, 0, b - 1)[:, :, None], axis=1
+        )  # [F, B, 3]
+        cum = jnp.where(
+            bundled_bin[:, :, None],
+            parent[None, None, :] - cum_end + cum - hist_o,
+            cum,
+        )
+        valid_bin = jnp.where(plane_bundled[:, None], bundled_bin, valid_bin)
     if rand_bins is not None:
         # extra_trees (extremely randomized trees): only ONE random
         # threshold per feature competes (reference USE_RAND branch of
@@ -346,7 +373,20 @@ def best_split(
         )
     else:
         is_cat_win = jnp.asarray(False)
-        cat_mask = jnp.zeros((1,), bool)
+        cat_mask = jnp.zeros((b if bundle_end is not None else 1,), bool)
+    if bundle_end is not None:
+        # a bundle-plane winner partitions by plane-bin MEMBERSHIP (left =
+        # everything except the member's bins [t, end]) — expressed through
+        # the existing categorical-mask machinery so every partition /
+        # replay / device-predict path applies it unchanged; the host Tree
+        # decode (tree.py) turns it back into a numeric threshold on the
+        # original feature
+        bwin_end = bundle_end[feat, tbin]
+        bundled_win = bwin_end >= 0
+        bids = jnp.arange(b, dtype=jnp.int32)
+        bundle_mask = ~((bids >= tbin) & (bids <= bwin_end))
+        is_cat_win = jnp.asarray(is_cat_win) | bundled_win
+        cat_mask = jnp.where(bundled_win, bundle_mask, cat_mask)
     if not use_full_gain:
         parent_gain = leaf_gain(parent[0], parent[1], lambda_l1, lambda_l2)
     else:

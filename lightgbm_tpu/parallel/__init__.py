@@ -43,6 +43,23 @@ from ..ops.grower import GrowerParams, TreeArrays, grow_tree
 DATA_AXIS = "data"
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs):
+    """jax.shard_map across jax versions: the top-level alias (check_vma)
+    landed after 0.4.x, where the API lives in jax.experimental.shard_map
+    with the equivalent knob spelled check_rep."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as sm_old
+
+    return sm_old(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
+
 def choose_devices(min_devices: int = 2):
     """Devices for distributed training: the default backend's devices, or —
     when it has a single chip (e.g. tests on a 1-chip host with a virtual CPU
@@ -103,12 +120,13 @@ def make_sharded_grow(
 
     def local(bins, grad, hess, mask, num_bins, nan_bins, feature_mask,
               monotone, interaction_sets, rng, is_cat, forced, cegb_penalty,
-              cegb_used, quant_scales):
+              cegb_used, quant_scales, bundle_end):
         return grow_tree(
             bins, grad, hess, mask, num_bins, nan_bins, feature_mask, p,
             monotone=monotone, interaction_sets=interaction_sets, rng=rng,
             is_cat=is_cat, forced=forced, cegb_penalty=cegb_penalty,
             cegb_used=cegb_used, quant_scales=quant_scales,
+            bundle_end=bundle_end,
         )
 
     rep = P()
@@ -119,15 +137,15 @@ def make_sharded_grow(
         sh = P(axis_name)
         sh2 = P(axis_name, None)
         leaf_out = sh
-    fn = jax.shard_map(
+    fn = _shard_map(
         local,
         mesh=mesh,
-        in_specs=(sh2, sh, sh, sh, rep, rep, rep, rep, rep, rep, rep, rep, rep, rep, rep),
+        in_specs=(sh2, sh, sh, sh, rep, rep, rep, rep, rep, rep, rep, rep,
+                  rep, rep, rep, rep),
         out_specs=(
             jax.tree.map(lambda _: rep, TreeArrays(*([0] * len(TreeArrays._fields)))),
             leaf_out,
         ),
-        check_vma=False,
     )
     return jax.jit(fn)
 
@@ -247,12 +265,11 @@ def make_data_parallel_train_step(
     sharded = P(axis_name)
     sharded2 = P(axis_name, None)
     rep = P()
-    fn = jax.shard_map(
+    fn = _shard_map(
         step,
         mesh=mesh,
         in_specs=(sharded2, sharded, sharded, rep, rep, rep),
         out_specs=(sharded, rep),
-        check_vma=False,
     )
     return jax.jit(fn)
 

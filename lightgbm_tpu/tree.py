@@ -102,8 +102,15 @@ class Tree:
         ta,  # ops.grower.TreeArrays pulled to host (numpy-compatible)
         bin_mappers,  # List[BinMapper] for ALL original features
         used_features: Sequence[int],  # used-col -> original feature index
+        bundle_layout=None,  # bundling.BundleLayout: columns are EFB planes
     ) -> "Tree":
-        """Materialize bin-space device TreeArrays into a real-valued Tree."""
+        """Materialize bin-space device TreeArrays into a real-valued Tree.
+
+        With ``bundle_layout`` the device column axis is EFB planes: a
+        bundle-plane split (recorded as a plane-bin membership mask on
+        device) decodes back to a NUMERIC threshold on the owning original
+        feature, so serialized models and prediction are expressed in
+        original-feature space exactly like unbundled training."""
         n = int(ta.num_leaves)
         nn = max(n - 1, 0)
         split_feature_used = np.asarray(ta.split_feature)[:nn]
@@ -119,7 +126,27 @@ class Tree:
         cat_threshold: List[int] = []
         num_cat = 0
         for t in range(nn):
-            orig = used_features[int(split_feature_used[t])]
+            plane = int(split_feature_used[t])
+            if bundle_layout is not None:
+                feats_p = bundle_layout.planes[plane]
+                if len(feats_p) > 1:
+                    # EFB bundle plane: candidate bin tb means "member-local
+                    # bin <= tb - start goes left" (ops/split.py bundle_end)
+                    orig, tl = bundle_layout.decode(plane, int(split_bin[t]))
+                    split_feature[t] = orig
+                    mapper = bin_mappers[orig]
+                    threshold[t] = mapper.bin_to_threshold(tl)
+                    # eligibility guarantees missing_type NONE and the
+                    # value-0 bin below every threshold: NaN (treated as 0
+                    # at predict) and zeros go left, matching the training
+                    # partition's shared default bin
+                    decision_type[t] = _make_decision_type(
+                        False, False, mapper.missing_type
+                    )
+                    continue
+                orig = feats_p[0]
+            else:
+                orig = used_features[plane]
             split_feature[t] = orig
             mapper = bin_mappers[orig]
             if mapper.is_categorical:

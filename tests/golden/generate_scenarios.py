@@ -163,6 +163,29 @@ SCENARIOS.update({
 })
 
 
+def _onehot_data(seed=51, n=4000, nvar=6, ncat=12):
+    """Block one-hot design for the EFB bundling scenario: nvar categorical
+    variables one-hot encoded into nvar*ncat mutually-exclusive-within-block
+    columns.  Both engines bundle it (reference FindGroups, our
+    bundling.py) and must land on the same trees in original-feature
+    space."""
+    rng = np.random.default_rng(seed)
+    codes = rng.integers(0, ncat, size=(n, nvar))
+    X = np.zeros((n, nvar * ncat))
+    X[np.arange(n)[:, None], np.arange(nvar) * ncat + codes] = 1.0
+    w = rng.normal(size=nvar * ncat)
+    y = X @ w + 0.2 * rng.normal(size=n)
+    return np.column_stack([y, X])
+
+
+SCENARIOS.update({
+    # EFB: explicit enable_bundle so the params.json documents the feature
+    # under test (it is the default in both engines)
+    "bundle": ({"enable_bundle": True, "min_data_in_leaf": 5,
+                "metric": "l2"}, _onehot_data),
+})
+
+
 def _conf_value(v):
     if isinstance(v, bool):
         return "true" if v else "false"

@@ -1,0 +1,319 @@
+"""Exclusive Feature Bundling (EFB): greedy bundling, plane packing, the
+50k-column one-hot path, and original-feature-space model output.
+
+Reference analogs: DatasetLoader FindGroups / FastFeatureBundling
+(src/io/dataset.cpp) and the EFB algorithm of Ke et al. (NeurIPS 2017).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+sp = pytest.importorskip("scipy.sparse")
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.bundling import (  # noqa: E402
+    BundleLayout,
+    build_layout,
+    greedy_find_bundles,
+)
+
+
+def _onehot_problem(n=3000, nvar=10, ncat=25, seed=0, noise=0.1):
+    """Block one-hot design: nvar categorical variables, one-hot encoded
+    into nvar*ncat mutually-exclusive-within-block columns."""
+    rng = np.random.default_rng(seed)
+    codes = rng.integers(0, ncat, size=(n, nvar))
+    rows = np.repeat(np.arange(n), nvar)
+    cols = (np.arange(nvar) * ncat + codes).ravel()
+    X = sp.csr_matrix(
+        (np.ones(n * nvar), (rows, cols)), shape=(n, nvar * ncat)
+    )
+    w = rng.normal(size=nvar * ncat)
+    y = np.asarray(X @ w).ravel() + noise * rng.normal(size=n)
+    return X, y
+
+
+# --------------------------------------------------------------- algorithm
+def test_greedy_bundles_exclusive_features_share_a_group():
+    # three features, pairwise disjoint nonzeros -> one bundle
+    nz = [np.array([0, 1]), np.array([2, 3]), np.array([4, 5])]
+    groups = greedy_find_bundles(nz, np.array([1, 1, 1]), 10, 0.0)
+    assert groups == [[0, 1, 2]]
+
+
+def test_greedy_bundles_conflicting_features_split():
+    nz = [np.array([0, 1, 2]), np.array([2, 3, 4])]  # overlap at row 2
+    groups = greedy_find_bundles(nz, np.array([1, 1]), 10, 0.0)
+    assert sorted(map(sorted, groups)) == [[0], [1]]
+    # a conflict budget of one row lets them merge
+    groups2 = greedy_find_bundles(nz, np.array([1, 1]), 10, 0.1)
+    assert groups2 == [[0, 1]]
+
+
+def test_greedy_bundles_respect_bin_budget():
+    # both features exclusive but each needs 200 bins: 1 + 200 + 200 > 256
+    nz = [np.array([0]), np.array([1])]
+    groups = greedy_find_bundles(nz, np.array([200, 200]), 10, 0.0)
+    assert len(groups) == 2
+
+
+def test_layout_decode_round_trip():
+    layout = BundleLayout(
+        planes=[[3, 7, 9], [5]],
+        starts=[[1, 2, 4], [0]],
+        widths=[[1, 2, 3], [10]],
+        plane_bins=[7, 10],
+    )
+    assert layout.has_bundles
+    assert layout.decode(0, 1) == (3, 0)
+    assert layout.decode(0, 2) == (7, 0)
+    assert layout.decode(0, 3) == (7, 1)
+    assert layout.decode(0, 6) == (9, 2)
+    assert layout.decode(1, 4) == (5, 4)  # singleton plane = identity
+    assert layout.feature_position(9) == (0, 2)
+    be = layout.bundle_end_array(8)
+    np.testing.assert_array_equal(be[0], [-1, 1, 3, 3, 6, 6, 6, -1])
+    np.testing.assert_array_equal(be[1], [-1] * 8)
+
+
+def test_build_layout_identity_for_dense():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 6))
+    y = rng.normal(size=500)
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    assert ds.bundle_layout is None  # dense columns never bundle
+    assert ds.num_planes == len(ds.used_features)
+
+
+# ----------------------------------------------------------- dataset layer
+def test_bundled_plane_columns_decode_back_to_feature_bins():
+    X, y = _onehot_problem(n=2000, nvar=8, ncat=20)
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    L = ds.bundle_layout
+    assert L is not None and L.has_bundles
+    Xc = X.tocsc()
+    n = X.shape[0]
+    for j in ds.used_features:
+        p, k = L.feature_position(j)
+        m = ds.bin_mappers[j]
+        col = np.zeros(n)
+        sl = slice(Xc.indptr[j], Xc.indptr[j + 1])
+        col[Xc.indices[sl]] = Xc.data[sl]
+        want = m.values_to_bins(col)
+        if L.is_bundle(p):
+            s, w = L.starts[p][k], L.widths[p][k]
+            pb = ds.bins[:, p].astype(int)
+            got = np.where((pb >= s) & (pb < s + w), pb - s + 1, 0)
+        else:
+            got = ds.bins[:, p].astype(int)
+        np.testing.assert_array_equal(want, got)
+
+
+def test_one_plane_per_onehot_block():
+    """Block one-hot discovers exactly one bundle per variable (the
+    original-order first-fit: a filled block's bundle occupies every row,
+    so the next block's first column immediately conflicts)."""
+    X, y = _onehot_problem(n=2500, nvar=12, ncat=20)
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    assert ds.num_planes == 12
+
+
+def test_bundled_binary_dataset_round_trip(tmp_path):
+    X, y = _onehot_problem(n=1500, nvar=6, ncat=15)
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    fn = str(tmp_path / "d.bin")
+    ds.save_binary(fn)
+    ds2 = lgb.Dataset(fn)
+    ds2.construct()
+    assert ds2.bundle_layout is not None and ds2.bundle_layout.has_bundles
+    np.testing.assert_array_equal(ds2.bins, ds.bins)
+
+
+# ---------------------------------------------------------------- training
+def test_bundled_training_matches_unbundled():
+    """Bundled and unbundled training are the same algorithm over the same
+    per-feature histograms (summation order aside): predictions agree to
+    float tolerance and both models split on original feature ids."""
+    X, y = _onehot_problem(n=4000, nvar=12, ncat=20, seed=3)
+    params = {
+        "objective": "regression", "num_leaves": 31, "min_data_in_leaf": 5,
+        "verbosity": -1, "seed": 1,
+    }
+    b = lgb.train(params, lgb.Dataset(X, y, params=params), 20)
+    p_off = {**params, "enable_bundle": False}
+    b0 = lgb.train(p_off, lgb.Dataset(X, y, params=p_off), 20)
+    pred, pred0 = b.predict(X), b0.predict(X)
+    # near-tie split flips under different accumulation orders move a few
+    # rows; overall fit must agree closely
+    corr = np.corrcoef(pred, pred0)[0, 1]
+    assert corr > 0.999, corr
+    mse = np.mean((pred - y) ** 2)
+    mse0 = np.mean((pred0 - y) ** 2)
+    assert mse <= mse0 * 1.05, (mse, mse0)
+
+
+def test_bundled_model_serializes_in_original_feature_space():
+    """Round-trip through the Tree::ToString text format: bundled models
+    carry original feature ids and real thresholds (never plane ids), and
+    the reloaded model reproduces the trainer's predictions."""
+    X, y = _onehot_problem(n=3000, nvar=10, ncat=20, seed=5)
+    params = {
+        "objective": "regression", "num_leaves": 15, "verbosity": -1,
+        "min_data_in_leaf": 5,
+    }
+    ds = lgb.Dataset(X, y, params=params)
+    b = lgb.train(params, ds, 10)
+    ds.construct()
+    assert ds.bundle_layout.has_bundles
+    txt = b.model_to_string()
+    assert "cat_threshold=" not in txt  # bundle splits decode as NUMERIC
+    feats, thrs = [], []
+    for line in txt.splitlines():
+        if line.startswith("split_feature="):
+            feats.extend(int(t) for t in line.split("=")[1].split())
+        if line.startswith("threshold="):
+            thrs.extend(float(t) for t in line.split("=")[1].split())
+    assert feats, "no splits recorded"
+    assert max(feats) < X.shape[1]
+    # one-hot thresholds sit at the zero/one bin boundary
+    assert all(0.0 < t < 1.0 for t in thrs), sorted(set(thrs))[:5]
+    b2 = lgb.Booster(model_str=txt)
+    np.testing.assert_allclose(
+        b2.predict(X.toarray()), b.predict(X), rtol=1e-5, atol=1e-6
+    )
+    # feature importance is per ORIGINAL feature
+    imp = b.feature_importance()
+    assert len(imp) == X.shape[1]
+    assert imp.sum() == len(feats)
+
+
+def test_bundled_valid_set_eval_matches_predict():
+    X, y = _onehot_problem(n=3000, nvar=8, ncat=15, seed=7)
+    params = {
+        "objective": "regression", "num_leaves": 15, "verbosity": -1,
+        "metric": "l2",
+    }
+    ds = lgb.Dataset(X[:2000], y[:2000], params=params)
+    dv = ds.create_valid(X[2000:], y[2000:])
+    ev = {}
+    b = lgb.train(
+        params, ds, 10, valid_sets=[dv], valid_names=["valid"],
+        callbacks=[lgb.record_evaluation(ev)],
+    )
+    manual = float(np.mean((b.predict(X[2000:]) - y[2000:]) ** 2))
+    assert abs(manual - ev["valid"]["l2"][-1]) < 1e-5
+
+
+def test_bundled_seg_mode_matches_ordered():
+    X, y = _onehot_problem(n=2500, nvar=8, ncat=15, seed=11)
+    base = {
+        "objective": "regression", "num_leaves": 15, "verbosity": -1,
+        "min_data_in_leaf": 5,
+    }
+    b_ord = lgb.train(
+        {**base, "hist_mode": "ordered"},
+        lgb.Dataset(X, y, params={**base, "hist_mode": "ordered"}), 8,
+    )
+    b_seg = lgb.train(
+        {**base, "hist_mode": "seg"},
+        lgb.Dataset(X, y, params={**base, "hist_mode": "seg"}), 8,
+    )
+    np.testing.assert_allclose(
+        b_seg.predict(X), b_ord.predict(X), rtol=1e-5, atol=1e-6
+    )
+
+
+@pytest.mark.slow
+def test_50k_onehot_trains_on_seg_fast_path(monkeypatch):
+    """The acceptance scenario: 50k one-hot columns that raise the plane
+    ceiling unbundled now bundle to ~nvar planes (>= 10x fewer than the
+    column count), pack under the seg path's 242-plane budget, and train
+    end-to-end with hist_mode='seg'."""
+    monkeypatch.setenv("LGBM_TPU_MAX_BINNED_BYTES", str(64 << 20))
+    X, y = _onehot_problem(n=3000, nvar=200, ncat=250, seed=0, noise=0.0)
+    assert X.shape[1] == 50_000
+    with pytest.raises(ValueError, match="enable_bundle|categorical"):
+        lgb.Dataset(X, y, params={"enable_bundle": False}).construct()
+    params = {
+        "objective": "regression", "num_leaves": 15, "verbosity": -1,
+        "hist_mode": "seg",
+    }
+    ds = lgb.Dataset(X, y, params=params)
+    ds.construct()
+    assert ds.num_planes * 10 <= ds.num_total_features
+    assert ds.num_planes <= 242  # fits the seg packed-row lane budget
+    b = lgb.train(params, ds, 3)
+    assert b.num_trees() >= 1
+    pred = b.predict(X[:200])
+    assert np.isfinite(pred).all()
+
+
+def test_wide_onehot_plane_reduction_and_ceiling(monkeypatch):
+    """Default-tier twin of the 50k scenario (smaller for runtime): the
+    unbundled construct raises the plane ceiling, the bundled one shrinks
+    plane count >= 10x and trains on the seg path."""
+    monkeypatch.setenv("LGBM_TPU_MAX_BINNED_BYTES", str(8 << 20))
+    X, y = _onehot_problem(n=2500, nvar=40, ncat=100, seed=2, noise=0.0)
+    assert X.shape[1] == 4000
+    with pytest.raises(ValueError, match="enable_bundle|categorical"):
+        lgb.Dataset(X, y, params={"enable_bundle": False}).construct()
+    params = {
+        "objective": "regression", "num_leaves": 15, "verbosity": -1,
+        "hist_mode": "seg",
+    }
+    ds = lgb.Dataset(X, y, params=params)
+    ds.construct()
+    assert ds.num_planes * 10 <= ds.num_total_features
+    b = lgb.train(params, ds, 3)
+    pred = b.predict(X[:200])
+    assert np.isfinite(pred).all()
+
+
+def test_conflict_rate_budget_trains():
+    rng = np.random.default_rng(1)
+    n, f = 3000, 60
+    X = sp.random(n, f, density=0.03, format="csr", random_state=rng)
+    w = rng.normal(size=f)
+    y = np.asarray(X @ w).ravel() + 0.05 * rng.normal(size=n)
+    params = {
+        "objective": "regression", "num_leaves": 15, "verbosity": -1,
+        "max_conflict_rate": 0.1,
+    }
+    ds = lgb.Dataset(X, y, params=params)
+    ds.construct()
+    assert ds.bundle_layout is not None and ds.bundle_layout.has_bundles
+    b = lgb.train(params, ds, 15)
+    pred = b.predict(X)
+    assert np.mean((pred - y) ** 2) < 0.8 * np.var(y)
+
+
+def test_bundle_incompatible_modes_raise():
+    X, y = _onehot_problem(n=1500, nvar=6, ncat=15)
+    nf = X.shape[1]
+    for bad in (
+        {"monotone_constraints": [1] + [0] * (nf - 1)},
+        {"interaction_constraints": "[0,1],[2,3]"},
+        {"extra_trees": True},
+        {"cegb_penalty_split": 1e-4},
+    ):
+        params = {"objective": "regression", "verbosity": -1, **bad}
+        with pytest.raises(ValueError, match="enable_bundle"):
+            lgb.train(params, lgb.Dataset(X, y, params=params), 2)
+        # the documented escape hatch works
+        params_off = {**params, "enable_bundle": False}
+        b = lgb.train(params_off, lgb.Dataset(X, y, params=params_off), 2)
+        assert b.num_trees() >= 0
+
+
+def test_bundled_subset_shares_layout():
+    X, y = _onehot_problem(n=2000, nvar=6, ncat=15)
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    sub = ds.subset(np.arange(0, 2000, 2))
+    assert sub.bundle_layout is ds.bundle_layout
+    np.testing.assert_array_equal(sub.bins, ds.bins[::2])

@@ -34,12 +34,32 @@ import lightgbm_tpu as lgb  # noqa: E402
 GOLDEN = Path(__file__).parent / "golden"
 REF_EXAMPLES = Path("/root/reference/examples")
 
+# per-example LOOSE band, used only when the example's own conf engages a
+# cross-engine RNG stream (bagging / feature_fraction — reference Random
+# vs jax.random draw different subsets by design).  Deterministic confs
+# get the tight band below: same data, same binning, same greedy split
+# rule must land within 1% (VERDICT item 6).
 CASES = {
     "regression": ("regression", "l2", 0.05),
     "binary_classification": ("binary", "binary_logloss", 0.08),
     "lambdarank": ("rank", "ndcg@3", 0.05),
     "multiclass_classification": ("multiclass", "multi_logloss", 0.08),
 }
+DETERMINISTIC_RTOL = 0.01
+
+
+def _conf_is_stochastic(conf: dict) -> bool:
+    """True when the conf engages any cross-engine RNG stream."""
+    ff = float(conf.get("feature_fraction", 1.0))
+    bf = float(conf.get("bagging_fraction", 1.0))
+    bfreq = int(conf.get("bagging_freq", 0))
+    return (
+        ff < 1.0
+        or (bfreq > 0 and bf < 1.0)
+        or conf.get("boosting", "gbdt") in ("dart", "goss", "rf")
+        or float(conf.get("pos_bagging_fraction", 1.0)) < 1.0
+        or float(conf.get("neg_bagging_fraction", 1.0)) < 1.0
+    )
 
 
 def _parse_conf(path: Path) -> dict:
@@ -118,6 +138,9 @@ def test_training_parity_on_example(name):
     ref_final = evals[ref_key][-1][1]
 
     conf = _parse_conf(REF_EXAMPLES / name / "train.conf")
+    if not _conf_is_stochastic(conf):
+        # deterministic pipeline end to end -> tight band (VERDICT item 6)
+        rtol = min(rtol, DETERMINISTIC_RTOL)
     ex = _load_example(name, stem)
     params = {
         k: v
@@ -210,7 +233,7 @@ _SCENARIO_NAMES = [
     "widebin", "obj_tweedie", "obj_poisson", "obj_quantile", "obj_huber",
     "obj_gamma", "obj_fair", "obj_mape", "obj_l1", "dart", "bagging",
     "obj_xentropy", "obj_xentlambda", "weighted", "interaction",
-    "forcedsplits", "categorical", "linear",
+    "forcedsplits", "categorical", "linear", "bundle",
 ]
 
 
@@ -266,6 +289,20 @@ def test_scenario_golden_parity(name):
         # both engines must actually have used categorical (bitset) splits
         for bst in (ref, b):
             assert "cat_threshold=" in bst.model_to_string()
+    if name == "bundle":
+        # EFB must actually have engaged on our side, and both models must
+        # speak original-feature space (numeric one-hot thresholds, ids
+        # within the raw column count)
+        ds.construct()
+        assert ds.bundle_layout is not None and ds.bundle_layout.has_bundles
+        assert ds.num_planes < len(ds.used_features)
+        for bst in (ref, b):
+            txt = bst.model_to_string()
+            assert "cat_threshold=" not in txt
+            for line in txt.splitlines():
+                if line.startswith("split_feature="):
+                    ids = [int(t) for t in line.split("=")[1].split()]
+                    assert all(0 <= i < X.shape[1] for i in ids)
     if name == "forcedsplits":
         # both engines must root at the forced feature 2 with the SAME
         # bin-snapped threshold (both snap the forced 0.5 to the nearest

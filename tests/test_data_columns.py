@@ -1,0 +1,166 @@
+"""weight_column / group_column / ignore_column extraction from text data
+files (reference DatasetLoader::SetHeader, src/io/dataset_loader.cpp:111-160,
+and Metadata::SetQueryId).
+
+Semantics under test:
+  * integer specs index DATA columns — they do not count the label column;
+  * ``name:...`` specs require header=true and resolve against it;
+  * the group column holds per-row query ids whose consecutive runs become
+    query sizes;
+  * extracted columns stay in the feature numbering but are ignored for
+    training (trivial mappers — never in used_features, never split on);
+  * an explicit group_column wins over a ``.query`` sidecar file.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.config import Config  # noqa: E402
+from lightgbm_tpu.dataset import _load_text_file  # noqa: E402
+
+
+def _write_csv(path, arr, header=None):
+    with open(path, "w") as fh:
+        if header:
+            fh.write(",".join(header) + "\n")
+        for row in arr:
+            fh.write(",".join(f"{v:.8f}" for v in row) + "\n")
+
+
+def _ranking_file(tmp_path, header=False):
+    """label, f0, f1, qid, weight — 12 rows over 3 queries."""
+    rng = np.random.default_rng(0)
+    n = 12
+    qid = np.repeat([0, 1, 2], [5, 4, 3]).astype(float)
+    w = rng.uniform(0.5, 2.0, size=n)
+    X = rng.normal(size=(n, 2))
+    y = rng.integers(0, 3, size=n).astype(float)
+    arr = np.column_stack([y, X, qid, w])
+    path = tmp_path / "rank.csv"
+    _write_csv(path, arr, ["label", "f0", "f1", "qid", "wt"] if header else None)
+    return path, arr
+
+
+def test_weight_column_by_index(tmp_path):
+    path, arr = _ranking_file(tmp_path)
+    # data-column 3 (not counting the label at raw col 0) = raw column 4
+    cfg = Config.from_params({"weight_column": "3"})
+    out = _load_text_file(str(path), cfg)
+    np.testing.assert_allclose(out["weight"], arr[:, 4], rtol=1e-6)
+    # the weight column is dropped from training features
+    assert out["ignore"] == [3]
+
+
+def test_group_column_by_index_run_length(tmp_path):
+    path, _ = _ranking_file(tmp_path)
+    cfg = Config.from_params({"group_column": "2"})
+    out = _load_text_file(str(path), cfg)
+    np.testing.assert_array_equal(out["group"], [5, 4, 3])
+    assert out["ignore"] == [2]
+
+
+def test_columns_by_name_require_header(tmp_path):
+    path, arr = _ranking_file(tmp_path, header=True)
+    cfg = Config.from_params(
+        {"header": True, "weight_column": "name:wt",
+         "group_column": "name:qid", "ignore_column": "name:f1"}
+    )
+    out = _load_text_file(str(path), cfg)
+    np.testing.assert_allclose(out["weight"], arr[:, 4], rtol=1e-6)
+    np.testing.assert_array_equal(out["group"], [5, 4, 3])
+    # f1 (data col 1), qid (2), wt (3) all leave the feature set
+    assert out["ignore"] == [1, 2, 3]
+    # name: without a header is an error, not a silent ignore
+    path2, _ = _ranking_file(tmp_path.joinpath("sub") if False else tmp_path)
+    cfg2 = Config.from_params({"weight_column": "name:wt"})
+    with pytest.raises(ValueError, match="header"):
+        _load_text_file(str(path2), cfg2)
+    # unknown names are an error too
+    cfg3 = Config.from_params({"header": True, "weight_column": "name:nope"})
+    with pytest.raises(ValueError, match="nope"):
+        _load_text_file(str(path), cfg3)
+
+
+def test_ignore_column_multiple_indices(tmp_path):
+    path, _ = _ranking_file(tmp_path)
+    cfg = Config.from_params({"ignore_column": "0,2"})
+    out = _load_text_file(str(path), cfg)
+    assert out["ignore"] == [0, 2]
+    assert "weight" not in out and "group" not in out
+
+
+def test_group_column_beats_query_sidecar(tmp_path):
+    path, _ = _ranking_file(tmp_path)
+    np.savetxt(str(path) + ".query", np.array([6, 6]), fmt="%d")
+    cfg = Config.from_params({"group_column": "2"})
+    out = _load_text_file(str(path), cfg)
+    np.testing.assert_array_equal(out["group"], [5, 4, 3])
+    # without the param the sidecar still applies
+    out2 = _load_text_file(str(path), Config.from_params({}))
+    np.testing.assert_array_equal(out2["group"], [6, 6])
+
+
+def test_ignored_columns_never_train(tmp_path):
+    """End-to-end: a file-fed Dataset with weight/group/ignore columns
+    trains, ignored features never appear in used_features or splits, and
+    the extracted weights change the fit exactly like in-memory weights."""
+    rng = np.random.default_rng(7)
+    n = 400
+    X = rng.normal(size=(n, 3))
+    y = 1.5 * X[:, 0] - 0.7 * X[:, 1] + 0.1 * rng.normal(size=n)
+    w = np.where(rng.random(n) < 0.5, 3.0, 0.25)
+    junk = rng.normal(size=n) * 100.0  # would split if not ignored
+    arr = np.column_stack([y, X, junk + y, w])
+    path = tmp_path / "train.csv"
+    _write_csv(path, arr)
+    params = {
+        "objective": "regression", "verbosity": -1, "num_leaves": 7,
+        "min_data_in_leaf": 10, "weight_column": "4", "ignore_column": "3",
+    }
+    ds = lgb.Dataset(str(path), params=params)
+    b = lgb.train(params, ds, 10)
+    ds.construct()
+    assert 3 not in ds.used_features  # ignored leaky column
+    assert 4 not in ds.used_features  # the weight column itself
+    feats = set()
+    for line in b.model_to_string().splitlines():
+        if line.startswith("split_feature="):
+            feats.update(int(t) for t in line.split("=")[1].split())
+    assert 3 not in feats and 4 not in feats
+    # parity with the in-memory weight path on the same features: the
+    # file-fed model keeps all 5 columns in its numbering, the in-memory
+    # one sees only the 3 real features — predictions must coincide
+    params_mem = {k: v for k, v in params.items()
+                  if k not in ("weight_column", "ignore_column")}
+    ds_mem = lgb.Dataset(X, y, weight=w, params=params_mem)
+    b_mem = lgb.train(params_mem, ds_mem, 10)
+    X_full = np.column_stack([X, junk + y, w])
+    np.testing.assert_allclose(
+        b.predict(X_full), b_mem.predict(X), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_group_column_trains_ranking(tmp_path):
+    """lambdarank from a single CSV whose qid travels as group_column."""
+    rng = np.random.default_rng(3)
+    n, q = 240, 24
+    qid = np.repeat(np.arange(q), n // q).astype(float)
+    X = rng.normal(size=(n, 3))
+    rel = (X[:, 0] + 0.5 * rng.normal(size=n) > 0.5).astype(float)
+    arr = np.column_stack([rel, X, qid])
+    path = tmp_path / "rank_train.csv"
+    _write_csv(path, arr)
+    params = {
+        "objective": "lambdarank", "verbosity": -1, "num_leaves": 7,
+        "min_data_in_leaf": 5, "group_column": "3", "metric": "ndcg",
+        "eval_at": [3],
+    }
+    ds = lgb.Dataset(str(path), params=params)
+    ev = {}
+    lgb.train(params, ds, 5, valid_sets=[ds], valid_names=["training"],
+              callbacks=[lgb.record_evaluation(ev)])
+    key = next(k for k in ev["training"] if "ndcg" in k)
+    assert np.isfinite(ev["training"][key][-1])

@@ -106,10 +106,12 @@ def test_libsvm_qid_groups(tmp_path):
 
 
 def test_sparse_wide_fails_actionably(monkeypatch):
-    """A sparse-wide dataset (50k one-hot columns) over the dense-layout
-    memory ceiling fails at construction with an error naming the fix
-    (categorical re-encoding), not an OOM mid-allocation (VERDICT r4
-    missing #2: the sparse-wide story is an enforced, documented ceiling)."""
+    """With EFB OFF, a sparse-wide dataset (50k one-hot columns) over the
+    dense-layout memory ceiling fails at construction with an error naming
+    the fixes (enable_bundle / categorical re-encoding), not an OOM
+    mid-allocation.  With EFB on (the default) the SAME data bundles into
+    a handful of planes and constructs under the ceiling — the former
+    error path is now the supported path."""
     sp = pytest.importorskip("scipy.sparse")
     # ~2.9k of the 50k columns survive trivial-feature pruning at this row
     # count; the ceiling sits below their ~8.3 MB footprint
@@ -122,9 +124,14 @@ def test_sparse_wide_fails_actionably(monkeypatch):
         (np.ones(n, np.float64), (rows, cols)), shape=(n, f)
     )
     y = rng.normal(size=n)
-    ds = lgb.Dataset(X, y)
+    ds = lgb.Dataset(X, y, params={"enable_bundle": False})
     with pytest.raises(ValueError, match="categorical"):
         ds.construct()
+    # EFB (default) bundles the mutually-exclusive columns under the ceiling
+    dsb = lgb.Dataset(X, y)
+    dsb.construct()
+    assert dsb.bundle_layout is not None and dsb.bundle_layout.has_bundles
+    assert dsb.num_planes * 10 <= len(dsb.used_features)
     # a small slice of the same data is under the ceiling and trains
     Xs = X[:, :40].toarray()
     b = lgb.train(

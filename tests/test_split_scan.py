@@ -124,6 +124,127 @@ def test_fused_grower_matches_default_end_to_end():
     assert _structure(b0) == _structure(b1)
 
 
+# --------------------------------------------------------------- near ties
+# Property test bounding the fused-scan near-tie flip rate (VERDICT item
+# 5): adversarial two-feature leaf histograms whose top candidates sit a
+# controlled relative gain gap apart, compared across the fused scan, the
+# XLA best_split, and a float64 oracle.  Both engines run f32, so below
+# the parent-minus-left cancellation scale the argmax can legitimately
+# pick the runner-up; the property that must hold is (a) above the scale
+# the choice matches the f64 oracle exactly, and (b) below it a flip only
+# ever lands on a candidate whose TRUE (f64) gain is within the gap of
+# optimal — near-tie flips are benign, wrong-split flips are bugs.
+#
+# Measured on this construction (seeds 0..9, gap targets 1e-1..1e-6, CPU
+# f32, recorded in BENCH_NOTES.md): zero flips for relative gap >= 1e-5
+# (53 trials); at gap ~1e-6 each engine flips on 1 of 7 trials (~14%), and
+# a wider 150-trial sweep (25 seeds) showed 3-4 of 18 trials (~20%) at
+# gap <= 1e-6 — every flip landing on the f64 runner-up candidate.
+
+_NT_L2 = 0.01
+_NT_MIN_DATA = 5
+_NT_MIN_HESS = 1e-3
+_NT_CANCEL_SCALE = 1e-4  # relative-gap scale above which flips = bugs
+
+
+def _oracle_gains64(hist64, parent):
+    """f64 per-(feature, bin) split gains, engine conventions (bins <= t
+    go left, t valid in [0, B-2], min_data/min_hess on both children)."""
+    B = hist64.shape[1]
+    cum = np.cumsum(hist64, axis=1)
+    lg, lh, lc = cum[..., 0], cum[..., 1], cum[..., 2]
+    rg, rh, rc = parent[0] - lg, parent[1] - lh, parent[2] - lc
+    gain = lg**2 / (lh + _NT_L2 + 1e-15) + rg**2 / (rh + _NT_L2 + 1e-15)
+    ok = (
+        (np.arange(B)[None, :] < B - 1)
+        & (lc >= _NT_MIN_DATA) & (rc >= _NT_MIN_DATA)
+        & (lh >= _NT_MIN_HESS) & (rh >= _NT_MIN_HESS)
+    )
+    return np.where(ok, gain, -np.inf)
+
+
+def _near_tie_problem(seed, target_rel_gap, n=4000, B=64):
+    """Two independent histograms; feature 1's gradients are bisected to a
+    scale where its f64-best gain trails feature 0's by ~target_rel_gap.
+    Independence decorrelates the engines' f32 rounding (identical
+    histograms round identically and can never flip)."""
+    rng = np.random.default_rng(seed)
+
+    def mk():
+        bins = rng.integers(0, B, size=n)
+        g = rng.normal(size=n)
+        h = rng.random(n) + 0.1
+        H = np.zeros((B, 3))
+        np.add.at(H[:, 0], bins, g)
+        np.add.at(H[:, 1], bins, h)
+        np.add.at(H[:, 2], bins, 1.0)
+        return H
+
+    h0, h1 = mk(), mk()
+    parent = h0.sum(axis=0)
+    tgt = _oracle_gains64(h0[None], parent).max() * (1.0 - target_rel_gap)
+    lo, hi = 0.0, 4.0
+    for _ in range(80):
+        mid = 0.5 * (lo + hi)
+        hh = h1.copy()
+        hh[:, 0] *= mid
+        if _oracle_gains64(hh[None], parent).max() < tgt:
+            lo = mid
+        else:
+            hi = mid
+    h1[:, 0] *= 0.5 * (lo + hi)
+    return np.stack([h0, h1]), parent
+
+
+def test_near_tie_flip_rate_bounded():
+    hp = dict(lambda_l1=0.0, lambda_l2=_NT_L2, min_data_in_leaf=_NT_MIN_DATA,
+              min_sum_hessian_in_leaf=_NT_MIN_HESS, min_gain_to_split=0.0)
+    B = 64
+    nb = jnp.full((2,), B, jnp.int32)
+    nanb = jnp.full((2,), -1, jnp.int32)
+    mask = jnp.ones((2,), bool)
+    below = {"xla": 0, "fused": 0, "n": 0}
+    for target in (1e-1, 1e-2, 1e-3, 1e-4, 1e-5, 1e-6):
+        for seed in range(10):
+            hist64, parent = _near_tie_problem(seed, target)
+            gain64 = _oracle_gains64(hist64, parent)
+            flat = np.sort(gain64.ravel())[::-1]
+            best, second = flat[0], flat[1]
+            rel_gap = (best - second) / abs(best)
+            fo, to = divmod(int(np.argmax(gain64.ravel())), B)
+            hist32 = jnp.asarray(hist64.astype(np.float32))
+            picks = {}
+            w = best_split(hist32, parent[0], parent[1], parent[2],
+                           nb, nanb, mask, **hp)
+            picks["xla"] = (int(w.feature), int(w.bin))
+            fz = fused_best_split(hist32, parent[0], parent[1], parent[2],
+                                  nb, nanb, mask, interpret=True, **hp)
+            picks["fused"] = (int(fz.feature), int(fz.bin))
+            for eng, (pf, pb) in picks.items():
+                flipped = (pf, pb) != (fo, to)
+                if rel_gap >= _NT_CANCEL_SCALE:
+                    assert not flipped, (
+                        f"{eng} flipped ABOVE the cancellation scale: "
+                        f"gap={rel_gap:.2e} picked f{pf}b{pb} over "
+                        f"f{fo}b{to} (seed={seed}, target={target})"
+                    )
+                elif flipped:
+                    below[eng] += 1
+                    # benign-flip property: the pick's TRUE gain is itself
+                    # within the cancellation scale of optimal
+                    assert gain64[pf, pb] >= best * (1 - _NT_CANCEL_SCALE), (
+                        f"{eng} flip landed on a genuinely worse split: "
+                        f"{gain64[pf, pb]} vs {best}"
+                    )
+            if rel_gap < _NT_CANCEL_SCALE:
+                below["n"] += 1
+    # sub-scale flips happen (that is WHY the scale exists) but must stay
+    # the exception, not the rule
+    if below["n"]:
+        assert below["xla"] <= below["n"] * 0.5, below
+        assert below["fused"] <= below["n"] * 0.5, below
+
+
 def test_fused_scan_inside_data_parallel_mesh():
     """The fused kernel must trace and run inside the shard_map'd
     data-parallel grower (the on-chip A/B will run it there): sharded
