@@ -122,11 +122,24 @@ def main() -> None:
     booster._bump_model_version()
     pred_rows = min(n_rows, 500_000)
     Xp = X[:pred_rows]
-    booster.predict(Xp)  # warmup/compile
+    t0 = time.perf_counter()
+    booster.predict(Xp)  # warmup: bucket-ladder executables compile here
+    pred_warmup_dt = time.perf_counter() - t0
     t0 = time.perf_counter()
     booster.predict(Xp)
     pred_dt = time.perf_counter() - t0
     preds_per_sec = pred_rows / pred_dt
+    # phase-resolved breakdown of the timed run (streaming engine /
+    # forest-walk stats): which pipeline stage regressed is visible
+    # round-over-round instead of one opaque preds_per_sec scalar
+    pred_stats = dict(booster.last_predict_stats)
+    pred_phases = {
+        k: round(float(pred_stats.get(k, 0.0)), 1)
+        for k in ("bin_ms", "transfer_ms", "walk_ms", "host_ms")
+    }
+    pred_phases["path"] = pred_stats.get("path", "unknown")
+    pred_phases["chunks"] = pred_stats.get("chunks", 1)
+    pred_phases["compiles_in_timed_run"] = pred_stats.get("compiles", 0)
 
     import jax as _jax
 
@@ -142,6 +155,8 @@ def main() -> None:
         "preds_per_sec": round(preds_per_sec),
         "pred_rows": pred_rows,
         "preds_vs_fork_84k": round(preds_per_sec / 84000.0, 2),
+        "pred_warmup_s": round(pred_warmup_dt, 2),
+        "pred_phases": pred_phases,
     }
     if iters_per_sec_secondary is not None:
         out[f"iters_per_sec_{secondary_rows}_rows"] = round(

@@ -37,11 +37,8 @@ from ..ops.grower import (
 )
 from ..predict import (
     BinTreeBatch,
+    StreamingPredictor,
     add_tree_to_score,
-    predict_bins_leaves,
-    predict_bins_raw,
-    predict_real_leaves,
-    predict_real_raw,
     stack_bin_trees,
     stack_real_trees,
 )
@@ -121,9 +118,13 @@ class Booster:
         if model_file is not None:
             with open(model_file) as f:
                 self._load_model_string(f.read())
+            if self.config.pred_aot_compile:
+                self.compile_predict()
             return
         if model_str is not None:
             self._load_model_string(model_str)
+            if self.config.pred_aot_compile:
+                self.compile_predict()
             return
         if train_set is None:
             raise ValueError("Booster needs train_set, model_file, or model_str")
@@ -1810,24 +1811,20 @@ class Booster:
         es_requested = bool(
             kwargs.get("pred_early_stop", self.config.pred_early_stop)
         ) and self._early_stop_type(k) != "none"
+        knobs = self._predict_knobs(kwargs)
         if use_bins:
             if not pred_leaf and not es_requested:
                 # fast path: Pallas forest-walk kernel (the fork's
                 # tree_avx512 batch predictor, TPU-shaped) with device-side
-                # binning — falls back to the XLA walker off-TPU or for
-                # categorical/wide trees
+                # binning — falls back to the streaming XLA engine off-TPU
+                # or for categorical/wide trees
                 raw_fw = self._forest_walk_raw(
                     X, t0, t1, k,
                     exact_binning=bool(kwargs.get("pred_exact_binning", False)),
                 )
                 if raw_fw is not None:
                     return self._finish_predict(raw_fw, t0, t1, k, raw_score)
-            bins = jnp.asarray(self._bin_input_host(X))
-            batch = self._stacked_bins(t0, t1)
-            if pred_leaf:
-                leaves = predict_bins_leaves(batch, bins, self._nan_bins)
-                return np.asarray(leaves, dtype=np.int32)
-            per_tree = np.asarray(predict_bins_raw(batch, bins, self._nan_bins), dtype=np.float64)
+            space = "bin"
         else:
             if hasattr(X, "toarray"):  # real-space walkers need dense values
                 X = np.asarray(X.toarray(), dtype=np.float64)
@@ -1838,30 +1835,105 @@ class Booster:
                 per_tree = np.stack(
                     [t.predict(X) for t in self.models_[t0:t1]], axis=1
                 )
-            else:
-                batch = self._stacked_real(t0, t1)
-                Xd = jnp.asarray(X, dtype=jnp.float32)
-                if pred_leaf:
-                    return np.asarray(predict_real_leaves(batch, Xd), dtype=np.int32)
-                per_tree = np.asarray(predict_real_raw(batch, Xd), dtype=np.float64)
-                # f32-boundary exactness: the device walker compares f32
-                # values against f32-cast thresholds; rows within f32
-                # rounding of a double threshold (~1e-5 of rows at 376
-                # trees, measured vs the reference CLI) re-walk on host in
-                # f64, matching NumericalDecision's double compare exactly
-                sus = self._real_walk_suspects(np.asarray(X, np.float64), t0, t1)
-                if sus.size:
-                    per_tree[sus] = np.stack(
-                        [t.predict(X[sus]) for t in self.models_[t0:t1]],
-                        axis=1,
-                    )
+                n = X.shape[0]
+                if es_requested:
+                    raw = self._apply_pred_early_stop(per_tree, k, kwargs)
+                else:
+                    raw = per_tree.reshape(n, (t1 - t0) // k, k).sum(axis=1)
+                return self._finish_predict(raw, t0, t1, k, raw_score)
+            space = "real"
 
+        # streaming engine: chunked, bucket-padded, double-buffered walks
+        # (real-space chunks carry the f64 suspect re-walk patch inside)
+        eng = self._stream_engine()
+        if pred_leaf:
+            return eng.run(X, t0, t1, space=space, kind="leaf", **knobs)
         n = X.shape[0]
+        iters = (t1 - t0) // k
         if es_requested:
+            per_tree = eng.run(X, t0, t1, space=space, kind="value", **knobs)
             raw = self._apply_pred_early_stop(per_tree, k, kwargs)
         else:
-            raw = per_tree.reshape(n, -1, k).sum(axis=1)  # [N, K]
+            raw = eng.run(
+                X,
+                t0,
+                t1,
+                space=space,
+                kind="value",
+                reduce_fn=lambda blk, rows: blk.reshape(rows, iters, k).sum(
+                    axis=1
+                ),
+                **knobs,
+            )
         return self._finish_predict(raw, t0, t1, k, raw_score)
+
+    def _predict_knobs(self, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        """Streaming-engine tuning knobs: per-call kwargs win over params."""
+        cfg = self.config
+        return {
+            "chunk": int(kwargs.get("pred_chunk_rows", cfg.pred_chunk_rows)),
+            "num_buffers": int(
+                kwargs.get("pred_num_buffers", cfg.pred_num_buffers)
+            ),
+            "shard_devices": int(
+                kwargs.get("pred_shard_devices", cfg.pred_shard_devices)
+            ),
+        }
+
+    def _stream_engine(self) -> StreamingPredictor:
+        eng = getattr(self, "_stream", None)
+        if eng is None:
+            eng = self._stream = StreamingPredictor(self)
+        return eng
+
+    @property
+    def last_predict_stats(self) -> Dict[str, Any]:
+        """Phase breakdown of the most recent predict() call (bin_ms,
+        transfer_ms, walk_ms, host_ms, chunks, buckets, compiles)."""
+        stats = getattr(self, "_fw_stats", None)
+        eng = getattr(self, "_stream", None)
+        if eng is not None and eng.last_stats:
+            return eng.last_stats
+        return stats or {}
+
+    def _bin_matrix_width(self) -> int:
+        """Column count of the host-binned prediction matrix: bundle planes
+        under EFB, used features otherwise, 1 dummy when nothing is used."""
+        ds = self.train_set
+        layout = getattr(ds, "bundle_layout", None)
+        if layout is not None and getattr(layout, "has_bundles", False):
+            return max(1, ds.num_planes)
+        return max(1, len(ds.used_features))
+
+    def compile_predict(
+        self,
+        start_iteration: int = 0,
+        num_iteration: Optional[int] = None,
+        kinds=("value",),
+    ) -> int:
+        """AOT-lower and cache the streaming engine's bucket-ladder
+        executables so the first predict() pays no compile (pred_aot_compile
+        runs this at Booster load).  Returns the number of executables
+        compiled."""
+        t0, t1 = self._tree_range(start_iteration, num_iteration)
+        if t1 <= t0 or not self.models_:
+            return 0
+        use_bins = (
+            self.train_set is not None
+            and self.train_set.bin_mappers
+            and not any(
+                r.get("no_bin_form") for r in self._bin_records[t0:t1]
+            )
+        )
+        knobs = self._predict_knobs({})
+        return self._stream_engine().warmup(
+            t0,
+            t1,
+            space="bin" if use_bins else "real",
+            chunk=max(256, knobs["chunk"]),
+            shard_devices=knobs["shard_devices"],
+            kinds=kinds,
+        )
 
     def _real_walk_suspects(self, X: np.ndarray, t0: int, t1: int) -> np.ndarray:
         """Row indices whose f32 walk could disagree with the reference's
@@ -1914,7 +1986,20 @@ class Booster:
             raw = raw[:, 0]
         if raw_score or self.objective is None:
             return raw
-        return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+        n = raw.shape[0]
+        if n == 0:
+            return raw
+        # pad rows to a power of two before the (row-local) output transform
+        # so convert_output compiles per bucket, not per distinct row count
+        n_pad = _ceil_pow2(n)
+        if n_pad != n:
+            widths = [(0, n_pad - n)] + [(0, 0)] * (raw.ndim - 1)
+            padded = np.pad(raw, widths)
+        else:
+            padded = raw
+        return np.asarray(
+            self.objective.convert_output(jnp.asarray(padded))
+        )[:n]
 
     def _forest_walk_raw(self, X, t0, t1, k, exact_binning: bool = False):
         """Raw class scores via the Pallas forest-walk kernel
@@ -1928,8 +2013,8 @@ class Booster:
 
         from ..ops.pallas.forest_walk import (
             _pack_bins_device,
-            ROW_TILE,
             bin_numeric_device,
+            bucket_pad_rows,
             build_devbin_tables,
             build_tables,
             forest_walk,
@@ -1988,9 +2073,33 @@ class Booster:
                 interpret=_WALK_INTERPRET,
             )
 
+        import time as _time
+
+        t_start = _time.perf_counter()
+
+        def _fw_stats(bin_ms=0.0, walk_ms=0.0, chunks=1):
+            self._fw_stats = {
+                "path": "forest_walk",
+                "rows": n,
+                "chunks": chunks,
+                "bin_ms": round(bin_ms, 3),
+                "transfer_ms": 0.0,
+                "walk_ms": round(walk_ms, 3),
+                "host_ms": 0.0,
+            }
+            # engine stats would shadow these (last_predict_stats prefers
+            # the engine when it ran last) — clear its record
+            if getattr(self, "_stream", None) is not None:
+                self._stream.last_stats = {}
+
         if dbt is None:
-            out = _walk(pad_bins_for_walk(self._bin_input_host(X)))
-            return unpack_walk_scores(np.asarray(out), n, k).astype(np.float64)
+            t_b = _time.perf_counter()
+            host_bins = self._bin_input_host(X)
+            bin_ms = (_time.perf_counter() - t_b) * 1e3
+            out = _walk(pad_bins_for_walk(host_bins, bucket_pad_rows(n)))
+            res = unpack_walk_scores(np.asarray(out), n, k).astype(np.float64)
+            _fw_stats(bin_ms, (_time.perf_counter() - t_start) * 1e3 - bin_ms)
+            return res
 
         # device binning + chunked feed: fixed-size chunks keep ONE compiled
         # (bin, pack, walk) pipeline, and dispatching chunk i+1's host slice
@@ -2022,9 +2131,12 @@ class Booster:
 
         if n <= CHUNK:
             xs = np.ascontiguousarray(X[:, used], dtype=np.float32)
-            n_pad = (n + ROW_TILE - 1) // ROW_TILE * ROW_TILE
-            out = _walk(_pack_bins_device(_bin_chunk(xs, X, n), n_pad))
-            return unpack_walk_scores(np.asarray(out), n, k).astype(np.float64)
+            # bucketed tile count: varying batch sizes reuse a small ladder
+            # of compiled walk programs instead of one per distinct size
+            out = _walk(_pack_bins_device(_bin_chunk(xs, X, n), bucket_pad_rows(n)))
+            res = unpack_walk_scores(np.asarray(out), n, k).astype(np.float64)
+            _fw_stats(0.0, (_time.perf_counter() - t_start) * 1e3)
+            return res
 
         # one-chunk lookahead drain: chunk i dispatches asynchronously, then
         # chunk i-1 transfers to host — compute/transfer overlap without
@@ -2042,7 +2154,9 @@ class Booster:
                 parts.append(unpack_walk_scores(np.asarray(pending[0]), pending[1], k))
             pending = (out, rows)
         parts.append(unpack_walk_scores(np.asarray(pending[0]), pending[1], k))
-        return np.concatenate(parts, axis=0).astype(np.float64)
+        res = np.concatenate(parts, axis=0).astype(np.float64)
+        _fw_stats(0.0, (_time.perf_counter() - t_start) * 1e3, chunks=-(-n // CHUNK))
+        return res
 
     def _early_stop_type(self, k: int) -> str:
         """Reference c_api chooses the margin rule from the objective

@@ -82,7 +82,10 @@ def run_predict(params: Dict[str, Any], cfg: Config) -> None:
         raise SystemExit("task=predict requires input_model=<model file>")
     if not cfg.data:
         raise SystemExit("task=predict requires data=<input file>")
-    booster = Booster(model_file=model_path)
+    # pass the CLI params through: the streaming-engine knobs
+    # (pred_chunk_rows / pred_num_buffers / pred_shard_devices /
+    # pred_aot_compile) live in Config and must reach the booster
+    booster = Booster(params, model_file=model_path)
     loaded = _load_text_file(cfg.data, cfg)
     X = loaded["data"]
     pred = booster.predict(

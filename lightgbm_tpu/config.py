@@ -391,6 +391,14 @@ class Config:
     pred_early_stop: bool = False
     pred_early_stop_freq: int = 10
     pred_early_stop_margin: float = 10.0
+    # streaming batch-prediction engine (predict.StreamingPredictor): chunk
+    # size fed per compiled walk, pipeline depth (chunks in flight), local
+    # devices to row-shard each chunk over (-1 = all), and whether Booster
+    # load AOT-compiles the bucket-ladder executables up front
+    pred_chunk_rows: int = 4096
+    pred_num_buffers: int = 2
+    pred_shard_devices: int = 1
+    pred_aot_compile: bool = False
 
     # Objective
     objective_seed: int = 5

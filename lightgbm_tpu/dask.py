@@ -187,6 +187,27 @@ def _train_part(
     return booster.model_to_string()
 
 
+# one-entry per-process booster cache: real dask workers are long-lived, so
+# repeated _predict_part calls for the same model skip the text-format parse
+_PREDICT_BOOSTER_CACHE: Dict[int, Any] = {}
+
+
+def _predict_part(
+    model_str: str, X_part: np.ndarray, predict_kwargs: Dict[str, Any]
+):
+    """Runs ON a worker: load (or reuse) the booster and stream the local
+    partition through the chunked prediction engine.  Output rides back to
+    the driver in partition order."""
+    from .boosting.gbdt import Booster
+
+    key = hash(model_str)
+    booster = _PREDICT_BOOSTER_CACHE.get(key)
+    if booster is None:
+        _PREDICT_BOOSTER_CACHE.clear()
+        booster = _PREDICT_BOOSTER_CACHE[key] = Booster(model_str=model_str)
+    return booster.predict(X_part, **predict_kwargs)
+
+
 class _DaskLGBMModel:
     """Mixin implementing the distributed fit over a dask-like client."""
 
@@ -258,6 +279,56 @@ class _DaskLGBMModel:
         self._Booster = Booster(model_str=model_str)
         return self
 
+    def _dask_predict(self, X, **kwargs):
+        """Partition-wise streaming predict: contiguous row chunks fan out
+        to the workers (same split rule as fit), each worker streams its
+        partition through the chunked engine (``_predict_part``), and the
+        driver concatenates in partition order — so the result is
+        bit-identical to a single-host ``Booster(model_str=...).predict``
+        over the same rows."""
+        client = self._resolve_client()
+        workers = _worker_addresses(client)
+        if hasattr(X, "to_delayed") or hasattr(X, "dask"):
+            raise NotImplementedError(
+                "dask-collection inputs need dask installed at runtime; "
+                "pass numpy/scipy arrays (split contiguously per worker)"
+            )
+        X = np.asarray(X, dtype=np.float64)
+        parts = _split_rows(X, len(workers))
+        model_str = self.booster_.model_to_string()
+        futures = [
+            (i, client.submit(_predict_part, model_str, parts[i], kwargs, workers=[w]))
+            for i, w in enumerate(workers)
+            if parts[i].shape[0]
+        ]
+        results = [f.result() for _, f in futures]
+        if not results:
+            return self.booster_.predict(X, **kwargs)  # 0-row input
+        return (
+            results[0]
+            if len(results) == 1
+            else np.concatenate(results, axis=0)
+        )
+
+    def predict(self, X, distributed: bool = False, **kwargs):
+        """Local streaming predict by default; ``distributed=True`` fans the
+        rows out to the training workers partition-wise (each worker loads
+        the model once and streams its chunk).  Classifier label/proba
+        semantics are applied on the driver either way."""
+        if not distributed:
+            return super().predict(X, **kwargs)
+        out = self._dask_predict(X, **kwargs)
+        if (
+            isinstance(self, LGBMClassifier)
+            and not kwargs.get("raw_score")
+            and not kwargs.get("pred_leaf")
+            and not kwargs.get("pred_contrib")
+        ):
+            if out.ndim == 1:  # binary: booster emits P(class 1)
+                return self._classes[(out > 0.5).astype(int)]
+            return self._classes[np.argmax(out, axis=1)]
+        return out
+
     def to_local(self):
         """A plain (non-dask) estimator carrying the trained booster
         (reference dask.py ``to_local``)."""
@@ -289,6 +360,14 @@ class DaskLGBMClassifier(_DaskLGBMModel, LGBMClassifier):
 
     def fit(self, X, y, sample_weight=None, **kwargs):
         return self._dask_fit(X, y, sample_weight=sample_weight, **kwargs)
+
+    def predict_proba(self, X, distributed: bool = False, **kwargs):
+        if not distributed:
+            return super().predict_proba(X, **kwargs)
+        prob = self._dask_predict(X, **kwargs)
+        if self._n_classes <= 2 and prob.ndim == 1:
+            return np.stack([1.0 - prob, prob], axis=1)
+        return prob
 
 
 class DaskLGBMRanker(_DaskLGBMModel, LGBMRanker):

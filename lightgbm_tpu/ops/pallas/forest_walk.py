@@ -61,6 +61,25 @@ def n_planes(num_features: int) -> int:
     return p
 
 
+def tile_bucket(n_rows: int) -> int:
+    """Bucketed tile count for an n_rows walk: the power-of-two ceiling of
+    ceil(n_rows / ROW_TILE).  The pallas grid is sized by tile count, so
+    without bucketing every distinct row count compiles a fresh executable;
+    with it a stream of arbitrary batch sizes reuses a small ladder of
+    cached programs (the streaming engine's bucket contract)."""
+    tiles = max(1, -(-n_rows // ROW_TILE))
+    b = 1
+    while b < tiles:
+        b <<= 1
+    return b
+
+
+def bucket_pad_rows(n_rows: int) -> int:
+    """Row count padded to the tile-bucket boundary (bucket-shape entry:
+    feed `pad_bins_for_walk`/`_pack_bins_device` this many rows)."""
+    return tile_bucket(n_rows) * ROW_TILE
+
+
 class ForestTables(NamedTuple):
     """Per-tree node tables, shaped [T, H, 128] (H lane-gather halves — the
     leading dim carries the tree index so per-tree slicing never hits the
@@ -425,14 +444,17 @@ def _pack_bins_device(mat_u8: jnp.ndarray, n_pad: int) -> jnp.ndarray:
     )
 
 
-def pad_bins_for_walk(bins: np.ndarray) -> jnp.ndarray:
+def pad_bins_for_walk(bins: np.ndarray, n_pad: int = 0) -> jnp.ndarray:
     """[N, F] int bins -> [n_tiles, P, 8, 128] i32, 4 bins
     byte-packed per i32 (feature j in byte j&3 of pack j>>2); row n sits at
     [n // 1024, :, (n % 1024) // 128, n % 128].  Only the compact u8 matrix
     crosses host->device (the padded i32 form is 9x bigger — built on
-    device)."""
+    device).  ``n_pad`` overrides the padded row count (pass
+    ``bucket_pad_rows(n)`` to land on the bucket ladder); 0 keeps the
+    minimal ROW_TILE ceiling."""
     n, f = bins.shape
-    n_pad = (n + ROW_TILE - 1) // ROW_TILE * ROW_TILE
+    if n_pad <= 0:
+        n_pad = (n + ROW_TILE - 1) // ROW_TILE * ROW_TILE
     # clip: categorical columns may carry an out-of-range unseen-category
     # sentinel — clipping to 255 keeps byte packing intact, and bin 255 is
     # outside every cat mask (<= 256 wide only when max_bin == 256... the

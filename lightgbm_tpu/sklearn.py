@@ -222,8 +222,12 @@ class LGBMModel:
             raise ValueError("Estimator not fitted, call fit first")
         if num_iteration is None and self._best_iteration > 0:
             num_iteration = self._best_iteration
+        # keep scipy inputs sparse: the streaming engine bins CSC directly
+        # (densifying here would also break on wide sparse matrices)
+        if not hasattr(X, "tocsc"):
+            X = np.asarray(X, dtype=np.float64)
         return self._Booster.predict(
-            np.asarray(X, dtype=np.float64),
+            X,
             raw_score=raw_score,
             start_iteration=start_iteration,
             num_iteration=num_iteration,

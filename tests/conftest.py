@@ -141,6 +141,10 @@ _SLOW_TESTS = {
     "test_booster.py::test_dart",
     "test_booster.py::test_goss_trains",
     "test_sparse.py::test_sparse_training_matches_dense",
+    # bench-scale streaming-prediction A/B (500k rows); the <=5k-row parity
+    # tests in test_streaming_predict.py stay tier-1
+    "test_streaming_predict.py::test_500k_prediction_ab_chunked_vs_singleshot",
+    "test_dask.py::test_dask_distributed_predict_matches_local",
 }
 
 

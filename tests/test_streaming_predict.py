@@ -1,0 +1,283 @@
+"""Streaming batch-prediction engine (predict.StreamingPredictor).
+
+Contracts under test:
+  * chunked/bucket-padded prediction is BIT-IDENTICAL to single-shot for
+    bin-space and real-space walkers — including the f64 suspect re-walk
+    rows, odd remainder chunks, and the 0-row edge;
+  * varying batch sizes NEVER recompile once the bucket ladder is warm
+    (streaming_compile_count is the jit cache-miss counter);
+  * row-sharding a chunk over a local device mesh changes nothing about
+    the output (virtual 8-device CPU mesh from conftest);
+  * Booster.compile_predict AOT-builds the ladder so the first predict
+    pays no compile.
+
+The 500k-row A/B lives at the bottom and is tier-2 (`slow`); everything
+else stays <=5k rows so the engine is exercised on every tier-1 run.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.predict import (
+    LADDER_MIN,
+    bucket_rows,
+    ladder_buckets,
+    streaming_compile_count,
+)
+
+
+def _make_binary(n=3000, f=12, seed=3, rounds=15):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    y = ((X @ w + rng.normal(scale=0.5, size=n)) > 0).astype(np.float64)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 31, "verbose": -1},
+        lgb.Dataset(X, label=y),
+        num_boost_round=rounds,
+    )
+    return bst, X
+
+
+def _make_multiclass(n=2500, f=10, seed=4, rounds=8):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = np.digitize(X[:, 0] + 0.3 * X[:, 1], [-0.5, 0.5]).astype(np.float64)
+    bst = lgb.train(
+        {
+            "objective": "multiclass",
+            "num_class": 3,
+            "num_leaves": 15,
+            "verbose": -1,
+        },
+        lgb.Dataset(X, label=y),
+        num_boost_round=rounds,
+    )
+    return bst, X
+
+
+@pytest.fixture(scope="module")
+def binary_model():
+    return _make_binary()
+
+
+@pytest.fixture(scope="module")
+def multiclass_model():
+    return _make_multiclass()
+
+
+def test_bucket_ladder_shapes():
+    assert bucket_rows(1, 4096) == LADDER_MIN
+    assert bucket_rows(LADDER_MIN, 4096) == LADDER_MIN
+    assert bucket_rows(LADDER_MIN + 1, 4096) == 2 * LADDER_MIN
+    assert bucket_rows(4096, 4096) == 4096
+    assert bucket_rows(9999, 4096) == 4096  # full chunks cap at chunk
+    # non-power-of-two chunk still tops the ladder with itself
+    assert bucket_rows(5000, 5000) == 5000
+    assert ladder_buckets(4096) == [256, 512, 1024, 2048, 4096]
+    for n in (1, 100, 300, 1000, 5000):
+        assert bucket_rows(n, 4096) >= min(n, 4096)
+        assert bucket_rows(n, 4096) in ladder_buckets(4096)
+
+
+def test_bin_space_chunked_bit_identical(binary_model):
+    bst, X = binary_model
+    single = bst.predict(X, pred_chunk_rows=1 << 20)
+    assert bst.last_predict_stats["chunks"] == 1
+    for chunk in (512, 1024, 2048):  # 3000 rows -> odd remainder chunks
+        chunked = bst.predict(X, pred_chunk_rows=chunk)
+        assert np.array_equal(single, chunked)
+    assert bst.last_predict_stats["chunks"] > 1
+    # raw scores and leaf indices stream through the same scheduler
+    raw_s = bst.predict(X, raw_score=True, pred_chunk_rows=1 << 20)
+    raw_c = bst.predict(X, raw_score=True, pred_chunk_rows=512)
+    assert np.array_equal(raw_s, raw_c)
+    leaf_s = bst.predict(X, pred_leaf=True, pred_chunk_rows=1 << 20)
+    leaf_c = bst.predict(X, pred_leaf=True, pred_chunk_rows=512)
+    assert leaf_c.dtype == np.int32
+    assert np.array_equal(leaf_s, leaf_c)
+
+
+def test_multiclass_chunked_bit_identical(multiclass_model):
+    bst, X = multiclass_model
+    single = bst.predict(X, pred_chunk_rows=1 << 20)
+    chunked = bst.predict(X, pred_chunk_rows=512)
+    assert single.shape == (X.shape[0], 3)
+    assert np.array_equal(single, chunked)
+
+
+def test_empty_input_all_kinds(binary_model, multiclass_model):
+    bst, X = binary_model
+    mc, Xm = multiclass_model
+    assert bst.predict(X[:0]).shape == (0,)
+    assert bst.predict(X[:0], raw_score=True).shape == (0,)
+    leaves = bst.predict(X[:0], pred_leaf=True)
+    assert leaves.shape == (0, bst.num_trees())
+    assert leaves.dtype == np.int32
+    assert mc.predict(Xm[:0]).shape == (0, 3)
+
+
+def test_real_space_chunked_bit_identical_with_suspects(binary_model):
+    """Loaded-from-text boosters walk in real-value space; rows sitting
+    EXACTLY on split thresholds take the f64 suspect re-walk, which must be
+    per-chunk identical to the single-shot patch."""
+    bst, X = binary_model
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    # plant threshold-exact rows in several chunks
+    X = np.array(X, copy=True)
+    tree0 = loaded.models_[0]
+    feat = int(tree0.split_feature[0])
+    thr = float(tree0.threshold[0])
+    X[5, feat] = thr
+    X[701, feat] = thr
+    X[2901, feat] = thr
+    sus = loaded._real_walk_suspects(X, 0, len(loaded.models_))
+    assert sus.size >= 3  # the planted rows ARE suspects
+    single = loaded.predict(X, pred_chunk_rows=1 << 20)
+    assert loaded.last_predict_stats["path"] == "stream_real"
+    for chunk in (512, 700, 2048):
+        chunked = loaded.predict(X, pred_chunk_rows=chunk)
+        assert np.array_equal(single, chunked)
+    # suspect rows match the host f64 reference walk exactly
+    raw = loaded.predict(X, raw_score=True, pred_chunk_rows=512)
+    host = np.sum(
+        np.stack([t.predict(X[sus]) for t in loaded.models_], axis=1), axis=1
+    )
+    np.testing.assert_allclose(raw[sus], host, rtol=0, atol=0)
+
+
+def test_zero_recompiles_across_batch_sizes(binary_model):
+    bst, X = binary_model
+    chunk = int(bst.config.pred_chunk_rows)
+    # warm every ladder bucket once
+    for b in ladder_buckets(chunk):
+        bst.predict(X[: min(b, len(X))])
+    before = streaming_compile_count()
+    for n in (1, 3, 17, 100, 255, 256, 257, 999, 1024, 2047, 3000):
+        out = bst.predict(X[:n])
+        assert out.shape == (n,)
+        assert bst.last_predict_stats["compiles"] == 0
+    assert streaming_compile_count() == before
+
+
+def test_sklearn_route_zero_recompiles():
+    """sklearn estimators ride the same bucket-padded path: once warm,
+    predict/predict_proba across varying batch sizes never recompile."""
+    from lightgbm_tpu.sklearn import LGBMClassifier
+
+    rng = np.random.default_rng(8)
+    X = rng.normal(size=(2000, 6))
+    y = (X[:, 0] > 0).astype(int)
+    est = LGBMClassifier(n_estimators=5, num_leaves=15, verbose=-1)
+    est.fit(X, y)
+    chunk = int(est.booster_.config.pred_chunk_rows)
+    for b in ladder_buckets(chunk):
+        est.predict_proba(X[: min(b, len(X))])
+    before = streaming_compile_count()
+    for n in (2, 33, 450, 1111, 2000):
+        assert est.predict(X[:n]).shape == (n,)
+        assert est.predict_proba(X[:n]).shape == (n, 2)
+        assert est.booster_.last_predict_stats["compiles"] == 0
+    assert streaming_compile_count() == before
+
+
+def test_sklearn_sparse_predict_matches_dense():
+    """scipy input stays sparse through the sklearn wrapper (binned once
+    from CSC by the engine) and matches the dense prediction exactly."""
+    import scipy.sparse as sp
+
+    from lightgbm_tpu.sklearn import LGBMRegressor
+
+    rng = np.random.default_rng(9)
+    X = np.where(rng.random((1500, 8)) < 0.3, rng.normal(size=(1500, 8)), 0.0)
+    y = X[:, 0] + 0.5 * X[:, 1]
+    est = LGBMRegressor(n_estimators=5, num_leaves=15, verbose=-1)
+    est.fit(X, y)
+    np.testing.assert_array_equal(
+        est.predict(sp.csr_matrix(X), pred_chunk_rows=512), est.predict(X, pred_chunk_rows=512)
+    )
+
+
+def test_aot_compile_then_first_predict_is_compile_free(binary_model):
+    bst, X = binary_model
+    fresh = lgb.Booster(model_str=bst.model_to_string())
+    compiled = fresh.compile_predict()
+    # a fresh real-space model may still share an executable shape with an
+    # earlier test's model (the cache is process-global by design); what
+    # matters is the ladder is FULLY warm now
+    assert compiled >= 0
+    assert fresh.compile_predict() == 0  # idempotent: everything cached
+    for n in (7, 300, 2000):
+        fresh.predict(X[:n])
+        assert fresh.last_predict_stats["compiles"] == 0
+
+
+def test_pred_aot_compile_param_warms_at_load(binary_model):
+    bst, X = binary_model
+    loaded = lgb.Booster(
+        params={"pred_aot_compile": True}, model_str=bst.model_to_string()
+    )
+    loaded.predict(X[:123])
+    assert loaded.last_predict_stats["compiles"] == 0
+
+
+def test_sharded_matches_single_device(binary_model, multiclass_model):
+    """Row-sharding chunks over the virtual CPU mesh (conftest forces 8
+    host devices) is output-identical to the single-device walk."""
+    import jax
+
+    assert jax.local_device_count() >= 4  # conftest mesh
+    bst, X = binary_model
+    base = bst.predict(X, pred_chunk_rows=1024)
+    for nd in (4, -1):  # -1 = all local devices
+        sharded = bst.predict(X, pred_chunk_rows=1024, pred_shard_devices=nd)
+        assert bst.last_predict_stats["shard_devices"] >= 4
+        assert np.array_equal(base, sharded)
+    mc, Xm = multiclass_model
+    base_mc = mc.predict(Xm)
+    sharded_mc = mc.predict(Xm, pred_shard_devices=4)
+    assert np.array_equal(base_mc, sharded_mc)
+    # loaded (real-space) models shard too
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_array_equal(
+        loaded.predict(X, pred_chunk_rows=1024),
+        loaded.predict(X, pred_chunk_rows=1024, pred_shard_devices=4),
+    )
+
+
+def test_num_buffers_depth_does_not_change_output(binary_model):
+    bst, X = binary_model
+    base = bst.predict(X, pred_chunk_rows=512, pred_num_buffers=1)
+    for depth in (2, 4, 8):
+        assert np.array_equal(
+            base,
+            bst.predict(X, pred_chunk_rows=512, pred_num_buffers=depth),
+        )
+
+
+def test_phase_breakdown_reported(binary_model):
+    bst, X = binary_model
+    bst.predict(X, pred_chunk_rows=512)
+    stats = bst.last_predict_stats
+    for key in ("bin_ms", "transfer_ms", "walk_ms", "host_ms"):
+        assert key in stats and stats[key] >= 0.0
+    assert stats["rows"] == X.shape[0]
+    assert stats["chunks"] == -(-X.shape[0] // 512)
+    assert set(stats["buckets"]) <= set(ladder_buckets(512))
+
+
+def test_500k_prediction_ab_chunked_vs_singleshot():
+    """Tier-2 (slow) A/B at bench scale: 500k rows through the streaming
+    engine must match the one-chunk walk bit-for-bit and report a full
+    phase breakdown."""
+    bst, _ = _make_binary(n=20_000, f=28, rounds=10)
+    rng = np.random.default_rng(99)
+    Xp = rng.normal(size=(500_000, 28))
+    single = bst.predict(Xp, pred_chunk_rows=1 << 20)
+    chunked = bst.predict(Xp, pred_chunk_rows=4096)
+    assert np.array_equal(single, chunked)
+    stats = bst.last_predict_stats
+    assert stats["chunks"] == -(-500_000 // 4096)
+    bst.predict(Xp, pred_chunk_rows=4096)  # ladder warm: now compile-free
+    assert bst.last_predict_stats["compiles"] == 0
