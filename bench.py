@@ -56,14 +56,15 @@ _PARAMS = {
 }
 
 
-def _train_bench(X, y, timed_iters: int, warmup_iters: int = 2):
+def _train_bench(X, y, timed_iters: int, warmup_iters: int = 2, params=None):
     """(iters/sec, booster) for the Higgs-shaped workload on these rows."""
     import jax
 
     import lightgbm_tpu as lgb
 
-    dtrain = lgb.Dataset(X, y, params=_PARAMS)
-    booster = lgb.Booster(_PARAMS, dtrain)
+    params = params or _PARAMS
+    dtrain = lgb.Dataset(X, y, params=params)
+    booster = lgb.Booster(params, dtrain)
     for _ in range(warmup_iters):
         booster.update()
     jax.block_until_ready(booster._score)
@@ -72,6 +73,132 @@ def _train_bench(X, y, timed_iters: int, warmup_iters: int = 2):
         booster.update()
     jax.block_until_ready(booster._score)
     return timed_iters / (time.perf_counter() - t0), booster
+
+
+def _time_op(fn, *args, reps: int = 3):
+    """Seconds for one jitted call (min over reps, after a compile run)."""
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _train_phases(booster, iters_per_sec):
+    """Per-tree training-phase breakdown (mirror of pred_phases).
+
+    The grower is one fused jit, so the phases can't be wall-clocked
+    individually; instead this measures the throughput of each phase's
+    primitive at the bench shape (histogram build, stable-sort partition,
+    best-split scan) and scales by the ROW/CALL counts the trained trees
+    actually incurred (sum of internal_count for partition, sum of
+    smaller-child counts for histograms, 2 candidate refreshes per split).
+    ``bookkeeping_ms`` is the measured per-tree remainder: state writes,
+    gradient/score updates, dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.histogram import leaf_histogram
+    from lightgbm_tpu.ops.split import best_split
+
+    bins = booster._bins
+    n, f = bins.shape
+    gp = booster._grower_params
+    B = gp.max_bin
+    grad = jnp.ones((n,), jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    mask = jnp.ones((n,), jnp.float32)
+
+    hist_fn = jax.jit(
+        lambda b_, g_, h_, m_: leaf_histogram(b_, g_, h_, m_, B, method=gp.hist_method)
+    )
+    hist_s = _time_op(hist_fn, bins, grad, hess, mask)
+    hist = hist_fn(bins, grad, hess, mask)
+
+    # partition proxy: one stable argsort over the full array — the
+    # dominant primitive of the sort-based partition modes
+    keys = (jnp.arange(n, dtype=jnp.int32) % 2).astype(jnp.int8)
+    part_fn = jax.jit(lambda k_: jnp.argsort(k_))
+    part_s = _time_op(part_fn, keys)
+
+    import jax.numpy as _jnp
+
+    pg, ph, pc = (
+        _jnp.asarray(float(hist[:, :, i].sum()) / f, _jnp.float32)
+        for i in range(3)
+    )
+    scan_fn = jax.jit(
+        lambda h_: best_split(
+            h_, pg, ph, pc, booster._num_bins, booster._nan_bins,
+            jnp.ones((f,), bool),
+            lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=gp.min_data_in_leaf,
+            min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0,
+        )
+    )
+    scan_s = _time_op(scan_fn, hist)
+
+    # actual per-tree work from the trained trees
+    def child_count(tree, c):
+        return (
+            int(tree.internal_count[c]) if c >= 0 else int(tree.leaf_count[~c])
+        )
+
+    splits = part_rows = small_rows = 0
+    trees = [t for t in booster.models_ if len(t.internal_count)]
+    for t in trees:
+        nn = len(t.internal_count)
+        splits += nn
+        part_rows += int(t.internal_count.sum())
+        small_rows += sum(
+            min(
+                child_count(t, int(t.left_child[i])),
+                child_count(t, int(t.right_child[i])),
+            )
+            for i in range(nn)
+        )
+    n_trees = max(1, len(trees))
+    splits, part_rows, small_rows = (
+        splits / n_trees, part_rows / n_trees, small_rows / n_trees
+    )
+
+    tree_ms = 1000.0 / iters_per_sec
+    partition_ms = part_s / n * part_rows * 1000.0
+    histogram_ms = hist_s / n * small_rows * 1000.0
+    split_scan_ms = scan_s * 2.0 * splits * 1000.0
+    bookkeeping_ms = max(0.0, tree_ms - partition_ms - histogram_ms - split_scan_ms)
+    return {
+        "tree_ms": round(tree_ms, 1),
+        "partition_ms": round(partition_ms, 1),
+        "histogram_ms": round(histogram_ms, 1),
+        "split_scan_ms": round(split_scan_ms, 1),
+        "bookkeeping_ms": round(bookkeeping_ms, 1),
+        "splits_per_tree": round(splits, 1),
+        "note": "primitive-throughput decomposition (phases share one jit)",
+    }
+
+
+def _leaf_batch_sweep(X, y, timed_iters: int):
+    """iters/sec per leaf_batch K — the frontier-batched grower's headline:
+    K splits per compiled step amortize the fixed per-split program cost."""
+    ks = [
+        int(k)
+        for k in os.environ.get("BENCH_LEAF_BATCH_SWEEP", "1,2,4,8").split(",")
+        if k.strip()
+    ]
+    out = {}
+    for k in ks:
+        ips, _ = _train_bench(
+            X, y, timed_iters, warmup_iters=1,
+            params={**_PARAMS, "leaf_batch": k},
+        )
+        out[str(k)] = round(ips, 4)
+    return out
 
 
 def main() -> None:
@@ -101,6 +228,17 @@ def main() -> None:
     X, y = _make_data(n_rows, n_features)
     iters_per_sec, booster = _train_bench(X, y, timed_iters)
     baseline = 3.8  # reference CPU iters/sec on Higgs (BASELINE.md)
+
+    # phase breakdown BEFORE the predict section replicates models_
+    try:
+        train_phases = _train_phases(booster, iters_per_sec)
+    except Exception as e:  # diagnostics must not sink the headline number
+        train_phases = {"error": repr(e)}
+    sweep_iters = int(os.environ.get("BENCH_SWEEP_ITERS", min(timed_iters, 3)))
+    try:
+        leaf_batch_sweep = _leaf_batch_sweep(X, y, sweep_iters)
+    except Exception as e:
+        leaf_batch_sweep = {"error": repr(e)}
 
     secondary_rows = int(os.environ.get("BENCH_ROWS_SECONDARY", 1_000_000))
     iters_per_sec_secondary = None
@@ -157,6 +295,8 @@ def main() -> None:
         "preds_vs_fork_84k": round(preds_per_sec / 84000.0, 2),
         "pred_warmup_s": round(pred_warmup_dt, 2),
         "pred_phases": pred_phases,
+        "train_phases": train_phases,
+        "leaf_batch_sweep_iters_per_sec": leaf_batch_sweep,
     }
     if iters_per_sec_secondary is not None:
         out[f"iters_per_sec_{secondary_rows}_rows"] = round(

@@ -242,12 +242,7 @@ class Booster:
                     qh,
                     mask,
                     feature_mask,
-                    (
-                        self._next_rng()
-                        if self.config.feature_fraction_bynode < 1.0
-                        or self.config.extra_trees
-                        else None
-                    ),
+                    self._tree_rng(),
                 )
                 ta = self._quant_renew(ta, leaf_id, grad[kk], hess[kk], mask)
                 shrunk = ta.leaf_value * self._shrinkage_rate
@@ -742,6 +737,16 @@ class Booster:
                 if j < len(cfg.monotone_constraints):
                     mc[ci] = cfg.monotone_constraints[j]
             self._monotone = jnp.asarray(mc)
+        # per-feature gain multipliers (reference feature_contri,
+        # feature_histogram.hpp:1445) mapped onto used columns; all-ones is
+        # the identity, so only materialize when some entry differs
+        self._feature_contri = None
+        if cfg.feature_contri and any(v != 1.0 for v in cfg.feature_contri):
+            fc = np.ones(len(used), dtype=np.float32)
+            for ci, j in enumerate(used):
+                if j < len(cfg.feature_contri):
+                    fc[ci] = cfg.feature_contri[j]
+            self._feature_contri = jnp.asarray(fc)
         self._interaction_sets = None
         ic = cfg.interaction_constraints
         sets: List[List[int]] = []
@@ -791,6 +796,11 @@ class Booster:
             self._bundle_end
             if self._bundle_end is not None
             else jnp.full((1, 1), -1, jnp.int32)  # static no-op dummy
+        )
+        self._contri_arg = (
+            self._feature_contri
+            if self._feature_contri is not None
+            else jnp.ones((f_used,), jnp.float32)
         )
 
     def _quant_grow_inputs(self, grad_k, hess_k):
@@ -871,6 +881,7 @@ class Booster:
                 *self._cegb_args(),
                 self._quant_scales_arg(),
                 self._bundle_end_arg,
+                self._contri_arg,
             )
         return grow_tree(
             self._bins,
@@ -888,6 +899,7 @@ class Booster:
             forced=self._forced,
             quant_scales=getattr(self, "_quant_scales", None),
             bundle_end=self._bundle_end,
+            feature_contri=self._feature_contri,
             **(
                 dict(zip(("cegb_penalty", "cegb_used"), self._cegb_args()))
                 if self._cegb_coupled is not None
@@ -1086,6 +1098,37 @@ class Booster:
                 else ("gather" if self._featpar else "ordered"),
             )
         )
+        # frontier batching scope: modes whose per-split state is not
+        # member-local keep the serial loop (grow_tree raises on these at
+        # K > 1; downgrade here with a warning instead)
+        leaf_k = max(1, int(cfg.leaf_batch))
+        if leaf_k > 1:
+            inter_mono = (
+                self._monotone is not None
+                and cfg.monotone_constraints_method
+                in ("intermediate", "advanced")
+            )
+            blockers = [
+                (cfg.tree_learner == "voting" and self._mesh is not None,
+                 "tree_learner='voting'"),
+                (bool(self._featpar), "feature-parallel training"),
+                (self._cegb_coupled is not None, "CEGB feature penalties"),
+                (inter_mono,
+                 "monotone_constraints_method='intermediate'/'advanced'"),
+                (self._interaction_sets is not None,
+                 "interaction_constraints"),
+            ]
+            why = [what for bad, what in blockers if bad]
+            if why:
+                from ..utils.log import log_warning
+
+                log_warning(
+                    "leaf_batch > 1 does not support "
+                    + ", ".join(why)
+                    + "; falling back to serial (leaf_batch=1) growth"
+                )
+                leaf_k = 1
+        leaf_k = min(leaf_k, max(1, cfg.num_leaves - 1))
         return GrowerParams(
             num_leaves=cfg.num_leaves,
             max_bin=self._max_bin_padded,
@@ -1128,6 +1171,9 @@ class Booster:
             cegb_split_penalty=cfg.cegb_tradeoff * cfg.cegb_penalty_split,
             fused_split_scan=cfg.fused_split_scan,
             use_bundle=self._has_bundle,
+            leaf_batch=leaf_k,
+            monotone_penalty=cfg.monotone_penalty,
+            use_feature_contri=self._feature_contri is not None,
         )
 
     def _fit_linear_leaves(
@@ -1278,6 +1324,31 @@ class Booster:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
+    def _tree_rng(self):
+        """Per-tree RNG for feature_fraction_bynode / extra_trees draws.
+
+        An EXPLICIT extra_seed (present in the raw params, reference
+        config.h extra_seed) folds into the stream so changing it changes
+        the extra-trees thresholds; unset, the stream is untouched and
+        training stays byte-identical to the pre-wiring behavior."""
+        cfg = self.config
+        if not (cfg.feature_fraction_bynode < 1.0 or cfg.extra_trees):
+            return None
+        rng = self._next_rng()
+        if cfg.extra_trees and "extra_seed" in cfg.raw:
+            rng = jax.random.fold_in(rng, cfg.extra_seed)
+        return rng
+
+    def _bagging_rng(self) -> jax.Array:
+        """Row-sampling RNG; an EXPLICIT bagging_seed folds in (reference
+        config.h bagging_seed — a distinct deterministic bagging stream),
+        unset keeps the historical stream byte-identical."""
+        rng = self._next_rng()
+        cfg = self.config
+        if "bagging_seed" in cfg.raw:
+            rng = jax.random.fold_in(rng, cfg.bagging_seed)
+        return rng
+
     @staticmethod
     def _rec_cat_args(rec):
         """(split_is_cat, cat_mask) device args for a bin record; records
@@ -1365,7 +1436,7 @@ class Booster:
             grad = jnp.where(live, grad, 0.0)
             hess = jnp.where(live, hess, 0.0)
         mask, grad, hess = self._sampler.sample(
-            self._iter, grad, hess, self._next_rng()
+            self._iter, grad, hess, self._bagging_rng()
         )
         if any_pad:
             mask = mask * self._ones_mask
@@ -1458,12 +1529,7 @@ class Booster:
                     qh,
                     mask,
                     feature_mask,
-                    (
-                        self._next_rng()
-                        if self.config.feature_fraction_bynode < 1.0
-                        or self.config.extra_trees
-                        else None
-                    ),
+                    self._tree_rng(),
                 )
                 ta = self._quant_renew(ta, leaf_id, grad[kk], hess[kk], mask)
                 # two bulk transfers instead of ~14 small ones (remote TPU

@@ -53,7 +53,7 @@ class RFBooster(Booster):
         cfg = self.config
         k = self.num_tree_per_iteration
         mask, grad, hess = self._sampler.sample(
-            self._iter, self._rf_grad, self._rf_hess, self._next_rng()
+            self._iter, self._rf_grad, self._rf_hess, self._bagging_rng()
         )
         feature_mask = self._feature_mask_for_iter()
 

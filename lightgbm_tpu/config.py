@@ -309,6 +309,11 @@ class Config:
     # basic numeric path (targets the per-split fixed cost; default off
     # pending on-chip measurement — see ops/pallas/split_scan.py)
     fused_split_scan: bool = False
+    # TPU extension: frontier-batched growth — split up to this many leaves
+    # per compiled loop step (amortizes the per-split fixed program cost;
+    # exact via the prefix-commit rule, see ops/grower.py).  1 = serial,
+    # byte-identical to the unbatched grower.
+    leaf_batch: int = 1
     early_stopping_round: int = 0
     early_stopping_min_delta: float = 0.0
     first_metric_only: bool = False
@@ -518,6 +523,8 @@ class Config:
             raise ValueError("num_leaves must be >= 2")
         if self.max_bin < 2:
             raise ValueError("max_bin must be >= 2")
+        if self.leaf_batch < 1:
+            raise ValueError("leaf_batch must be >= 1")
         if self.bagging_freq > 0 and (self.pos_bagging_fraction < 1.0 or self.neg_bagging_fraction < 1.0):
             if self.objective != "binary":
                 raise ValueError("pos/neg bagging fractions require binary objective")

@@ -121,6 +121,22 @@ class GrowerParams:
     use_interaction: bool = False  # interaction_constraints
     feature_fraction_bynode: float = 1.0
     extra_trees: bool = False  # one random threshold per feature (USE_RAND)
+    # frontier batching: split the top-K frontier leaves per compiled loop
+    # step (K partitions over disjoint windows, one batched smaller-child
+    # histogram pass, 2K candidate refreshes in one scan, and ONE psum per
+    # collective kind under data-parallel).  Exactness by the prefix-commit
+    # rule: with batch gains g1 >= ... >= gK, commit exactly the longest
+    # prefix whose gi beats the running max gain of children created by
+    # earlier batch members; uncommitted members are value-preserving no-ops
+    # and their leaves stay in the frontier — the committed split sequence
+    # is identical to serial leaf-wise growth.  1 = the serial fori_loop,
+    # byte-identical to the pre-batching grower.
+    leaf_batch: int = 1
+    # depth-scaled split-gain penalty on monotone features (reference
+    # ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:357)
+    monotone_penalty: float = 0.0
+    # per-feature gain multipliers arrive via the feature_contri operand
+    use_feature_contri: bool = False
 
 
 def _hist_caps(n: int, full_range: bool = False) -> list:
@@ -316,6 +332,7 @@ def _candidate_for_leaf(
     hist, g, h, c, num_bins, nan_bins, feature_mask, p: GrowerParams,
     monotone=None, lb=None, ub=None, parent_output=0.0, is_cat=None,
     cegb_penalty=None, rand_bins=None, adv=None, bundle_end=None,
+    depth=None, feature_contri=None,
 ):
     """Best split for one leaf.  ``hist`` is the GLOBAL (psummed) histogram
     normally; under voting-parallel it is the LOCAL histogram and only the
@@ -354,8 +371,10 @@ def _candidate_for_leaf(
                 min_data_in_leaf=p.min_data_in_leaf,
                 min_sum_hessian_in_leaf=p.min_sum_hessian_in_leaf,
                 min_gain_to_split=p.min_gain_to_split,
+                feature_contri=feature_contri,
                 interpret=not on_tpu,
             )
+    use_mono_pen = monotone is not None and p.monotone_penalty > 0.0
     common = dict(
         lambda_l1=p.lambda_l1,
         lambda_l2=p.lambda_l2,
@@ -369,6 +388,8 @@ def _candidate_for_leaf(
         parent_output=parent_output,
         cat_params=p.cat_params,
         cegb_split_penalty=p.cegb_split_penalty if p.use_cegb else 0.0,
+        monotone_penalty=p.monotone_penalty if use_mono_pen else 0.0,
+        leaf_depth=depth if use_mono_pen else None,
     )
     if not voting_active(p, f):
         return best_split(
@@ -379,6 +400,7 @@ def _candidate_for_leaf(
             rand_bins=rand_bins if p.extra_trees else None,
             adv_bounds=adv,
             bundle_end=bundle_end,
+            feature_contri=feature_contri,
             **common,
         )
     # ---- PV-Tree election.  1) local per-feature best gains from the LOCAL
@@ -392,6 +414,7 @@ def _candidate_for_leaf(
         cegb_penalty=cegb_penalty if p.use_cegb else None,
         rand_bins=rand_bins if p.extra_trees else None,
         adv_bounds=adv,
+        feature_contri=feature_contri,
         per_feature_gains=True,
         **common,
     )
@@ -424,6 +447,9 @@ def _candidate_for_leaf(
         ),
         adv_bounds=(
             tuple(a[ids] for a in adv) if adv is not None else None
+        ),
+        feature_contri=(
+            feature_contri[ids] if feature_contri is not None else None
         ),
         **common,
     )
@@ -547,6 +573,7 @@ def grow_tree(
     quant_scales=None,  # (g_scale, h_scale) for hist_method='pallas_int8'
     bundle_end: Optional[jnp.ndarray] = None,  # [F, B] i32 — EFB sub-range
     #   ends per plane bin (bundling.py / ops/split.py), -1 off-bundle
+    feature_contri: Optional[jnp.ndarray] = None,  # [F] f32 gain multipliers
 ):
     """Grow one tree. Returns (TreeArrays, leaf_id[N])."""
     p = params
@@ -568,6 +595,10 @@ def grow_tree(
             (p.use_cegb, "CEGB feature penalties"),
             (p.feature_shard > 1, "feature-parallel training"),
             (voting_active(p, bins.shape[1]), "voting-parallel training"),
+            # bundle planes merge several features; a per-feature gain
+            # multiplier has no well-defined plane-level analog
+            (p.use_feature_contri and feature_contri is not None,
+             "feature_contri"),
         ]
         for bad, what in incompatible:
             if bad:
@@ -601,6 +632,17 @@ def grow_tree(
     Bm = B if (use_cat or use_bundle) else 1
     is_cat_arr = is_cat if use_cat else None
     use_cegb = p.use_cegb and cegb_penalty is not None
+    # per-feature gain multipliers (reference feature_contri /
+    # feature_histogram.hpp:1445 — scales the IMPROVEMENT before the
+    # cross-feature argmax)
+    fc_arr = (
+        feature_contri if (p.use_feature_contri and feature_contri is not None)
+        else None
+    )
+    # monotone_penalty needs the splitting leaf's depth threaded into the scan
+    use_mono_pen = (
+        p.use_monotone and monotone is not None and p.monotone_penalty > 0.0
+    )
 
     def _cegb_pen(used_mask):
         # coupled penalty only until the feature is first used in the MODEL
@@ -716,8 +758,29 @@ def grow_tree(
     # feature-parallel) — used by the full-mode and root histograms
     bins_loc = _fslice(bins, axis=1) if f > 0 else bins
 
+    # frontier batching scope: modes whose per-split bookkeeping is not
+    # member-local (election/ownership state, cross-leaf bound propagation,
+    # model-level CEGB purchases, path-dependent allowed-feature sets) keep
+    # the serial loop.  boosting/gbdt.py clamps leaf_batch to 1 with a
+    # warning before it gets here; a direct grow_tree caller gets the raise.
+    leaf_k = max(1, min(p.leaf_batch, L - 1))
+    if leaf_k > 1:
+        unsupported = [
+            (use_voting, "voting-parallel training"),
+            (use_featpar, "feature-parallel training"),
+            (use_cegb, "CEGB feature penalties"),
+            (use_inter_mono, "intermediate/advanced monotone constraints"),
+            (p.use_interaction and interaction_sets is not None,
+             "interaction constraints"),
+        ]
+        for bad, what in unsupported:
+            if bad:
+                raise ValueError(
+                    f"leaf_batch > 1 does not support {what}; set leaf_batch=1"
+                )
+
     def cand_for_leaf(hist, g, h, c, fm, lb=None, ub=None, pout=0.0,
-                      rand=None, cpen=None, adv=None):
+                      rand=None, cpen=None, adv=None, depth=None):
         """Leaf candidate with the distributed-mode plumbing: per-feature
         operand slicing + winner all-reduce under feature-parallel; voting
         election happens inside _candidate_for_leaf."""
@@ -726,7 +789,8 @@ def grow_tree(
                 hist, g, h, c, num_bins, nan_bins, fm, p,
                 monotone=mono_arr, lb=lb, ub=ub, parent_output=pout,
                 is_cat=is_cat_arr, cegb_penalty=cpen, rand_bins=rand,
-                adv=adv, bundle_end=bundle_end,
+                adv=adv, bundle_end=bundle_end, depth=depth,
+                feature_contri=fc_arr,
             )
         cand = _candidate_for_leaf(
             hist, g, h, c, _fslice(num_bins), _fslice(nan_bins),
@@ -737,6 +801,8 @@ def grow_tree(
             cegb_penalty=_fslice(cpen) if cpen is not None else None,
             rand_bins=_fslice(rand) if rand is not None else None,
             adv=tuple(_fslice(a) for a in adv) if adv is not None else None,
+            depth=depth,
+            feature_contri=_fslice(fc_arr) if fc_arr is not None else None,
         )
         return _featpar_reduce(cand)
 
@@ -746,9 +812,15 @@ def grow_tree(
             pack_rows,
             padded_rows,
             seg_hist,
+            seg_hist_batch,
             stat_lanes,
         )
-        from .segpart import leaf_id_from_seg, leaf_of_positions, sort_partition
+        from .segpart import (
+            leaf_id_from_seg,
+            leaf_of_positions,
+            sort_partition,
+            sort_partition_batch,
+        )
 
         # bins byte-pack two features per i16 plane up to max_bin 256; wider
         # bin spaces use one u16 plane per feature (the reference's
@@ -837,6 +909,32 @@ def grow_tree(
 
         hist_branches = [_make_hist_branch(c) for c in caps]
 
+        if leaf_k > 1:
+            # frontier batching: each member compacts into ITS OWN capacity
+            # bucket (pmax'd under data-parallel so every shard lowers the
+            # same branch per member) — a shared max-over-members bucket was
+            # measured 15% slower at the 1M-row bench shape because every
+            # member paid the largest window's gather.  The inner histograms
+            # run with axis_name=None and the [K, F, B, 3] stack psums ONCE
+            # outside.
+            def _make_hist_branch_loc(cap: int):
+                def branch(member):  # [N] bool
+                    (idx,) = jnp.nonzero(member, size=cap, fill_value=n)
+                    return leaf_histogram(
+                        bins_pad_loc[idx],
+                        grad_pad[idx],
+                        hess_pad[idx],
+                        mask_pad[idx],
+                        B,
+                        method=p.hist_method,
+                        axis_name=None,
+                        quant_scales=quant_scales,
+                    )
+
+                return branch
+
+            hist_branches_loc = [_make_hist_branch_loc(c) for c in caps]
+
     # transposed copy for contiguous per-feature column reads in the
     # partition step (bins is row-major; a column gather is strided)
     bins_t_cols = bins.T if f > 0 else bins.reshape(f, n)
@@ -907,6 +1005,35 @@ def grow_tree(
 
         hist_branches_ordered = [_make_hist_branch_ordered(c) for c in caps]
 
+        if leaf_k > 1:
+            # batched analog: one (start, cnt) window at a time, each in ITS
+            # OWN capacity bucket (per-member row counts are pmax'd under
+            # data-parallel, so shards agree per member), inner hists local
+            # (one stacked psum happens outside)
+            def _make_hist_branch_ordered_loc(C: int):
+                def branch(op):
+                    order, start, child_cnt = op
+                    cidx = lax.dynamic_slice(order, (start,), (C,))
+                    vmask = (
+                        jnp.arange(C, dtype=jnp.int32) < child_cnt
+                    ).astype(count_mask.dtype)
+                    return leaf_histogram(
+                        bins_pad[cidx],
+                        grad_pad[cidx],
+                        hess_pad[cidx],
+                        mask_pad[cidx] * vmask,
+                        B,
+                        method=p.hist_method,
+                        axis_name=None,
+                        quant_scales=quant_scales,
+                    )
+
+                return branch
+
+            hist_branches_ordered_loc = [
+                _make_hist_branch_ordered_loc(c) for c in caps
+            ]
+
     cegb_used0 = (
         cegb_used
         if (use_cegb and cegb_used is not None)
@@ -942,6 +1069,7 @@ def grow_tree(
         pout=leaf_output(totals[0], totals[1], p.lambda_l1, p.lambda_l2, p.max_delta_step),
         cpen=_cegb_pen(cegb_used0),
         rand=node_rand_bins(0),
+        depth=jnp.asarray(0, jnp.int32) if use_mono_pen else None,
     )
 
     neg_inf = jnp.full((L,), -jnp.inf, dtype=jnp.float32)
@@ -1582,10 +1710,15 @@ def grow_tree(
         use_rand = p.extra_trees and rng is not None
         if use_rand:
             opt2 += [jax.vmap(node_rand_bins)(seeds2)]
+        if use_mono_pen:
+            depth2 = jnp.stack([d_new, d_new])
+            if use_inter_mono:
+                depth2 = jnp.concatenate([depth2, leaf_depth[inter_idxs]])
+            opt2 += [depth2]
         cpen = _cegb_pen(cegb_used_new)
 
         def _child_cand(hist, g_, h_, c_, fm, po, *rest):
-            lbv = ubv = rbv = advv = None
+            lbv = ubv = rbv = advv = dv = None
             i = 0
             if use_mono:
                 lbv, ubv = rest[0], rest[1]
@@ -1595,9 +1728,13 @@ def grow_tree(
                 i += 4
             if use_rand:
                 rbv = rest[i]
+                i += 1
+            if use_mono_pen:
+                dv = rest[i]
             return cand_for_leaf(
                 hist, g_, h_, c_, fm,
                 lb=lbv, ub=ubv, pout=po, cpen=cpen, rand=rbv, adv=advv,
+                depth=dv,
             )
 
         cand2 = jax.vmap(_child_cand)(hist2, g2, h2, c2, fm2, po2, *opt2)
@@ -1661,8 +1798,523 @@ def grow_tree(
             cegb_used=cegb_used_new,
         )
 
+    def body_batched(st: _State) -> _State:
+        """One frontier-batched step: split up to ``leaf_k`` leaves.
+
+        The top-K frontier leaves by cached gain are partitioned over their
+        DISJOINT row windows, the K smaller children are histogrammed in one
+        batched pass (one [K, 2] count psum + one [K, F, B, 3] histogram
+        psum under data-parallel), and all 2K child candidates refresh in
+        one vmapped scan.  Exactness by the prefix-commit rule: member i
+        commits iff every earlier member committed AND its gain strictly
+        exceeds the best child gain any earlier member created — exactly
+        when the serial argmax would have picked leaf i next.  Uncommitted
+        members only reordered rows WITHIN their leaf's window (membership
+        unchanged) and are value-preserving no-ops everywhere else; their
+        leaves stay in the frontier for the next step.  Member 0 is the
+        plain argmax, so every step with a positive best gain commits at
+        least one split and the while loop terminates.  All commit
+        decisions derive from psummed quantities, so every data-parallel
+        shard runs the identical trip count."""
+        K = leaf_k
+        iota_k = jnp.arange(K, dtype=jnp.int32)
+        base = st.num_leaves - 1  # node id taken by batch member 0
+        t_k = base + iota_k  # node id per member under the prefix rule
+        nl_k = t_k + 1  # new leaf index per member
+        gains_k, l_k = lax.top_k(st.cand.gain, K)
+        l_k = l_k.astype(jnp.int32)
+
+        # ---- forced phase: commit exactly ONE (member 0) split per step so
+        # the host-precomputed forced leaf numbering stays valid; a failed
+        # forced split aborts the rest (abort_last_forced_split) and the
+        # whole batch resumes normal growth the same step
+        if use_forced_splits:
+            f_leaf_a, f_feat_a, f_bin_a, f_iscat_a = forced
+            tf = jnp.clip(base, 0, p.n_forced - 1)
+            is_f_step = (base < p.n_forced) & st.forced_ok
+            f_leaf = f_leaf_a[tf]
+            f_feat = f_feat_a[tf]
+            f_bin = f_bin_a[tf]
+            f_iscat = f_iscat_a[tf]
+            hrow = st.hist_buf[f_leaf, f_feat]  # [B, 3] (voting raises @K>1)
+            nbv = nan_bins[f_feat]
+            has_nb = nbv >= 0
+            nan_s = jnp.where(has_nb, hrow[jnp.maximum(nbv, 0)], 0.0)
+            brow_ids = jnp.arange(B, dtype=jnp.int32)
+            hrow_o = jnp.where(
+                ((brow_ids == nbv) & has_nb)[:, None], 0.0, hrow
+            )
+            cumr = jnp.cumsum(hrow_o, axis=0)
+            fpg, fph, fpc = (
+                st.leaf_g[f_leaf],
+                st.leaf_h[f_leaf],
+                st.leaf_cnt[f_leaf],
+            )
+            f_left = jnp.where(f_iscat, hrow[f_bin], cumr[f_bin] + nan_s)
+            f_lg, f_lh, f_lc = f_left[0], f_left[1], f_left[2]
+            f_rg, f_rh, f_rc = fpg - f_lg, fph - f_lh, fpc - f_lc
+            f_raw = leaf_gain(f_lg, f_lh, p.lambda_l1, p.lambda_l2) + leaf_gain(
+                f_rg, f_rh, p.lambda_l1, p.lambda_l2
+            )
+            f_gain = (
+                f_raw
+                - leaf_gain(fpg, fph, p.lambda_l1, p.lambda_l2)
+                - p.min_gain_to_split
+            )
+            use_forced = is_f_step & (f_gain > 0)
+            forced_ok_next = st.forced_ok & (~is_f_step | use_forced)
+            l_k = l_k.at[0].set(jnp.where(use_forced, f_leaf, l_k[0]))
+            gains_k = gains_k.at[0].set(
+                jnp.where(use_forced, f_gain, gains_k[0])
+            )
+            forced_mask_k = jnp.where(
+                use_forced, iota_k == 0, jnp.ones((K,), bool)
+            )
+        else:
+            use_forced = None
+            forced_ok_next = st.forced_ok
+            forced_mask_k = jnp.ones((K,), bool)
+
+        c_gain_k = gains_k
+        c_feat_k = st.cand.feature[l_k]
+        c_bin_k = st.cand.bin[l_k]
+        c_dl_k = st.cand.default_left[l_k]
+        c_cis_k = st.cand.is_cat[l_k]
+        c_cmask_k = st.cand.cat_mask[l_k]  # [K, Bm]
+        c_lg_k = st.cand.left_g[l_k]
+        c_lh_k = st.cand.left_h[l_k]
+        c_lc_k = st.cand.left_cnt[l_k]
+        c_rg_k = st.cand.right_g[l_k]
+        c_rh_k = st.cand.right_h[l_k]
+        c_rc_k = st.cand.right_cnt[l_k]
+        if use_forced_splits:
+            def _f0(arr, val):
+                return arr.at[0].set(jnp.where(use_forced, val, arr[0]))
+
+            c_feat_k = _f0(c_feat_k, f_feat)
+            c_bin_k = _f0(c_bin_k, f_bin)
+            c_dl_k = _f0(c_dl_k, ~f_iscat)
+            c_cis_k = _f0(c_cis_k, f_iscat)
+            if use_cat:
+                oh = jnp.arange(Bm, dtype=jnp.int32) == f_bin
+                c_cmask_k = _f0(c_cmask_k, oh)
+            c_lg_k = _f0(c_lg_k, f_lg)
+            c_lh_k = _f0(c_lh_k, f_lh)
+            c_lc_k = _f0(c_lc_k, f_lc)
+            c_rg_k = _f0(c_rg_k, f_rg)
+            c_rh_k = _f0(c_rh_k, f_rh)
+            c_rc_k = _f0(c_rc_k, f_rc)
+
+        pos_k = c_gain_k > 0.0
+        # node ids are committed as a prefix, so member i's slot is statically
+        # base + i; members past the node budget cannot commit
+        room_k = t_k < (L - 1)
+        active_k = pos_k & room_k & forced_mask_k & ~st.done
+        done = st.done | ~pos_k[0]
+
+        # ---- K partitions over disjoint windows + ONE batched smaller-child
+        # histogram pass (speculative for members that end up uncommitted:
+        # rows only move WITHIN their leaf's window, so nothing leaks)
+        in_leaf_k = go_left_k = None
+        if use_seg:
+            begin_k = st.leaf_begin[l_k]
+            cnt_k = jnp.where(active_k, st.leaf_nrows[l_k], 0)
+            order, nleft_k, nright_k = sort_partition_batch(
+                st.order,
+                begin_k,
+                cnt_k,
+                c_feat_k,
+                c_bin_k,
+                c_dl_k.astype(jnp.int32),
+                nan_bins[c_feat_k],
+                c_cis_k.astype(jnp.int32),
+                c_cmask_k.astype(jnp.float32),
+                f=f_seg,
+                n_pad=n_pad_seg,
+                wide=seg_wide,
+            )
+            if p.axis_name is not None:
+                cnts_g = lax.psum(
+                    jnp.stack([nleft_k, nright_k], axis=1), p.axis_name
+                )
+                left_smaller_k = cnts_g[:, 0] <= cnts_g[:, 1]
+            else:
+                left_smaller_k = nleft_k <= nright_k
+            child_start_k = begin_k + jnp.where(left_smaller_k, 0, nleft_k)
+            child_cnt_k = jnp.where(left_smaller_k, nleft_k, nright_k)
+            sm_k = seg_hist_batch(
+                order,
+                jnp.stack([child_start_k, child_cnt_k], axis=1).astype(
+                    jnp.int32
+                ),
+                f=f_seg,
+                num_bins=B,
+                n_pad=n_pad_seg,
+                quant_scales=seg_qs,
+                wide=seg_wide,
+            )
+            if hist_axis is not None:
+                sm_k = lax.psum(sm_k, hist_axis)
+        elif use_ordered:
+            begin_k = st.leaf_begin[l_k]
+            cnt_k = jnp.where(active_k, st.leaf_nrows[l_k], 0)
+            order = st.order
+            nleft_list = []
+            for i in range(K):
+                pbucket_i = jnp.clip(
+                    jnp.searchsorted(pcaps_arr, cnt_k[i], side="left"),
+                    0,
+                    len(pcaps) - 1,
+                ).astype(jnp.int32)
+                order, nleft_i = lax.switch(
+                    pbucket_i,
+                    part_branches,
+                    (order, begin_k[i], cnt_k[i], c_feat_k[i], c_bin_k[i],
+                     c_dl_k[i], c_cis_k[i], c_cmask_k[i]),
+                )
+                nleft_list.append(nleft_i)
+            nleft_k = jnp.stack(nleft_list)
+            nright_k = cnt_k - nleft_k
+            if p.axis_name is not None:
+                cnts_g = lax.psum(
+                    jnp.stack([nleft_k, nright_k], axis=1), p.axis_name
+                )
+                left_smaller_k = cnts_g[:, 0] <= cnts_g[:, 1]
+                tc_k = lax.pmax(
+                    jnp.where(left_smaller_k, nleft_k, nright_k), p.axis_name
+                )
+            else:
+                left_smaller_k = nleft_k <= nright_k
+                tc_k = jnp.minimum(nleft_k, nright_k)
+            child_start_k = begin_k + jnp.where(left_smaller_k, 0, nleft_k)
+            child_cnt_k = jnp.where(left_smaller_k, nleft_k, nright_k)
+            sm_list = []
+            for i in range(K):
+                cbucket_i = jnp.clip(
+                    jnp.searchsorted(caps_arr, tc_k[i], side="left"),
+                    0,
+                    len(caps) - 1,
+                ).astype(jnp.int32)
+                sm_list.append(
+                    lax.switch(
+                        cbucket_i,
+                        hist_branches_ordered_loc,
+                        (order, child_start_k[i], child_cnt_k[i]),
+                    )
+                )
+            sm_k = jnp.stack(sm_list)
+            if hist_axis is not None:
+                sm_k = lax.psum(sm_k, hist_axis)
+        else:
+            # gather / full: row membership per member, leaf_id writes
+            # deferred to the commit decision below
+            order = st.order
+            begin_k = jnp.zeros((K,), jnp.int32)
+            nleft_k = nright_k = jnp.zeros((K,), jnp.int32)
+            gl_rows, in_rows = [], []
+            for i in range(K):
+                col = lax.dynamic_slice_in_dim(
+                    bins_t_cols, c_feat_k[i], 1, axis=0
+                )[0]
+                nb = nan_bins[c_feat_k[i]]
+                gli = (col <= c_bin_k[i]) | (
+                    c_dl_k[i] & (nb >= 0) & (col == nb)
+                )
+                if use_cat or use_bundle:
+                    gli = jnp.where(
+                        c_cis_k[i], c_cmask_k[i][jnp.minimum(col, Bm - 1)], gli
+                    )
+                gl_rows.append(gli)
+                in_rows.append((st.leaf_id == l_k[i]) & active_k[i])
+            go_left_k = jnp.stack(gl_rows)  # [K, N]
+            in_leaf_k = jnp.stack(in_rows)
+            if use_gather:
+                rows_l_k = jnp.sum(in_leaf_k & go_left_k, axis=1).astype(
+                    jnp.int32
+                )
+                rows_r_k = (
+                    jnp.sum(in_leaf_k, axis=1).astype(jnp.int32) - rows_l_k
+                )
+                if p.axis_name is not None:
+                    cnts_g = lax.psum(
+                        jnp.stack([rows_l_k, rows_r_k], axis=1), p.axis_name
+                    )
+                    left_smaller_k = cnts_g[:, 0] <= cnts_g[:, 1]
+                    tc_k = lax.pmax(
+                        jnp.where(left_smaller_k, rows_l_k, rows_r_k),
+                        p.axis_name,
+                    )
+                else:
+                    left_smaller_k = rows_l_k <= rows_r_k
+                    tc_k = jnp.minimum(rows_l_k, rows_r_k)
+                member_k = in_leaf_k & jnp.where(
+                    left_smaller_k[:, None], go_left_k, ~go_left_k
+                )
+                sm_list = []
+                for i in range(K):
+                    bucket_i = jnp.clip(
+                        jnp.searchsorted(caps_arr, tc_k[i], side="left"),
+                        0,
+                        len(caps) - 1,
+                    ).astype(jnp.int32)
+                    sm_list.append(
+                        lax.switch(bucket_i, hist_branches_loc, member_k[i])
+                    )
+                sm_k = jnp.stack(sm_list)
+            else:
+                left_smaller_k = c_lc_k <= c_rc_k
+                member_k = in_leaf_k & jnp.where(
+                    left_smaller_k[:, None], go_left_k, ~go_left_k
+                )
+                mask_k = count_mask[None, :] * member_k
+                sm_k = jax.vmap(
+                    lambda m: leaf_histogram(
+                        bins_loc, grad, hess, m, B,
+                        method=p.hist_method,
+                        axis_name=None,
+                        quant_scales=quant_scales,
+                    )
+                )(mask_k)
+            if hist_axis is not None:
+                sm_k = lax.psum(sm_k, hist_axis)
+
+        # ---- sibling histograms by subtraction, per pair
+        parent_hist_k = st.hist_buf[l_k]  # [K, f_loc, B, 3]
+        other_k = parent_hist_k - sm_k
+        ls4 = left_smaller_k[:, None, None, None]
+        left_hist_k = jnp.where(ls4, sm_k, other_k)
+        right_hist_k = jnp.where(ls4, other_k, sm_k)
+
+        lg_k, lh_k, lc_k = c_lg_k, c_lh_k, c_lc_k
+        rg_k, rh_k, rc_k = c_rg_k, c_rh_k, c_rc_k
+
+        # basic monotone bounds are member-local: each member reads only its
+        # OWN parent's interval, which no other batch member writes
+        if use_mono:
+            mc_f_k = mono_arr[c_feat_k]
+            lb_par_k = st.leaf_lb[l_k]
+            ub_par_k = st.leaf_ub[l_k]
+            out_l_c = jnp.clip(
+                leaf_output(
+                    lg_k, lh_k, p.lambda_l1, p.lambda_l2, p.max_delta_step
+                ),
+                lb_par_k, ub_par_k,
+            )
+            out_r_c = jnp.clip(
+                leaf_output(
+                    rg_k, rh_k, p.lambda_l1, p.lambda_l2, p.max_delta_step
+                ),
+                lb_par_k, ub_par_k,
+            )
+            mid_k = 0.5 * (out_l_c + out_r_c)
+            lb_l_k = jnp.where(mc_f_k < 0, mid_k, lb_par_k)
+            ub_l_k = jnp.where(mc_f_k > 0, mid_k, ub_par_k)
+            lb_r_k = jnp.where(mc_f_k > 0, mid_k, lb_par_k)
+            ub_r_k = jnp.where(mc_f_k < 0, mid_k, ub_par_k)
+
+        d_new_k = st.leaf_depth[l_k] + 1
+
+        # ---- refresh all 2K child candidates in ONE vmapped scan
+        hist2 = jnp.concatenate([left_hist_k, right_hist_k])
+        g2 = jnp.concatenate([lg_k, rg_k])
+        h2 = jnp.concatenate([lh_k, rh_k])
+        c2 = jnp.concatenate([lc_k, rc_k])
+        seeds2 = jnp.concatenate([2 * t_k + 1, 2 * t_k + 2])
+        fm2 = jax.vmap(lambda s: node_feature_mask(s, root_used))(seeds2)
+        po2 = leaf_output(g2, h2, p.lambda_l1, p.lambda_l2, p.max_delta_step)
+        opt2 = []
+        if use_mono:
+            opt2 += [
+                jnp.concatenate([lb_l_k, lb_r_k]),
+                jnp.concatenate([ub_l_k, ub_r_k]),
+            ]
+        use_rand = p.extra_trees and rng is not None
+        if use_rand:
+            opt2 += [jax.vmap(node_rand_bins)(seeds2)]
+        if use_mono_pen:
+            opt2 += [jnp.concatenate([d_new_k, d_new_k])]
+
+        def _child_cand_b(hist, g_, h_, c_, fm, po, *rest):
+            lbv = ubv = rbv = dv = None
+            i = 0
+            if use_mono:
+                lbv, ubv = rest[0], rest[1]
+                i = 2
+            if use_rand:
+                rbv = rest[i]
+                i += 1
+            if use_mono_pen:
+                dv = rest[i]
+            return cand_for_leaf(
+                hist, g_, h_, c_, fm,
+                lb=lbv, ub=ubv, pout=po, rand=rbv, depth=dv,
+            )
+
+        cand2 = jax.vmap(_child_cand_b)(hist2, g2, h2, c2, fm2, po2, *opt2)
+        depth_ok_k = (p.max_depth <= 0) | (d_new_k < p.max_depth)
+        gain_l_k = jnp.where(depth_ok_k, cand2.gain[:K], -jnp.inf)
+        gain_r_k = jnp.where(depth_ok_k, cand2.gain[K:], -jnp.inf)
+        child_best_k = jnp.maximum(gain_l_k, gain_r_k)
+
+        # ---- prefix-commit: member i's gain must STRICTLY beat the best
+        # child gain created by earlier members (a tie defers to the next
+        # step, where the serial argmax tie-break applies natively), and all
+        # earlier members must themselves have committed
+        prev_max = lax.cummax(
+            jnp.concatenate(
+                [jnp.full((1,), -jnp.inf, jnp.float32), child_best_k[:-1]]
+            )
+        )
+        ok_k = pos_k & room_k & forced_mask_k & (c_gain_k > prev_max)
+        commit_k = lax.associative_scan(jnp.logical_and, ok_k) & ~st.done
+
+        # ---- commit the prefix: value-preserving writes per member (node
+        # ids t_i = base + i are disjoint, as are the members' leaf rows)
+        def _setb(arr, idx, val, ok):
+            return arr.at[idx].set(jnp.where(ok, val, arr[idx]))
+
+        left_child = st.left_child
+        right_child = st.right_child
+        split_feature = st.split_feature
+        split_bin = st.split_bin
+        split_gain = st.split_gain
+        default_left = st.default_left
+        split_is_cat = st.split_is_cat
+        node_cat_mask = st.node_cat_mask
+        internal_value = st.internal_value
+        internal_weight = st.internal_weight
+        internal_count = st.internal_count
+        leaf_g = st.leaf_g
+        leaf_h = st.leaf_h
+        leaf_cnt = st.leaf_cnt
+        leaf_depth = st.leaf_depth
+        leaf_parent = st.leaf_parent
+        leaf_is_right = st.leaf_is_right
+        leaf_lb, leaf_ub = st.leaf_lb, st.leaf_ub
+        hist_buf = st.hist_buf
+        cand = st.cand
+        leaf_begin, leaf_nrows = st.leaf_begin, st.leaf_nrows
+        leaf_id = st.leaf_id
+        for i in range(K):
+            ok = commit_k[i]
+            t_i, l_i, nl_i = t_k[i], l_k[i], nl_k[i]
+            left_child = _setb(left_child, t_i, -(l_i + 1), ok)
+            right_child = _setb(right_child, t_i, -(nl_i + 1), ok)
+            par = st.leaf_parent[l_i]  # no member writes another's leaf row
+            is_r = st.leaf_is_right[l_i]
+            fix = (node_ids == par) & (par >= 0) & ok
+            left_child = jnp.where(fix & ~is_r, t_i, left_child)
+            right_child = jnp.where(fix & is_r, t_i, right_child)
+            split_feature = _setb(split_feature, t_i, c_feat_k[i], ok)
+            split_bin = _setb(split_bin, t_i, c_bin_k[i], ok)
+            split_gain = _setb(
+                split_gain, t_i, c_gain_k[i] + p.min_gain_to_split, ok
+            )
+            default_left = _setb(default_left, t_i, c_dl_k[i], ok)
+            split_is_cat = _setb(split_is_cat, t_i, c_cis_k[i], ok)
+            node_cat_mask = _setb(node_cat_mask, t_i, c_cmask_k[i], ok)
+            pg, ph, pc = st.leaf_g[l_i], st.leaf_h[l_i], st.leaf_cnt[l_i]
+            internal_value = _setb(
+                internal_value,
+                t_i,
+                leaf_output(pg, ph, p.lambda_l1, p.lambda_l2, p.max_delta_step),
+                ok,
+            )
+            internal_weight = _setb(internal_weight, t_i, ph, ok)
+            internal_count = _setb(internal_count, t_i, pc, ok)
+            leaf_g = _setb(_setb(leaf_g, l_i, lg_k[i], ok), nl_i, rg_k[i], ok)
+            leaf_h = _setb(_setb(leaf_h, l_i, lh_k[i], ok), nl_i, rh_k[i], ok)
+            leaf_cnt = _setb(
+                _setb(leaf_cnt, l_i, lc_k[i], ok), nl_i, rc_k[i], ok
+            )
+            leaf_depth = _setb(
+                _setb(leaf_depth, l_i, d_new_k[i], ok), nl_i, d_new_k[i], ok
+            )
+            leaf_parent = _setb(
+                _setb(leaf_parent, l_i, t_i, ok), nl_i, t_i, ok
+            )
+            leaf_is_right = _setb(
+                _setb(leaf_is_right, l_i, jnp.asarray(False), ok),
+                nl_i, jnp.asarray(True), ok,
+            )
+            hist_buf = hist_buf.at[l_i].set(
+                jnp.where(ok, left_hist_k[i], hist_buf[l_i])
+            )
+            hist_buf = hist_buf.at[nl_i].set(
+                jnp.where(ok, right_hist_k[i], hist_buf[nl_i])
+            )
+            if use_mono:
+                leaf_lb = _setb(
+                    _setb(leaf_lb, l_i, lb_l_k[i], ok), nl_i, lb_r_k[i], ok
+                )
+                leaf_ub = _setb(
+                    _setb(leaf_ub, l_i, ub_l_k[i], ok), nl_i, ub_r_k[i], ok
+                )
+            cand_l_i = SplitCandidate(*[a[i] for a in cand2])
+            cand_r_i = SplitCandidate(*[a[K + i] for a in cand2])
+            cand = _set_cand(cand, l_i, cand_l_i, gain_l_k[i], pred=ok)
+            cand = _set_cand(cand, nl_i, cand_r_i, gain_r_k[i], pred=ok)
+            if use_ordered or use_seg:
+                leaf_begin = _setb(
+                    leaf_begin, nl_i, begin_k[i] + nleft_k[i], ok
+                )
+                leaf_nrows = _setb(
+                    _setb(leaf_nrows, l_i, nleft_k[i], ok),
+                    nl_i, nright_k[i], ok,
+                )
+        if in_leaf_k is not None:
+            for i in range(K):
+                leaf_id = jnp.where(
+                    in_leaf_k[i] & ~go_left_k[i] & commit_k[i],
+                    nl_k[i], leaf_id,
+                )
+
+        return _State(
+            leaf_id=leaf_id,
+            order=order,
+            leaf_begin=leaf_begin,
+            leaf_nrows=leaf_nrows,
+            hist_buf=hist_buf,
+            leaf_g=leaf_g,
+            leaf_h=leaf_h,
+            leaf_cnt=leaf_cnt,
+            leaf_depth=leaf_depth,
+            leaf_parent=leaf_parent,
+            leaf_is_right=leaf_is_right,
+            leaf_lb=leaf_lb,
+            leaf_ub=leaf_ub,
+            leaf_box=st.leaf_box,
+            leaf_allowed=st.leaf_allowed,
+            cand=cand,
+            split_feature=split_feature,
+            split_bin=split_bin,
+            split_gain=split_gain,
+            default_left=default_left,
+            split_is_cat=split_is_cat,
+            node_cat_mask=node_cat_mask,
+            left_child=left_child,
+            right_child=right_child,
+            internal_value=internal_value,
+            internal_weight=internal_weight,
+            internal_count=internal_count,
+            num_leaves=st.num_leaves + jnp.sum(commit_k.astype(jnp.int32)),
+            done=done,
+            forced_ok=forced_ok_next,
+            cegb_used=st.cegb_used,
+        )
+
     with jax.named_scope("leaf_loop"):
-        state = lax.fori_loop(0, L - 1, body, state)
+        if leaf_k > 1:
+            # dynamic trip count: every step commits >= 1 split while any
+            # leaf remains splittable, so this takes ceil((num_splits)/avg
+            # batch) steps instead of a fixed L - 1
+            state = lax.while_loop(
+                lambda st: ~st.done & (st.num_leaves < L),
+                body_batched,
+                state,
+            )
+        else:
+            state = lax.fori_loop(0, L - 1, body, state)
 
     leaf_idx = jnp.arange(L, dtype=jnp.int32)
     active = leaf_idx < state.num_leaves

@@ -63,15 +63,17 @@ def _bytes_bf16(xu):
 
 
 def _seg_partition_kernel(
-    scal_ref,  # SMEM [8] i32: sbegin, cnt, feat, tbin, dl, nanb, iscat, pad
+    scal_ref,  # SMEM [K, 8] i32: sbegin, cnt, feat, tbin, dl, nanb, iscat,
+    #          pad — one row per grid program (K=1 for the serial call)
     seg_any,  # ANY [LANES, n_pad] i16 (aliased to seg_out)
-    cat_ref,  # VMEM [1, 256] f32 — bin -> goes-left (categorical)
+    cat_ref,  # VMEM [1, 256] f32 — bin -> goes-left (categorical); batched
+    #          calls block a [K, bmt] table to one row per program
     tri_ref,  # VMEM [T, T] bf16 — tri[i, j] = (i <= j), cumsum-by-matmul
     gl_any,  # ANY [1, n_pad] f32 — precomputed go-left bits (use_gl; else
     #          a [1, COL_ALIGN] dummy)
     seg_out,  # ANY [LANES, n_pad] i16 (aliased with seg_any)
     scratch_out,  # ANY [SUB, n_pad] i16 — right-stream spill
-    nl_ref,  # SMEM [1, 1] i32 — rows of the segment going left
+    nl_ref,  # SMEM [K, 1] i32 — rows of the segment going left, per program
     in_stage,  # VMEM [SUB, T] i16
     out_stage,  # VMEM [SUB, T] i16
     stage_lo,  # VMEM [SUB, W] f32 — left/main stream staging (lo bytes)
@@ -91,13 +93,14 @@ def _seg_partition_kernel(
     bmt: int,
     use_gl: bool,
 ):
-    sbegin = scal_ref[0]
-    cnt = scal_ref[1]
-    feat = scal_ref[2]
-    tbin = scal_ref[3]
-    dl = scal_ref[4]
-    nanb = scal_ref[5]
-    iscat = scal_ref[6]
+    pid = pl.program_id(0)
+    sbegin = scal_ref[pid, 0]
+    cnt = scal_ref[pid, 1]
+    feat = scal_ref[pid, 2]
+    tbin = scal_ref[pid, 3]
+    dl = scal_ref[pid, 4]
+    nanb = scal_ref[pid, 5]
+    iscat = scal_ref[pid, 6]
 
     abegin = (sbegin // COL_ALIGN) * COL_ALIGN
     off = sbegin - abegin
@@ -114,7 +117,7 @@ def _seg_partition_kernel(
     stage_hi[...] = jnp.zeros_like(stage_hi)
     rstage_lo[...] = jnp.zeros_like(rstage_lo)
     rstage_hi[...] = jnp.zeros_like(rstage_hi)
-    nl_ref[0, 0] = 0
+    nl_ref[pid, 0] = 0
 
     def _append(lo, hi, keep, fill, slo, shi):
         """Matmul-compact `keep` columns of the tile into staging at `fill`.
@@ -189,7 +192,11 @@ def _seg_partition_kernel(
 
     def body1(t, carry):
         fill_l, bl, fill_r, br, nl = carry
-        xu = _read_tile(seg_any, abegin + t * T)
+        # read through the OUTPUT alias, not seg_any: on TPU they are the
+        # same buffer, but batched grids re-read boundary tiles an earlier
+        # program rewrote (adjacent leaf windows share COL_ALIGN blocks) and
+        # interpret mode only makes those writes visible on the output ref
+        xu = _read_tile(seg_out, abegin + t * T)
         rpos = iota_j + t * T
         in_seg = (rpos >= off) & (rpos < off + cnt)
         if use_gl:
@@ -264,7 +271,7 @@ def _seg_partition_kernel(
         body1,
         (jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
     )
-    nl_ref[0, 0] = nl
+    nl_ref[pid, 0] = nl
 
     # spill the partial right-stream block (cols beyond fill_r are garbage;
     # pass 2 masks them out via the stream length)
@@ -371,5 +378,81 @@ def seg_partition_pallas(
         ],
         input_output_aliases={1: 0},
         interpret=interpret,
-    )(scal, seg, catmask, tri, gl_arr)
+    )(scal.reshape(1, 8), seg, catmask, tri, gl_arr)
     return seg_new, nl[0, 0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("f", "n_pad", "use_cat", "wide", "interpret")
+)
+def seg_partition_pallas_batch(
+    seg: jnp.ndarray,  # [LANES, n_pad] i16 plane-major packed rows
+    scal: jnp.ndarray,  # [K, 8] i32 rows: sbegin, cnt, feat, tbin, dl,
+    #                     nanb, iscat, 0 — one DISJOINT window per row
+    catmask: jnp.ndarray,  # [K, bmt] f32 (bmt >= 256, 128-multiple)
+    *,
+    f: int,
+    n_pad: int,
+    use_cat: bool,
+    wide: bool = False,
+    interpret: bool = False,
+):
+    """K in-place stable partitions over K disjoint windows in ONE launch.
+
+    A K-program grid over the serial streaming kernel: TPU grid programs
+    execute sequentially on the core, so the in-place aliasing and shared
+    staging scratch stay safe — each program completes its read-rewrite of
+    its (over-covered, boundary-preserving) window before the next starts.
+    A zero-cnt row is a no-op (its window rewrite preserves every value).
+    Frontier-batched growth (ops/grower.py leaf_batch) pays ONE program's
+    fixed cost for K splits.
+
+    Returns (seg', nl[K])."""
+    k = scal.shape[0]
+    sub = -(-used_lanes(f, wide) // 8) * 8
+    lanes = seg.shape[0]
+    bmt = catmask.shape[1]
+    tri = jnp.tril(jnp.ones((T, T), jnp.bfloat16)).T  # tri[i, j] = i <= j
+    gl_arr = jnp.zeros((1, COL_ALIGN), jnp.float32)
+    kernel = functools.partial(
+        _seg_partition_kernel, f=f, n_pad=n_pad, use_cat=use_cat, sub=sub,
+        wide=wide, bmt=bmt, use_gl=False,
+    )
+    seg_new, _, nl = pl.pallas_call(
+        kernel,
+        grid=(k,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            # one catmask row per program, so the kernel body sees the same
+            # [1, bmt] block the serial call passes
+            pl.BlockSpec((1, bmt), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((lanes, n_pad), jnp.int16),
+            jax.ShapeDtypeStruct((sub, n_pad), jnp.int16),
+            jax.ShapeDtypeStruct((k, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((sub, T), jnp.int16),
+            pltpu.VMEM((sub, T), jnp.int16),
+            pltpu.VMEM((sub, W), jnp.float32),
+            pltpu.VMEM((sub, W), jnp.float32),
+            pltpu.VMEM((sub, W), jnp.float32),
+            pltpu.VMEM((sub, W), jnp.float32),
+            pltpu.VMEM((1, T), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(scal.astype(jnp.int32), seg, catmask, tri, gl_arr)
+    return seg_new, nl[:, 0]

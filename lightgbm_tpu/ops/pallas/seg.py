@@ -208,10 +208,10 @@ _TARGET_LANES = 2048
 
 
 def _seg_hist_kernel(
-    scal_ref,  # SMEM [2] i32: start, cnt
+    scal_ref,  # SMEM [K, 2] i32: (start, cnt) per grid program (K=1 serial)
     scales_ref,  # SMEM [2] f32: g_scale, h_scale (quantized mode; else 1s)
     seg_any,  # ANY [LANES, n_pad] i16 (plane-major)
-    out_ref,  # VMEM [3, F * bpad] f32
+    out_ref,  # VMEM [3, F * bpad] f32 (batched: [1, 3, F * bpad] block)
     in_stage,  # VMEM [SUB, TILE] i16 — only the used planes are DMA'd
     acc,  # VMEM [8 | 4, F * bpad] f32 | i32
     onehot,  # VMEM [TILE, group * bpad] bf16 | i8
@@ -223,9 +223,11 @@ def _seg_hist_kernel(
     sub: int,
     quantized: bool,
     wide: bool,
+    batched: bool = False,
 ):
-    start = scal_ref[0]
-    cnt = scal_ref[1]
+    i = pl.program_id(0)
+    start = scal_ref[i, 0]
+    cnt = scal_ref[i, 1]
     abegin = (start // COL_ALIGN) * COL_ALIGN
     off = start - abegin
     nt = (off + cnt + TILE - 1) // TILE
@@ -347,15 +349,23 @@ def _seg_hist_kernel(
 
     lax.fori_loop(0, nt, body, 0)
     if quantized:
-        out_ref[0, :] = acc[0, :].astype(jnp.float32) * scales_ref[0]
-        out_ref[1, :] = acc[1, :].astype(jnp.float32) * scales_ref[1]
-        out_ref[2, :] = acc[2, :].astype(jnp.float32)
+        row0 = acc[0, :].astype(jnp.float32) * scales_ref[0]
+        row1 = acc[1, :].astype(jnp.float32) * scales_ref[1]
+        row2 = acc[2, :].astype(jnp.float32)
     else:
         # rows: 0 g_hi, 1 h_hi, 2 count, 3 g_lo, 4 h_lo, 5 zero,
         # 6 g_lo2, 7 h_lo2
-        out_ref[...] = acc[:3, :] + acc[3:6, :]
-        out_ref[0, :] += acc[6, :]
-        out_ref[1, :] += acc[7, :]
+        row0 = acc[0, :] + acc[3, :] + acc[6, :]
+        row1 = acc[1, :] + acc[4, :] + acc[7, :]
+        row2 = acc[2, :] + acc[5, :]
+    if batched:
+        out_ref[0, 0, :] = row0
+        out_ref[0, 1, :] = row1
+        out_ref[0, 2, :] = row2
+    else:
+        out_ref[0, :] = row0
+        out_ref[1, :] = row1
+        out_ref[2, :] = row2
 
 
 @functools.partial(
@@ -411,8 +421,68 @@ def seg_hist_pallas(
             pltpu.SemaphoreType.DMA,
         ],
         interpret=interpret,
-    )(scal, scales.astype(jnp.float32), seg)
+    )(scal.reshape(1, 2), scales.astype(jnp.float32), seg)
     return out.reshape(3, f, bpad)[:, :, :num_bins].transpose(1, 2, 0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("f", "num_bins", "n_pad", "quantized", "wide", "interpret"),
+)
+def seg_hist_pallas_batch(
+    seg: jnp.ndarray,
+    scal: jnp.ndarray,  # [K, 2] i32: (start, cnt) per batch member
+    scales: Optional[jnp.ndarray] = None,
+    *,
+    f: int,
+    num_bins: int,
+    n_pad: int,
+    quantized: bool = False,
+    wide: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """K histograms [K, F, B, 3] of K disjoint packed-row windows in ONE
+    launch: a K-program grid over the serial kernel (TPU grid programs run
+    sequentially on the core, so the shared staging/accumulator scratch is
+    reused safely program-to-program).  Frontier-batched growth
+    (ops/grower.py leaf_batch) uses this to build all K smaller-child
+    histograms per step with one program's fixed cost."""
+    k = scal.shape[0]
+    bpad = (max(num_bins, 1) + 127) // 128 * 128
+    group = min(max(1, _TARGET_LANES // bpad), f)
+    sub = min(storage_lanes(f, wide), (used_lanes(f, wide) + 15) // 16 * 16)
+    kernel = functools.partial(
+        _seg_hist_kernel, f=f, bpad=bpad, group=group, sub=sub,
+        quantized=quantized, wide=wide, batched=True,
+    )
+    if scales is None:
+        scales = jnp.ones((2,), jnp.float32)
+    out = pl.pallas_call(
+        kernel,
+        grid=(k,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 3, f * bpad), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((k, 3, f * bpad), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((sub, TILE), jnp.int16),
+            pltpu.VMEM(
+                (4, f * bpad) if quantized else (8, f * bpad),
+                jnp.int32 if quantized else jnp.float32,
+            ),
+            pltpu.VMEM(
+                (TILE, group * bpad), jnp.int8 if quantized else jnp.bfloat16
+            ),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(scal.astype(jnp.int32), scales.astype(jnp.float32), seg)
+    return out.reshape(k, 3, f, bpad)[:, :, :, :num_bins].transpose(0, 2, 3, 1)
 
 
 def seg_hist_ref(seg: jnp.ndarray, scal: jnp.ndarray, *, f: int, num_bins: int,
@@ -455,4 +525,37 @@ def seg_hist(seg, scal, *, f: int, num_bins: int, n_pad: int,
         default=lambda seg, scal, _s: seg_hist_ref(
             seg, scal, f=f, num_bins=num_bins, n_pad=n_pad, wide=wide
         ),
+    )
+
+
+def seg_hist_batch(seg, scal_k, *, f: int, num_bins: int, n_pad: int,
+                   quant_scales=None, wide: bool = False):
+    """K-window histogram dispatch ([K, 2] (start, cnt) -> [K, F, B, 3]):
+    one K-program Pallas launch on TPU, a vmapped masked full pass
+    elsewhere."""
+    quantized = quant_scales is not None
+    scales = (
+        jnp.stack([quant_scales[0], quant_scales[1]]).astype(jnp.float32)
+        if quantized
+        else jnp.ones((2,), jnp.float32)
+    )
+
+    def _ref(seg, scal_k, _s):
+        return jax.vmap(
+            lambda s: seg_hist_ref(
+                seg, s, f=f, num_bins=num_bins, n_pad=n_pad, wide=wide
+            )
+        )(scal_k)
+
+    if jax.default_backend() != "tpu":
+        return _ref(seg, scal_k, scales)
+    return jax.lax.platform_dependent(
+        seg,
+        scal_k,
+        scales,
+        tpu=functools.partial(
+            seg_hist_pallas_batch, f=f, num_bins=num_bins, n_pad=n_pad,
+            quantized=quantized, wide=wide,
+        ),
+        default=_ref,
     )

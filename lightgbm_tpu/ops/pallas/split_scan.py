@@ -237,13 +237,19 @@ def fused_best_split(
     min_data_in_leaf: int,
     min_sum_hessian_in_leaf: float,
     min_gain_to_split: float,
+    feature_contri=None,
     interpret: bool = False,
 ):
     """best_split (basic numeric path) backed by the Pallas scan kernel.
 
     Returns the same SplitCandidate best_split would for configurations
     fused_eligible() admits (tie order differs only on exact cross-feature
-    float-gain ties)."""
+    float-gain ties).
+
+    ``feature_contri`` ([F] f32): per-feature gain multipliers (reference
+    FeatureMetainfo::penalty) — applied OUTSIDE the kernel to the
+    per-feature improvement rows before the cross-feature argmax, mirroring
+    best_split's penalized path."""
     from ..split import SplitCandidate, leaf_gain
 
     f, b, _ = hist.shape
@@ -260,13 +266,24 @@ def fused_best_split(
         interpret=interpret,
     )
     gains = rows[:, 0]
-    feat = jnp.argmax(gains).astype(jnp.int32)
-    r = rows[feat]
     parent_gain = leaf_gain(
         jnp.asarray(parent_g, jnp.float32), jnp.asarray(parent_h, jnp.float32),
         lambda_l1, lambda_l2,
     )
-    improvement = r[0] - parent_gain - min_gain_to_split
+    if feature_contri is not None:
+        imp_f = gains - parent_gain - min_gain_to_split
+        scaled = jnp.where(
+            jnp.isfinite(gains),
+            imp_f * feature_contri.astype(jnp.float32),
+            -jnp.inf,
+        )
+        feat = jnp.argmax(scaled).astype(jnp.int32)
+        r = rows[feat]
+        improvement = scaled[feat]
+    else:
+        feat = jnp.argmax(gains).astype(jnp.int32)
+        r = rows[feat]
+        improvement = r[0] - parent_gain - min_gain_to_split
     improvement = jnp.where(jnp.isfinite(r[0]), improvement, -jnp.inf)
     return SplitCandidate(
         gain=improvement.astype(jnp.float32),

@@ -203,6 +203,64 @@ def sort_partition(
     return jax.lax.platform_dependent(*args, tpu=_pallas, default=_xla)
 
 
+def sort_partition_batch(
+    seg,
+    sbegins,  # [K] i32 — segment begins (disjoint windows)
+    cnts,  # [K] i32 — segment rows (0 = no-op member)
+    feats,  # [K] i32
+    tbins,  # [K] i32
+    dls,  # [K] i32
+    nanbs,  # [K] i32
+    iscats,  # [K] i32
+    catmasks,  # [K, Bm] f32
+    *,
+    f: int,
+    n_pad: int,
+    wide: bool = False,
+):
+    """K stable partitions over K DISJOINT leaf windows (frontier-batched
+    growth, ops/grower.py leaf_batch).  One K-program Pallas launch on TPU;
+    elsewhere a sequential chain of the stable-sort partitions (disjoint
+    windows make the chain order-independent and bit-identical to K serial
+    calls).  Returns (seg', nl[K], nr[K])."""
+    from .pallas.partition import seg_partition_pallas_batch
+
+    k = sbegins.shape[0]
+
+    def _pallas(seg, sbegins, cnts, feats, tbins, dls, nanbs, iscats,
+                catmasks):
+        bm = catmasks.shape[1]
+        bmt = max(256, -(-bm // 128) * 128)
+        catm = jnp.zeros((k, bmt), jnp.float32)
+        catm = catm.at[:, :bm].set(catmasks.astype(jnp.float32))
+        scal = jnp.stack(
+            [sbegins, cnts, feats, tbins, dls, nanbs, iscats,
+             jnp.zeros_like(sbegins)],
+            axis=1,
+        ).astype(jnp.int32)
+        seg_new, nl = seg_partition_pallas_batch(
+            seg, scal, catm, f=f, n_pad=n_pad, use_cat=bm > 1, wide=wide,
+        )
+        return seg_new, nl, cnts - nl
+
+    def _xla(seg, sbegins, cnts, feats, tbins, dls, nanbs, iscats, catmasks):
+        nls = []
+        for i in range(k):
+            seg, nl_i, _ = sort_partition_xla(
+                seg, sbegins[i], cnts[i], feats[i], tbins[i], dls[i],
+                nanbs[i], iscats[i], catmasks[i],
+                f=f, n_pad=n_pad, wide=wide, use_gl_vec=False,
+            )
+            nls.append(nl_i)
+        nl = jnp.stack(nls)
+        return seg, nl, cnts - nl
+
+    args = (seg, sbegins, cnts, feats, tbins, dls, nanbs, iscats, catmasks)
+    if jax.default_backend() != "tpu":
+        return _xla(*args)
+    return jax.lax.platform_dependent(*args, tpu=_pallas, default=_xla)
+
+
 def leaf_of_positions(
     leaf_sbegin: jnp.ndarray,  # [L] i32 (active leaves' segment begins)
     leaf_rows: jnp.ndarray,  # [L] i32

@@ -131,6 +131,15 @@ def best_split(
     cegb_split_penalty: float = 0.0,  # tradeoff * cegb_penalty_split
     rand_bins: Optional[jnp.ndarray] = None,  # [F] extra_trees random bin
     per_feature_gains: bool = False,  # also return max gain per feature [F]
+    monotone_penalty: float = 0.0,  # depth-scaled gain penalty for monotone
+    #                   features (reference monotone_constraints.hpp:357-366,
+    #                   applied at serial_tree_learner.cpp:1002); needs
+    #                   ``leaf_depth`` and ``monotone`` to engage
+    leaf_depth=None,  # scalar i32 — depth of THIS leaf (the penalty is
+    #                   evaluated at leaf_depth + 1, the children's depth)
+    feature_contri: Optional[jnp.ndarray] = None,  # [F] f32 per-feature gain
+    #                   multipliers (reference FeatureMetainfo::penalty,
+    #                   feature_histogram.hpp:1445-1448)
     adv_bounds=None,  # advanced monotone: (lb_l, ub_l, lb_r, ub_r) [F, B]
     #                   per-THRESHOLD child bounds (reference
     #                   AdvancedLeafConstraints / CumulativeFeatureConstraint,
@@ -337,12 +346,60 @@ def best_split(
         cases += [gain_oh, gain_fwd, gain_bwd]
 
     gains = jnp.stack(cases)  # [C, F, B]
-    if cegb_penalty is not None:
+    if not use_full_gain:
+        parent_gain = leaf_gain(parent[0], parent[1], lambda_l1, lambda_l2)
+    else:
+        parent_gain = gain_given_output(
+            parent[0], parent[1], lambda_l1, lambda_l2,
+            constrained_output(
+                parent[0], parent[1], lambda_l1, lambda_l2, max_delta_step,
+                0.0, None, 0.0, leaf_lb, leaf_ub,
+            ),
+        )
+    use_penalized = feature_contri is not None or (
+        monotone is not None
+        and monotone_penalty > 0.0
+        and leaf_depth is not None
+    )
+    if cegb_penalty is not None and not use_penalized:
         # per-feature penalty shifts which candidate wins (DeltaGain's
         # coupled term); applied in improvement units so the parent-gain
         # subtraction below stays correct
         gains = gains - cegb_penalty[None, :, None]
-    flat = jnp.argmax(gains)
+    if use_penalized:
+        # the reference applies these multipliers to the IMPROVEMENT (raw
+        # gain minus parent gain minus min_gain_shift) before the
+        # cross-feature comparison — FindBestThreshold's
+        # ``output->gain *= meta_->penalty`` (feature_histogram.hpp:1445)
+        # and ComputeMonotoneSplitGainPenalty at
+        # serial_tree_learner.cpp:1002 — so they can change which feature
+        # wins, not just rescale the winner
+        mult = jnp.ones((f,), jnp.float32)
+        if (
+            monotone is not None
+            and monotone_penalty > 0.0
+            and leaf_depth is not None
+        ):
+            d = (jnp.asarray(leaf_depth) + 1).astype(jnp.float32)
+            if monotone_penalty <= 1.0:
+                base = 1.0 - monotone_penalty / jnp.exp2(d) + _EPS
+            else:
+                base = 1.0 - jnp.exp2(monotone_penalty - 1.0 - d) + _EPS
+            pen = jnp.where(monotone_penalty >= d + 1.0, _EPS, base)
+            mult = mult * jnp.where(monotone != 0, pen, 1.0)
+        if feature_contri is not None:
+            mult = mult * feature_contri.astype(jnp.float32)
+        imp_all = gains - parent_gain - min_gain_to_split
+        scaled = jnp.where(
+            jnp.isfinite(gains), imp_all * mult[None, :, None], -jnp.inf
+        )
+        if cegb_penalty is not None:
+            # reference order: penalty multiply, THEN the CEGB delta
+            scaled = scaled - cegb_penalty[None, :, None]
+        sel = scaled
+    else:
+        sel = gains
+    flat = jnp.argmax(sel)
     case = (flat // (f * b)).astype(jnp.int32)
     dl = (case == 1).astype(jnp.int32)
     rem = flat % (f * b)
@@ -387,17 +444,10 @@ def best_split(
         bundle_mask = ~((bids >= tbin) & (bids <= bwin_end))
         is_cat_win = jnp.asarray(is_cat_win) | bundled_win
         cat_mask = jnp.where(bundled_win, bundle_mask, cat_mask)
-    if not use_full_gain:
-        parent_gain = leaf_gain(parent[0], parent[1], lambda_l1, lambda_l2)
+    if use_penalized:
+        improvement = scaled.reshape(-1)[flat]
     else:
-        parent_gain = gain_given_output(
-            parent[0], parent[1], lambda_l1, lambda_l2,
-            constrained_output(
-                parent[0], parent[1], lambda_l1, lambda_l2, max_delta_step,
-                0.0, None, 0.0, leaf_lb, leaf_ub,
-            ),
-        )
-    improvement = best_gain_raw - parent_gain - min_gain_to_split
+        improvement = best_gain_raw - parent_gain - min_gain_to_split
     if cegb_split_penalty:
         # uniform per-split data cost: tradeoff * penalty_split * num_data
         improvement = improvement - cegb_split_penalty * parent[2]
@@ -422,5 +472,7 @@ def best_split(
         # min_gain offset the winning candidate uses — including the
         # constrained-parent form under use_full_gain) — the voting-parallel
         # learner's LightSplitInfo gains (voting_parallel_tree_learner.cpp:152)
+        if use_penalized:
+            return cand_out, sel.max(axis=(0, 2))
         return cand_out, gains.max(axis=(0, 2)) - parent_gain - min_gain_to_split
     return cand_out

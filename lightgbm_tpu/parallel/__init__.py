@@ -120,13 +120,13 @@ def make_sharded_grow(
 
     def local(bins, grad, hess, mask, num_bins, nan_bins, feature_mask,
               monotone, interaction_sets, rng, is_cat, forced, cegb_penalty,
-              cegb_used, quant_scales, bundle_end):
+              cegb_used, quant_scales, bundle_end, feature_contri):
         return grow_tree(
             bins, grad, hess, mask, num_bins, nan_bins, feature_mask, p,
             monotone=monotone, interaction_sets=interaction_sets, rng=rng,
             is_cat=is_cat, forced=forced, cegb_penalty=cegb_penalty,
             cegb_used=cegb_used, quant_scales=quant_scales,
-            bundle_end=bundle_end,
+            bundle_end=bundle_end, feature_contri=feature_contri,
         )
 
     rep = P()
@@ -141,7 +141,7 @@ def make_sharded_grow(
         local,
         mesh=mesh,
         in_specs=(sh2, sh, sh, sh, rep, rep, rep, rep, rep, rep, rep, rep,
-                  rep, rep, rep, rep),
+                  rep, rep, rep, rep, rep),
         out_specs=(
             jax.tree.map(lambda _: rep, TreeArrays(*([0] * len(TreeArrays._fields)))),
             leaf_out,

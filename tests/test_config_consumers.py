@@ -40,17 +40,6 @@ NOT_APPLICABLE = {
     "by the installed jax platform, not per-param",
     "deterministic": "training is already run-to-run deterministic: one "
     "PRNGKey stream, no atomics, fixed reduction orders",
-    # per-subsystem seeds whose reference RNG streams are replaced by the
-    # single jax.random PRNGKey chain derived from `seed` (gbdt.py:601);
-    # _apply_seed still derives them for model-file parity
-    "bagging_seed": "bagging keys derive from the one PRNGKey chain",
-    "extra_seed": "extra_trees keys derive from the one PRNGKey chain",
-    # reference-only split shaping not yet ported (tracked features, not
-    # silently-broken ones: both raise via Config.raw round-trip in model
-    # files rather than changing results)
-    "monotone_penalty": "monotone split-depth penalty not yet implemented; "
-    "constraints themselves ARE enforced (ops/grower.py)",
-    "feature_contri": "per-feature gain multipliers not yet implemented",
     # dataset-loading switches with no analog in the NumPy/scipy loaders
     "is_enable_sparse": "sparse input is type-driven (scipy matrix in -> "
     "CSC path); no heuristic sparse/dense switch to toggle",

@@ -1,0 +1,383 @@
+"""Frontier-batched growth (``leaf_batch=K``): the grower splits up to K
+frontier leaves per compiled loop step, committing the longest prefix of the
+gain-sorted batch whose members each beat every child created by earlier
+members (strictly) — which is exactly when serial leaf-wise argmax would have
+picked them next.  The committed split SEQUENCE is therefore identical to
+serial growth; these tests assert structure equality (split features / bins /
+topology / leaf counts) and leaf-value closeness across K for every supported
+scenario.
+
+Row ORDER inside a leaf window may differ from serial (uncommitted members
+still physically partition their window before being rolled back as
+value-preserving no-ops), so the tests compare tree structure, not
+intermediate buffers.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.ops.grower import GrowerParams, grow_tree  # noqa: E402
+from lightgbm_tpu.parallel import (  # noqa: E402
+    DATA_AXIS,
+    l2_gradients,
+    make_data_parallel_train_step,
+    replicate,
+    shard_rows,
+)
+
+N, F, B = 600, 6, 16
+KS = [2, 4, 8]
+
+
+def _problem(seed=0, n=N, f=F, b=B):
+    rs = np.random.RandomState(seed)
+    bins = jnp.asarray(rs.randint(0, b, size=(n, f)), jnp.int32)
+    grad = jnp.asarray(rs.randn(n), jnp.float32)
+    hess = jnp.asarray(np.abs(rs.randn(n)) + 0.1, jnp.float32)
+    mask = jnp.ones((n,), jnp.float32)
+    num_bins = jnp.full((f,), b, jnp.int32)
+    nan_bins = jnp.full((f,), -1, jnp.int32)
+    fm = jnp.ones((f,), bool)
+    return bins, grad, hess, mask, num_bins, nan_bins, fm
+
+
+def _grow(problem, params, **kw):
+    bins, grad, hess, mask, num_bins, nan_bins, fm = problem
+    return grow_tree(bins, grad, hess, mask, num_bins, nan_bins, fm, params, **kw)
+
+
+def _assert_same_tree(got, ref, *, check_leaf_id=True):
+    ta, lid = got
+    ta1, lid1 = ref
+    assert int(ta.num_leaves) == int(ta1.num_leaves)
+    np.testing.assert_array_equal(
+        np.asarray(ta.split_feature), np.asarray(ta1.split_feature)
+    )
+    np.testing.assert_array_equal(np.asarray(ta.split_bin), np.asarray(ta1.split_bin))
+    np.testing.assert_array_equal(
+        np.asarray(ta.left_child), np.asarray(ta1.left_child)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ta.right_child), np.asarray(ta1.right_child)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ta.default_left), np.asarray(ta1.default_left)
+    )
+    np.testing.assert_allclose(
+        np.asarray(ta.leaf_value), np.asarray(ta1.leaf_value), rtol=1e-5, atol=1e-6
+    )
+    if check_leaf_id:
+        np.testing.assert_array_equal(np.asarray(lid), np.asarray(lid1))
+
+
+@pytest.mark.parametrize("mode", ["seg", "ordered", "gather", "full"])
+@pytest.mark.parametrize("K", KS)
+def test_parity_hist_modes(mode, K):
+    prob = _problem(0)
+    p1 = GrowerParams(num_leaves=15, max_bin=B, hist_mode=mode, min_data_in_leaf=5)
+    ref = _grow(prob, p1)
+    got = _grow(prob, dataclasses.replace(p1, leaf_batch=K))
+    _assert_same_tree(got, ref)
+
+
+@pytest.mark.parametrize("K", KS)
+def test_parity_categorical(K):
+    prob = _problem(1)
+    is_cat = jnp.asarray([True, False, True, False, False, True])
+    p1 = GrowerParams(
+        num_leaves=15, max_bin=B, hist_mode="ordered", min_data_in_leaf=5, use_cat=True
+    )
+    ref = _grow(prob, p1, is_cat=is_cat)
+    got = _grow(prob, dataclasses.replace(p1, leaf_batch=K), is_cat=is_cat)
+    _assert_same_tree(got, ref)
+
+
+@pytest.mark.parametrize("K", KS)
+def test_parity_monotone_basic(K):
+    prob = _problem(2)
+    mono = jnp.asarray([1, -1, 0, 0, 1, 0], jnp.int8)
+    p1 = GrowerParams(
+        num_leaves=15,
+        max_bin=B,
+        hist_mode="ordered",
+        min_data_in_leaf=5,
+        use_monotone=True,
+        monotone_method="basic",
+    )
+    ref = _grow(prob, p1, monotone=mono)
+    got = _grow(prob, dataclasses.replace(p1, leaf_batch=K), monotone=mono)
+    _assert_same_tree(got, ref)
+
+
+@pytest.mark.parametrize("K", [2, 4])
+def test_parity_extra_trees(K):
+    prob = _problem(3)
+    p1 = GrowerParams(
+        num_leaves=15, max_bin=B, hist_mode="gather", min_data_in_leaf=5,
+        extra_trees=True,
+    )
+    rng = jax.random.PRNGKey(42)
+    ref = _grow(prob, p1, rng=rng)
+    got = _grow(prob, dataclasses.replace(p1, leaf_batch=K), rng=rng)
+    _assert_same_tree(got, ref)
+
+
+# ---- prefix-commit edge cases -------------------------------------------
+
+@pytest.mark.parametrize("K", KS)
+def test_prefix_commit_sequential_gains(K):
+    """A single dominant feature makes every new child the next-best leaf:
+    only the first batch member can commit each step (the rest lose to its
+    children), so the batched loop degenerates to serial one-at-a-time — and
+    must still match exactly."""
+    rs = np.random.RandomState(4)
+    n = 800
+    bins = jnp.asarray(rs.randint(0, B, size=(n, F)), jnp.int32)
+    # gradient is a steep function of feature 0 alone: refining feature 0
+    # always produces the next-highest-gain leaf
+    grad = jnp.asarray(-np.power(2.0, np.asarray(bins[:, 0]) / 2.0), jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    prob = (
+        bins, grad, hess, jnp.ones((n,), jnp.float32),
+        jnp.full((F,), B, jnp.int32), jnp.full((F,), -1, jnp.int32),
+        jnp.ones((F,), bool),
+    )
+    p1 = GrowerParams(num_leaves=12, max_bin=B, hist_mode="ordered", min_data_in_leaf=2)
+    ref = _grow(prob, p1)
+    got = _grow(prob, dataclasses.replace(p1, leaf_batch=K))
+    _assert_same_tree(got, ref)
+
+
+@pytest.mark.parametrize("K", KS)
+def test_prefix_commit_independent_gains(K):
+    """Additively separable target over independent features: frontier leaves
+    have unrelated gains, so most batch members commit every step (the
+    all-committed edge)."""
+    rs = np.random.RandomState(5)
+    n = 1200
+    bins = jnp.asarray(rs.randint(0, B, size=(n, F)), jnp.int32)
+    b_np = np.asarray(bins)
+    grad = jnp.asarray(
+        -(
+            4.0 * (b_np[:, 0] > 8)
+            + 2.0 * (b_np[:, 1] > 8)
+            + 1.0 * (b_np[:, 2] > 8)
+            + 0.5 * (b_np[:, 3] > 8)
+            + 0.25 * (b_np[:, 4] > 8)
+        ),
+        jnp.float32,
+    )
+    hess = jnp.ones((n,), jnp.float32)
+    prob = (
+        bins, grad, hess, jnp.ones((n,), jnp.float32),
+        jnp.full((F,), B, jnp.int32), jnp.full((F,), -1, jnp.int32),
+        jnp.ones((F,), bool),
+    )
+    p1 = GrowerParams(num_leaves=15, max_bin=B, hist_mode="seg", min_data_in_leaf=2)
+    ref = _grow(prob, p1)
+    got = _grow(prob, dataclasses.replace(p1, leaf_batch=K))
+    _assert_same_tree(got, ref)
+
+
+@pytest.mark.parametrize("K", KS)
+def test_prefix_commit_tie_gains(K):
+    """Duplicated feature columns give exact cross-feature gain ties; top_k
+    and argmax both break ties toward the lowest index, so the batched
+    frontier selection must agree with serial."""
+    rs = np.random.RandomState(6)
+    n = 600
+    bins_np = rs.randint(0, B, size=(n, F))
+    bins_np[:, 1] = bins_np[:, 0]  # exact duplicate -> identical gains
+    bins_np[:, 3] = bins_np[:, 2]
+    bins = jnp.asarray(bins_np, jnp.int32)
+    grad = jnp.asarray(rs.randn(n), jnp.float32)
+    hess = jnp.ones((n,), jnp.float32)
+    prob = (
+        bins, grad, hess, jnp.ones((n,), jnp.float32),
+        jnp.full((F,), B, jnp.int32), jnp.full((F,), -1, jnp.int32),
+        jnp.ones((F,), bool),
+    )
+    p1 = GrowerParams(num_leaves=15, max_bin=B, hist_mode="gather", min_data_in_leaf=5)
+    ref = _grow(prob, p1)
+    got = _grow(prob, dataclasses.replace(p1, leaf_batch=K))
+    _assert_same_tree(got, ref)
+
+
+def test_leaf_batch_clamped_to_frontier():
+    """K larger than num_leaves-1 is clamped, not an error."""
+    prob = _problem(7)
+    p1 = GrowerParams(num_leaves=4, max_bin=B, hist_mode="ordered", min_data_in_leaf=5)
+    ref = _grow(prob, p1)
+    got = _grow(prob, dataclasses.replace(p1, leaf_batch=16))
+    _assert_same_tree(got, ref)
+
+
+# ---- e2e booster ---------------------------------------------------------
+
+def _tree_dump(bst):
+    return [
+        (
+            list(t.split_feature),
+            list(t.left_child),
+            list(t.right_child),
+            [round(float(v), 5) for v in t.leaf_value],
+        )
+        for t in bst.models_
+    ]
+
+
+@pytest.mark.parametrize("K", KS)
+def test_booster_e2e_structure_matches_serial(K):
+    rng = np.random.default_rng(0)
+    n = 800
+    X = rng.normal(size=(n, 4))
+    y = 3.0 * (X[:, 0] > 0) + 0.5 * (X[:, 1] > 0) + rng.normal(scale=0.1, size=n)
+    base = {
+        "objective": "regression",
+        "num_leaves": 12,
+        "min_data_in_leaf": 5,
+        "verbosity": -1,
+    }
+    ref = lgb.train(base, lgb.Dataset(X, y), 3)
+    got = lgb.train({**base, "leaf_batch": K}, lgb.Dataset(X, y), 3)
+    assert _tree_dump(got) == _tree_dump(ref)
+
+
+@pytest.mark.parametrize("K", [2, 4])
+def test_booster_e2e_forced_splits(K, tmp_path):
+    rng = np.random.default_rng(1)
+    n = 800
+    X = rng.normal(size=(n, 4))
+    y = 3.0 * (X[:, 0] > 0) + 0.5 * (X[:, 1] > 0) + rng.normal(scale=0.1, size=n)
+    fs = tmp_path / "forced.json"
+    fs.write_text(
+        json.dumps(
+            {
+                "feature": 1,
+                "threshold": 0.0,
+                "left": {"feature": 2, "threshold": 0.5},
+                "right": {"feature": 2, "threshold": -0.5},
+            }
+        )
+    )
+    base = {
+        "objective": "regression",
+        "num_leaves": 12,
+        "min_data_in_leaf": 5,
+        "verbosity": -1,
+        "forcedsplits_filename": str(fs),
+    }
+    ref = lgb.train(base, lgb.Dataset(X, y), 2)
+    got = lgb.train({**base, "leaf_batch": K}, lgb.Dataset(X, y), 2)
+    assert _tree_dump(got) == _tree_dump(ref)
+    # the forced chain actually took effect
+    assert ref.models_[0].split_feature[0] == 1
+
+
+def test_unsupported_mode_falls_back_to_serial():
+    """Interaction constraints aren't batched: the booster must warn, drop
+    to leaf_batch=1, and train identically to serial."""
+    rng = np.random.default_rng(2)
+    n = 500
+    X = rng.normal(size=(n, 4))
+    y = X[:, 0] + X[:, 2] + rng.normal(scale=0.1, size=n)
+    base = {
+        "objective": "regression",
+        "num_leaves": 8,
+        "min_data_in_leaf": 5,
+        "verbosity": -1,
+        "interaction_constraints": [[0, 1], [2, 3]],
+    }
+    ref = lgb.train(base, lgb.Dataset(X, y), 2)
+    got = lgb.train({**base, "leaf_batch": 4}, lgb.Dataset(X, y), 2)
+    assert _tree_dump(got) == _tree_dump(ref)
+
+
+def test_leaf_batch_validation():
+    with pytest.raises(ValueError):
+        lgb.Config.from_params({"leaf_batch": 0})
+
+
+# ---- data-parallel -------------------------------------------------------
+
+@pytest.mark.parametrize("K", [2, 4])
+def test_parity_data_parallel(K, cpu_mesh_devices):
+    """Sharded batched growth == sharded serial growth == single-device
+    serial growth: the commit decisions derive from psummed quantities, so
+    every shard takes the same trip count."""
+    rng = np.random.default_rng(21)
+    n = 512
+    bins = rng.integers(0, B - 1, size=(n, F), dtype=np.int32)
+    label = (bins[:, 0] * 0.3 - bins[:, 1] * 0.1 + rng.normal(size=n)).astype(
+        np.float32
+    )
+    mesh = Mesh(np.array(cpu_mesh_devices[:8]), (DATA_AXIS,))
+
+    def run(params):
+        step = make_data_parallel_train_step(mesh, params, 0.1, l2_gradients)
+        return step(
+            shard_rows(bins, mesh),
+            shard_rows(label, mesh),
+            shard_rows(np.zeros(n, np.float32), mesh),
+            replicate(np.full(F, B, np.int32), mesh),
+            replicate(np.full(F, -1, np.int32), mesh),
+            replicate(np.ones(F, bool), mesh),
+        )
+
+    p1 = GrowerParams(
+        num_leaves=15, max_bin=B, min_data_in_leaf=5, axis_name=DATA_AXIS
+    )
+    _, tree_ref = run(p1)
+    _, tree = run(dataclasses.replace(p1, leaf_batch=K))
+    assert int(tree.num_leaves) == int(tree_ref.num_leaves)
+    np.testing.assert_array_equal(
+        np.asarray(tree.split_feature), np.asarray(tree_ref.split_feature)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(tree.split_bin), np.asarray(tree_ref.split_bin)
+    )
+    np.testing.assert_allclose(
+        np.asarray(tree.leaf_value),
+        np.asarray(tree_ref.leaf_value),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+
+
+def test_psum_count_per_step_does_not_scale_with_k(cpu_mesh_devices):
+    """The batched body issues ONE stacked counts-psum and ONE stacked
+    histogram-psum per step regardless of K — so per-tree collective count
+    drops by ~the committed batch factor.  Static proxy: the number of psum
+    equations in the lowered jaxpr must not grow with K."""
+    rng = np.random.default_rng(3)
+    n = 512
+    bins = rng.integers(0, B - 1, size=(n, F), dtype=np.int32)
+    label = (bins[:, 0] * 0.3 + rng.normal(size=n)).astype(np.float32)
+    mesh = Mesh(np.array(cpu_mesh_devices[:8]), (DATA_AXIS,))
+
+    def count_psums(params):
+        step = make_data_parallel_train_step(mesh, params, 0.1, l2_gradients)
+        jx = jax.make_jaxpr(step)(
+            shard_rows(bins, mesh),
+            shard_rows(label, mesh),
+            shard_rows(np.zeros(n, np.float32), mesh),
+            replicate(np.full(F, B, np.int32), mesh),
+            replicate(np.full(F, -1, np.int32), mesh),
+            replicate(np.ones(F, bool), mesh),
+        )
+        return str(jx).count("psum")
+
+    p1 = GrowerParams(
+        num_leaves=15, max_bin=B, min_data_in_leaf=5, axis_name=DATA_AXIS
+    )
+    serial = count_psums(p1)
+    batched = count_psums(dataclasses.replace(p1, leaf_batch=4))
+    assert batched <= serial + 2, (batched, serial)

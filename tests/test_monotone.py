@@ -170,3 +170,106 @@ def test_advanced_monotone_with_categoricals():
     b = lgb.train(params, lgb.Dataset(X, y, params=params), 25)
     _check_monotone(b, X, 0, +1)
     _check_monotone(b, X, 2, -1)
+
+
+# ---- monotone_penalty (reference monotone_constraints.hpp:357-366) -------
+
+def _dup_feature_hist(seed=0, n=2000, b=32):
+    """Two IDENTICAL feature columns -> exactly tied best gains, so any
+    penalty on one feature must flip the argmax to the other."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    bins = rng.integers(0, b, size=n)
+    g = rng.normal(size=n).astype(np.float32) - 0.3 * (bins > b // 2)
+    h = np.ones(n, np.float32)
+    hist = np.zeros((2, b, 3), np.float32)
+    for j in range(2):
+        np.add.at(hist[j, :, 0], bins, g)
+        np.add.at(hist[j, :, 1], bins, h)
+        np.add.at(hist[j, :, 2], bins, 1.0)
+    parent = hist[0].sum(axis=0)
+    return (
+        jnp.asarray(hist),
+        parent,
+        jnp.full((2,), b, np.int32),
+        jnp.full((2,), -1, np.int32),
+        jnp.ones((2,), bool),
+    )
+
+
+_BS_HP = dict(
+    lambda_l1=0.0,
+    lambda_l2=0.01,
+    min_data_in_leaf=5,
+    min_sum_hessian_in_leaf=1e-3,
+    min_gain_to_split=0.0,
+)
+
+
+def test_penalized_split_loses_to_unpenalized_at_matched_gain():
+    """feature 0 is monotone-constrained, feature 1 is its exact copy but
+    unconstrained: with monotone_penalty the tie must break to feature 1
+    (serial argmax alone would pick feature 0)."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.split import best_split
+
+    hist, parent, num_bins, nan_bins, mask = _dup_feature_hist()
+    mono = jnp.asarray([1, 0], jnp.int8)
+    base = best_split(
+        hist, parent[0], parent[1], parent[2], num_bins, nan_bins, mask,
+        monotone=mono, **_BS_HP,
+    )
+    assert int(base.feature) == 0  # tie -> lowest index without penalty
+    pen = best_split(
+        hist, parent[0], parent[1], parent[2], num_bins, nan_bins, mask,
+        monotone=mono, monotone_penalty=1.0,
+        leaf_depth=jnp.asarray(0, jnp.int32), **_BS_HP,
+    )
+    assert int(pen.feature) == 1
+    # the winning (unpenalized) candidate keeps its full gain
+    np.testing.assert_allclose(float(pen.gain), float(base.gain), rtol=1e-6)
+
+
+def test_monotone_penalty_decays_with_depth():
+    """The penalty factor is 1 - penalty/2^depth (penalty <= 1): deeper
+    leaves are penalized less, converging to the unpenalized gain."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.split import best_split
+
+    hist, parent, num_bins, nan_bins, mask = _dup_feature_hist(seed=1)
+    mono = jnp.asarray([1, 1], jnp.int8)  # both constrained -> both penalized
+    base = best_split(
+        hist, parent[0], parent[1], parent[2], num_bins, nan_bins, mask,
+        monotone=mono, **_BS_HP,
+    )
+    gains = []
+    for depth in (0, 1, 4):
+        c = best_split(
+            hist, parent[0], parent[1], parent[2], num_bins, nan_bins, mask,
+            monotone=mono, monotone_penalty=1.0,
+            leaf_depth=jnp.asarray(depth, jnp.int32), **_BS_HP,
+        )
+        gains.append(float(c.gain))
+    assert gains[0] < gains[1] < gains[2] <= float(base.gain) + 1e-6
+    # depth 0 -> children at depth 1 -> factor 1 - 1/2 = 0.5
+    np.testing.assert_allclose(gains[0], 0.5 * float(base.gain), rtol=1e-5)
+
+
+def test_monotone_penalty_e2e_still_monotone():
+    X, y = _make_data()
+    params = {
+        "objective": "regression",
+        "num_leaves": 31,
+        "verbosity": -1,
+        "metric": "none",
+        "monotone_constraints": [1, 0, -1, 0],
+        "monotone_penalty": 1.5,
+        "min_data_in_leaf": 5,
+    }
+    b = lgb.train(params, lgb.Dataset(X, y, params=params), 15)
+    assert len(b.models_) == 15
+    _check_monotone(b, X, 0, +1)
+    _check_monotone(b, X, 2, -1)

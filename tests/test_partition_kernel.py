@@ -143,3 +143,43 @@ def test_partition_kernel_gl_vec_matches_sort():
         )
         assert int(nl_k) == int(nl_s)
         assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_partition_kernel_batch_matches_serial_loop():
+    """K-program batched launch over DISJOINT windows == K serial kernel
+    calls (bit-equal state), including zero-cnt no-op members."""
+    from lightgbm_tpu.ops.pallas.partition import seg_partition_pallas_batch
+
+    rng = np.random.default_rng(9)
+    f, n = 11, 5000
+    n_pad = padded_rows(n)
+    bins = rng.integers(0, 256, size=(n, f)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = np.ones(n, np.float32)
+    m = np.ones(n, np.float32)
+    seg = pack_rows(
+        jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m), n_pad
+    )
+    catmask = (rng.random(256) < 0.5).astype(np.float32)
+    # disjoint windows incl. a zero-cnt member and a categorical member
+    rows = [
+        (0, 1200, 3, 120, 0, -1, 0, 0),
+        (1200, 800, 5, 80, 1, 200, 0, 0),
+        (2000, 0, 0, 10, 0, -1, 0, 0),  # no-op
+        (2500, 1500, 7, 30, 0, -1, 1, 0),  # categorical
+    ]
+    scal = jnp.asarray(rows, jnp.int32)
+    catm = jnp.broadcast_to(jnp.asarray(catmask), (4, 256))
+    got, nl_b = seg_partition_pallas_batch(
+        seg, scal, catm, f=f, n_pad=n_pad, use_cat=True, interpret=True,
+    )
+    want = seg
+    nls = []
+    for r in rows:
+        want, nl, _ = sort_partition_xla(
+            want, *(jnp.int32(v) for v in r[:7]),
+            jnp.asarray(catmask), f=f, n_pad=n_pad,
+        )
+        nls.append(int(nl))
+    assert [int(v) for v in nl_b] == nls
+    assert np.array_equal(np.asarray(got), np.asarray(want))

@@ -1,0 +1,93 @@
+"""``bagging_seed`` / ``extra_seed`` (reference: config.h — each consumer
+derives its own deterministic stream).  Contract here: leaving the seeds
+unset keeps the legacy derivation (byte-identical models, goldens untouched);
+setting one folds it into the matching RNG stream, so changing it changes
+exactly that draw and nothing else.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.default_rng(0)
+    n = 1000
+    X = rng.normal(size=(n, 6))
+    y = X[:, 0] * 1.5 - X[:, 1] + 0.5 * X[:, 2] + rng.normal(scale=0.1, size=n)
+    return X, y
+
+
+def _preds(X, y, params, nt=4):
+    bst = lgb.train(dict(params, verbosity=-1), lgb.Dataset(X, y), nt)
+    return bst.predict(X)
+
+
+BAG = {
+    "objective": "regression",
+    "num_leaves": 15,
+    "min_data_in_leaf": 5,
+    "bagging_freq": 1,
+    "bagging_fraction": 0.6,
+    "seed": 3,
+}
+
+
+def test_bagging_seed_changes_the_bag(xy):
+    X, y = xy
+    p0 = _preds(X, y, BAG)
+    p_same = _preds(X, y, BAG)
+    np.testing.assert_array_equal(p0, p_same)  # unset -> deterministic
+    p99 = _preds(X, y, dict(BAG, bagging_seed=99))
+    assert not np.allclose(p0, p99)
+    p99b = _preds(X, y, dict(BAG, bagging_seed=99))
+    np.testing.assert_array_equal(p99, p99b)  # seeded -> deterministic
+    p7 = _preds(X, y, dict(BAG, bagging_seed=7))
+    assert not np.allclose(p99, p7)
+
+
+def test_bagging_seed_does_not_touch_unbagged_training(xy):
+    """No bagging -> bagging_seed must be a no-op."""
+    X, y = xy
+    base = {k: v for k, v in BAG.items() if not k.startswith("bagging")}
+    np.testing.assert_array_equal(
+        _preds(X, y, base), _preds(X, y, dict(base, bagging_seed=99))
+    )
+
+
+def test_extra_seed_changes_the_threshold_draw(xy):
+    X, y = xy
+    base = {
+        "objective": "regression",
+        "num_leaves": 15,
+        "min_data_in_leaf": 5,
+        "extra_trees": True,
+        "seed": 3,
+    }
+    p0 = _preds(X, y, base)
+    np.testing.assert_array_equal(p0, _preds(X, y, base))
+    p123 = _preds(X, y, dict(base, extra_seed=123))
+    assert not np.allclose(p0, p123)
+    np.testing.assert_array_equal(p123, _preds(X, y, dict(base, extra_seed=123)))
+
+
+def test_extra_seed_noop_without_extra_trees(xy):
+    X, y = xy
+    base = {
+        "objective": "regression",
+        "num_leaves": 15,
+        "min_data_in_leaf": 5,
+        "seed": 3,
+    }
+    np.testing.assert_array_equal(
+        _preds(X, y, base), _preds(X, y, dict(base, extra_seed=123))
+    )
+
+
+def test_seed_aliases_resolve():
+    cfg = lgb.Config.from_params({"bagging_fraction_seed": 11})
+    assert cfg.bagging_seed == 11

@@ -394,3 +394,42 @@ def test_wide_seg_hist_int8_quantized(packed_wide):
     got = np.asarray(out)
     assert np.array_equal(got[:, :, 2], np.asarray(ref)[:, :, 2])
     assert np.allclose(got, np.asarray(ref), rtol=1e-6, atol=1e-6)
+
+
+def test_seg_hist_pallas_batch_interpret(packed):
+    """K-program batched histogram launch == K serial kernel results,
+    including a zero-cnt member (all-zero histogram)."""
+    from lightgbm_tpu.ops.pallas.seg import seg_hist_pallas, seg_hist_pallas_batch
+
+    p = packed
+    windows = [(0, 1500), (1500, 1000), (2500, 0), (2600, 2400)]
+    scal_k = jnp.asarray(windows, jnp.int32)
+    got = seg_hist_pallas_batch(
+        p["seg"], scal_k, f=p["f"], num_bins=256, n_pad=p["n_pad"],
+        interpret=True,
+    )
+    assert got.shape[0] == len(windows)
+    for i, (st, cnt) in enumerate(windows):
+        want = seg_hist_pallas(
+            p["seg"], jnp.asarray([st, cnt], jnp.int32),
+            f=p["f"], num_bins=256, n_pad=p["n_pad"], interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got[i]), np.asarray(want))
+
+
+def test_seg_hist_batch_dispatch_cpu(packed):
+    """Off-TPU dispatch: seg_hist_batch == vmapped serial seg_hist."""
+    from lightgbm_tpu.ops.pallas.seg import seg_hist_batch
+
+    p = packed
+    windows = [(0, 2000), (2000, 3000)]
+    scal_k = jnp.asarray(windows, jnp.int32)
+    got = seg_hist_batch(
+        p["seg"], scal_k, f=p["f"], num_bins=256, n_pad=p["n_pad"]
+    )
+    for i, (st, cnt) in enumerate(windows):
+        want = seg_hist(
+            p["seg"], jnp.asarray([st, cnt], jnp.int32),
+            f=p["f"], num_bins=256, n_pad=p["n_pad"],
+        )
+        np.testing.assert_array_equal(np.asarray(got[i]), np.asarray(want))

@@ -281,3 +281,98 @@ def test_fused_scan_inside_data_parallel_mesh():
     default = lgb.train(plain, lgb.Dataset(X, y, params=plain), 4)
     assert _structure(serial) == _structure(default)
     assert _structure(sharded) == _structure(serial)
+
+
+# ---- feature_contri (reference FeatureMetainfo::penalty, ---------------
+# feature_histogram.hpp:1445-1448): per-feature multiplier on the
+# improvement BEFORE the cross-feature argmax, in both engines.
+
+def _dup_hist(seed=0, n=2000, b=32):
+    rng = np.random.default_rng(seed)
+    bins = rng.integers(0, b, size=n)
+    g = rng.normal(size=n).astype(np.float32) - 0.3 * (bins > b // 2)
+    h = np.ones(n, np.float32)
+    hist = np.zeros((2, b, 3), np.float32)
+    for j in range(2):
+        np.add.at(hist[j, :, 0], bins, g)
+        np.add.at(hist[j, :, 1], bins, h)
+        np.add.at(hist[j, :, 2], bins, 1.0)
+    parent = hist[0].sum(axis=0)
+    return (
+        jnp.asarray(hist), parent, jnp.full((2,), b, np.int32),
+        jnp.full((2,), -1, np.int32), jnp.ones((2,), bool),
+    )
+
+
+_FC_HP = dict(lambda_l1=0.0, lambda_l2=0.01, min_data_in_leaf=5,
+              min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0)
+
+
+def test_feature_contri_flips_tied_argmax_xla():
+    hist, parent, num_bins, nan_bins, mask = _dup_hist()
+    base = best_split(
+        hist, parent[0], parent[1], parent[2], num_bins, nan_bins, mask,
+        **_FC_HP,
+    )
+    assert int(base.feature) == 0  # exact tie -> lowest index
+    fc = best_split(
+        hist, parent[0], parent[1], parent[2], num_bins, nan_bins, mask,
+        feature_contri=jnp.asarray([0.5, 1.0], jnp.float32), **_FC_HP,
+    )
+    assert int(fc.feature) == 1
+    np.testing.assert_allclose(float(fc.gain), float(base.gain), rtol=1e-6)
+    # and the multiplier actually scales the reported improvement
+    half = best_split(
+        hist, parent[0], parent[1], parent[2], num_bins, nan_bins, mask,
+        feature_contri=jnp.asarray([0.5, 0.5], jnp.float32), **_FC_HP,
+    )
+    np.testing.assert_allclose(float(half.gain), 0.5 * float(base.gain),
+                               rtol=1e-5)
+
+
+def test_feature_contri_flips_tied_argmax_fused():
+    hist, parent, num_bins, nan_bins, mask = _dup_hist(seed=1)
+    base = fused_best_split(
+        hist, parent[0], parent[1], parent[2], num_bins, nan_bins, mask,
+        interpret=True, **_FC_HP,
+    )
+    assert int(base.feature) == 0
+    fc = fused_best_split(
+        hist, parent[0], parent[1], parent[2], num_bins, nan_bins, mask,
+        feature_contri=jnp.asarray([0.5, 1.0], jnp.float32),
+        interpret=True, **_FC_HP,
+    )
+    assert int(fc.feature) == 1
+    np.testing.assert_allclose(float(fc.gain), float(base.gain), rtol=1e-6)
+
+
+def test_feature_contri_engines_agree():
+    hist, parent, num_bins, nan_bins, mask = _dup_hist(seed=2)
+    contri = jnp.asarray([0.25, 1.5], jnp.float32)
+    want = best_split(
+        hist, parent[0], parent[1], parent[2], num_bins, nan_bins, mask,
+        feature_contri=contri, **_FC_HP,
+    )
+    got = fused_best_split(
+        hist, parent[0], parent[1], parent[2], num_bins, nan_bins, mask,
+        feature_contri=contri, interpret=True, **_FC_HP,
+    )
+    assert int(got.feature) == int(want.feature)
+    assert int(got.bin) == int(want.bin)
+    np.testing.assert_allclose(float(got.gain), float(want.gain), rtol=5e-3,
+                               atol=1e-4)
+
+
+def test_feature_contri_e2e_moves_root_split():
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(1000, 5))
+    y = X[:, 0] * 1.5 - X[:, 1] + rng.normal(scale=0.1, size=1000)
+    base = {"objective": "regression", "num_leaves": 8, "verbosity": -1,
+            "min_data_in_leaf": 5}
+    b0 = lgb.train(base, lgb.Dataset(X, y), 1)
+    assert b0.models_[0].split_feature[0] == 0
+    b1 = lgb.train({**base, "feature_contri": [0.001, 1, 1, 1, 1]},
+                   lgb.Dataset(X, y), 1)
+    assert b1.models_[0].split_feature[0] != 0
