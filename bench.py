@@ -57,7 +57,7 @@ _PARAMS = {
 
 
 def _train_bench(X, y, timed_iters: int, warmup_iters: int = 2, params=None):
-    """(iters/sec, booster) for the Higgs-shaped workload on these rows."""
+    """(iters/sec, booster, compile stats) for the Higgs-shaped workload."""
     import jax
 
     import lightgbm_tpu as lgb
@@ -65,14 +65,21 @@ def _train_bench(X, y, timed_iters: int, warmup_iters: int = 2, params=None):
     params = params or _PARAMS
     dtrain = lgb.Dataset(X, y, params=params)
     booster = lgb.Booster(params, dtrain)
+    c0 = lgb.compile_count()
     for _ in range(warmup_iters):
         booster.update()
     jax.block_until_ready(booster._score)
+    c_warm = lgb.compile_count()
     t0 = time.perf_counter()
     for _ in range(timed_iters):
         booster.update()
     jax.block_until_ready(booster._score)
-    return timed_iters / (time.perf_counter() - t0), booster
+    ips = timed_iters / (time.perf_counter() - t0)
+    stats = {
+        "compiles_warmup": c_warm - c0,
+        "recompiles_timed": lgb.compile_count() - c_warm,
+    }
+    return ips, booster, stats
 
 
 def _time_op(fn, *args, reps: int = 3):
@@ -90,97 +97,56 @@ def _time_op(fn, *args, reps: int = 3):
     return best
 
 
-def _train_phases(booster, iters_per_sec):
-    """Per-tree training-phase breakdown (mirror of pred_phases).
+def _train_phases(X, y, iters_per_sec):
+    """Per-tree training-phase breakdown from the telemetry event stream.
 
-    The grower is one fused jit, so the phases can't be wall-clocked
-    individually; instead this measures the throughput of each phase's
-    primitive at the bench shape (histogram build, stable-sort partition,
-    best-split scan) and scales by the ROW/CALL counts the trained trees
-    actually incurred (sum of internal_count for partition, sum of
-    smaller-child counts for histograms, 2 candidate refreshes per split).
-    ``bookkeeping_ms`` is the measured per-tree remainder: state writes,
-    gradient/score updates, dispatch."""
-    import jax
-    import jax.numpy as jnp
+    A short instrumented re-fit with ``telemetry`` + ``obs_sync_timing``
+    (each phase blocks on its device values, so phase walls measure device
+    time rather than dispatch time) yields per-iteration phase timings; the
+    headline run stays uninstrumented."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs.registry import get_session
 
-    from lightgbm_tpu.ops.histogram import leaf_histogram
-    from lightgbm_tpu.ops.split import best_split
-
-    bins = booster._bins
-    n, f = bins.shape
-    gp = booster._grower_params
-    B = gp.max_bin
-    grad = jnp.ones((n,), jnp.float32)
-    hess = jnp.ones((n,), jnp.float32)
-    mask = jnp.ones((n,), jnp.float32)
-
-    hist_fn = jax.jit(
-        lambda b_, g_, h_, m_: leaf_histogram(b_, g_, h_, m_, B, method=gp.hist_method)
+    m = min(len(y), 1_000_000)  # bound the instrumented re-fit's cost
+    ses = get_session()
+    ses.reset()
+    params = {**_PARAMS, "telemetry": True, "obs_sync_timing": True}
+    dtrain = lgb.Dataset(X[:m], y[:m], params=params)
+    booster = lgb.Booster(params, dtrain)
+    try:
+        for _ in range(5):
+            booster.update()
+        events = [
+            e for e in booster.telemetry()["events"]
+            if e.get("event") == "iteration"
+        ]
+    finally:
+        ses.configure(enabled=False)
+        ses.reset()
+    # steady state only: iterations that retraced measure compile, not run
+    steady = [e for e in events if e.get("compiles_delta", 0) == 0] or events
+    n = max(1, len(steady))
+    phases = {}
+    for e in steady:
+        for k, v in e["phases"].items():
+            phases[k] = phases.get(k, 0.0) + v
+    out = {f"{k}_ms": round(v / n, 1) for k, v in sorted(phases.items())}
+    out["tree_ms"] = round(1000.0 / iters_per_sec, 1)
+    out["wall_ms"] = round(sum(e["wall_ms"] for e in steady) / n, 1)
+    trees = sum(e.get("trees_materialized", 0) for e in steady)
+    out["splits_per_tree"] = round(
+        sum(e.get("splits", 0) for e in steady) / max(1, trees), 1
     )
-    hist_s = _time_op(hist_fn, bins, grad, hess, mask)
-    hist = hist_fn(bins, grad, hess, mask)
-
-    # partition proxy: one stable argsort over the full array — the
-    # dominant primitive of the sort-based partition modes
-    keys = (jnp.arange(n, dtype=jnp.int32) % 2).astype(jnp.int8)
-    part_fn = jax.jit(lambda k_: jnp.argsort(k_))
-    part_s = _time_op(part_fn, keys)
-
-    import jax.numpy as _jnp
-
-    pg, ph, pc = (
-        _jnp.asarray(float(hist[:, :, i].sum()) / f, _jnp.float32)
-        for i in range(3)
+    out["recompiles_after_warmup"] = sum(
+        e.get("compiles_delta", 0) for e in events[2:]
     )
-    scan_fn = jax.jit(
-        lambda h_: best_split(
-            h_, pg, ph, pc, booster._num_bins, booster._nan_bins,
-            jnp.ones((f,), bool),
-            lambda_l1=0.0, lambda_l2=0.0, min_data_in_leaf=gp.min_data_in_leaf,
-            min_sum_hessian_in_leaf=1e-3, min_gain_to_split=0.0,
-        )
+    out["rows"] = m
+    out["note"] = (
+        "telemetry event stream, obs_sync_timing on (phase walls include "
+        "device time); wall_ms is the instrumented re-fit, tree_ms the "
+        "headline run"
     )
-    scan_s = _time_op(scan_fn, hist)
-
-    # actual per-tree work from the trained trees
-    def child_count(tree, c):
-        return (
-            int(tree.internal_count[c]) if c >= 0 else int(tree.leaf_count[~c])
-        )
-
-    splits = part_rows = small_rows = 0
-    trees = [t for t in booster.models_ if len(t.internal_count)]
-    for t in trees:
-        nn = len(t.internal_count)
-        splits += nn
-        part_rows += int(t.internal_count.sum())
-        small_rows += sum(
-            min(
-                child_count(t, int(t.left_child[i])),
-                child_count(t, int(t.right_child[i])),
-            )
-            for i in range(nn)
-        )
-    n_trees = max(1, len(trees))
-    splits, part_rows, small_rows = (
-        splits / n_trees, part_rows / n_trees, small_rows / n_trees
-    )
-
-    tree_ms = 1000.0 / iters_per_sec
-    partition_ms = part_s / n * part_rows * 1000.0
-    histogram_ms = hist_s / n * small_rows * 1000.0
-    split_scan_ms = scan_s * 2.0 * splits * 1000.0
-    bookkeeping_ms = max(0.0, tree_ms - partition_ms - histogram_ms - split_scan_ms)
-    return {
-        "tree_ms": round(tree_ms, 1),
-        "partition_ms": round(partition_ms, 1),
-        "histogram_ms": round(histogram_ms, 1),
-        "split_scan_ms": round(split_scan_ms, 1),
-        "bookkeeping_ms": round(bookkeeping_ms, 1),
-        "splits_per_tree": round(splits, 1),
-        "note": "primitive-throughput decomposition (phases share one jit)",
-    }
+    return out
 
 
 def _leaf_batch_sweep(X, y, timed_iters: int):
@@ -193,7 +159,7 @@ def _leaf_batch_sweep(X, y, timed_iters: int):
     ]
     out = {}
     for k in ks:
-        ips, _ = _train_bench(
+        ips, _b, _st = _train_bench(
             X, y, timed_iters, warmup_iters=1,
             params={**_PARAMS, "leaf_batch": k},
         )
@@ -226,12 +192,12 @@ def main() -> None:
     timed_iters = int(os.environ.get("BENCH_ITERS", 10))
 
     X, y = _make_data(n_rows, n_features)
-    iters_per_sec, booster = _train_bench(X, y, timed_iters)
+    iters_per_sec, booster, train_compiles = _train_bench(X, y, timed_iters)
     baseline = 3.8  # reference CPU iters/sec on Higgs (BASELINE.md)
 
     # phase breakdown BEFORE the predict section replicates models_
     try:
-        train_phases = _train_phases(booster, iters_per_sec)
+        train_phases = _train_phases(X, y, iters_per_sec)
     except Exception as e:  # diagnostics must not sink the headline number
         train_phases = {"error": repr(e)}
     sweep_iters = int(os.environ.get("BENCH_SWEEP_ITERS", min(timed_iters, 3)))
@@ -244,7 +210,7 @@ def main() -> None:
     iters_per_sec_secondary = None
     if on_accel and secondary_rows and secondary_rows < n_rows:
         Xs, ys = X[:secondary_rows], y[:secondary_rows]
-        iters_per_sec_secondary, _ = _train_bench(Xs, ys, timed_iters)
+        iters_per_sec_secondary, _, _ = _train_bench(Xs, ys, timed_iters)
 
     # batch-inference throughput. The fork's 84k preds/s (original.md) was
     # measured on a 376-tree model; replicate the trained trees to the same
@@ -296,6 +262,7 @@ def main() -> None:
         "pred_warmup_s": round(pred_warmup_dt, 2),
         "pred_phases": pred_phases,
         "train_phases": train_phases,
+        "train_compiles": train_compiles,
         "leaf_batch_sweep_iters_per_sec": leaf_batch_sweep,
     }
     if iters_per_sec_secondary is not None:

@@ -20,6 +20,7 @@ LGBMError = LightGBMError
 from .boosting.gbdt import Booster
 from .callback import (
     EarlyStopException,
+    TelemetryCallback,
     early_stopping,
     log_evaluation,
     print_evaluation,
@@ -38,8 +39,13 @@ from .plotting import (
     plot_split_value_histogram,
     plot_tree,
 )
+from .obs import (
+    compile_count,
+    compile_counts_by_label,
+    get_session,
+)
 from .parser import register_parser
-from .utils.log import register_logger
+from .utils.log import register_logger, unregister_logger
 from .utils.timer import global_timer
 
 try:
@@ -64,8 +70,13 @@ __all__ = [
     "reset_parameter",
     "EarlyStopException",
     "register_logger",
+    "unregister_logger",
     "register_parser",
     "global_timer",
+    "TelemetryCallback",
+    "get_session",
+    "compile_count",
+    "compile_counts_by_label",
     "plot_importance",
     "plot_metric",
     "plot_split_value_histogram",

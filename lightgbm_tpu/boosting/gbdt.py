@@ -18,6 +18,7 @@ Per-iteration device work (all jitted, scores stay in HBM):
 from __future__ import annotations
 
 import math
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -27,6 +28,8 @@ import jax.numpy as jnp
 from ..config import Config
 from ..dataset import Dataset
 from ..metrics import Metric, create_metric
+from ..obs.jit import compile_count as _obs_compile_count
+from ..obs.registry import get_session
 from ..objectives import ObjectiveFunction, create_objective
 from ..ops.grower import (
     GrowerParams,
@@ -162,7 +165,8 @@ class Booster:
         if pend is None:
             return
         self._pending = None
-        self._process_pending(pend)
+        with get_session().phase("host_materialize"):
+            self._process_pending(pend)
 
     def _process_pending(self, pend: dict) -> None:
         decoded = []
@@ -245,24 +249,26 @@ class Booster:
                     self._tree_rng(),
                 )
                 ta = self._quant_renew(ta, leaf_id, grad[kk], hess[kk], mask)
-                shrunk = ta.leaf_value * self._shrinkage_rate
-                self._score = self._score.at[kk].add(shrunk[leaf_id])
-                for entry in self._valid:
-                    entry.score = entry.score.at[kk].set(
-                        add_tree_to_score(
-                            entry.score[kk],
-                            entry.bins,
-                            self._nan_bins,
-                            ta.split_feature,
-                            ta.split_bin,
-                            ta.default_left,
-                            ta.left_child,
-                            ta.right_child,
-                            shrunk,
-                            ta.split_is_cat,
-                            ta.cat_mask,
+                with get_session().phase("score_update"):
+                    shrunk = ta.leaf_value * self._shrinkage_rate
+                    self._score = self._score.at[kk].add(shrunk[leaf_id])
+                    for entry in self._valid:
+                        entry.score = entry.score.at[kk].set(
+                            add_tree_to_score(
+                                entry.score[kk],
+                                entry.bins,
+                                self._nan_bins,
+                                ta.split_feature,
+                                ta.split_bin,
+                                ta.default_left,
+                                ta.left_child,
+                                ta.right_child,
+                                shrunk,
+                                ta.split_is_cat,
+                                ta.cat_mask,
+                            )
                         )
-                    )
+                    get_session().sync(self._score)
                 ints_d, floats_d = pack_tree_arrays(ta)
                 ints_d.copy_to_host_async()
                 floats_d.copy_to_host_async()
@@ -274,7 +280,8 @@ class Booster:
         self._pending = {"classes": pend, "rate": self._shrinkage_rate}
         self._iter += 1
         if prev is not None:
-            self._process_pending(prev)
+            with get_session().phase("host_materialize"):
+                self._process_pending(prev)
             if self._finished:
                 # the previous iteration found no split: training stopped
                 # THERE, so the iteration just dispatched must leave no trace
@@ -334,6 +341,12 @@ class Booster:
         train_set.construct()
         self.train_set = train_set
         cfg = self.config
+        if cfg.telemetry:
+            get_session().configure(
+                enabled=True,
+                sync_timing=cfg.obs_sync_timing,
+                sink_path=cfg.telemetry_out,
+            )
         self.objective = create_objective(cfg)
         md = train_set.metadata
         n = train_set.num_data
@@ -860,8 +873,11 @@ class Booster:
         src/boosting/gbdt.cpp:59 tree_learner selection)."""
         from ..utils.timer import global_timer
 
-        with global_timer.timed("tree/grow"):
-            return self._grow_one_inner(grad_k, hess_k, mask, feature_mask, rng)
+        ses = get_session()
+        with global_timer.timed("tree/grow"), ses.phase("grow"):
+            res = self._grow_one_inner(grad_k, hess_k, mask, feature_mask, rng)
+            ses.sync(res)
+            return res
 
     def _grow_one_inner(self, grad_k, hess_k, mask, feature_mask, rng):
         if self._mesh is not None:
@@ -1440,6 +1456,10 @@ class Booster:
         )
         if any_pad:
             mask = mask * self._ones_mask
+        ses = get_session()
+        if ses.enabled:
+            # host pull of a scalar; only paid when telemetry is on
+            ses.set_gauge("bagging_rows", int(jnp.sum(mask > 0)))
         return mask, grad, hess
 
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
@@ -1448,8 +1468,72 @@ class Booster:
         Returns True when training cannot continue (no positive-gain split),
         mirroring the reference's is_finished flag.
         """
+        ses = get_session()
+        if not ses.enabled:
+            return self._update_impl(train_set, fobj)
+        it = self._iter
+        trees_before = len(self._bin_records_store)
+        compiles_before = _obs_compile_count()
+        t0 = time.perf_counter()
+        ses.begin_iteration()
+        try:
+            finished = self._update_impl(train_set, fobj)
+        finally:
+            phases = ses.end_iteration()
+        # under obs_sync_timing wall_ms is the fully synchronized iteration
+        # time; otherwise it is dispatch time (async runtime)
+        ses.sync(self._score)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        # host bookkeeping (and hence these records) lags one iteration on
+        # the pipelined path — splits here count trees MATERIALIZED this call
+        new_recs = [r for r in self._bin_records_store[trees_before:] if r]
+        compiles_now = _obs_compile_count()
+        event = {
+            "event": "iteration",
+            "iter": it,
+            "wall_ms": wall_ms,
+            "phases": {k2: v * 1e3 for k2, v in phases.items()},
+            "compile_count": compiles_now,
+            "compiles_delta": compiles_now - compiles_before,
+            "trees_materialized": len(new_recs),
+            "splits": int(sum(len(r["split_feature"]) for r in new_recs)),
+            "leaf_batch": int(self.config.leaf_batch),
+            "finished": bool(finished),
+        }
+        if self._mesh is not None and self.config.tree_learner == "data":
+            from ..parallel import psum_bytes_per_iteration
+
+            k = max(1, self.num_tree_per_iteration)
+            per_tree = (
+                event["splits"] // max(1, len(new_recs))
+                if new_recs
+                else max(1, self.config.num_leaves - 1)
+            )
+            coll = psum_bytes_per_iteration(
+                per_tree,
+                int(self._bins.shape[1]),
+                int(np.asarray(self._num_bins).max(initial=1)),
+                leaf_batch=int(self.config.leaf_batch),
+                mesh_size=int(self._mesh.devices.size),
+            )
+            coll = {k2: v * k for k2, v in coll.items()}
+            event["collective"] = coll
+            ses.set_gauge("collective_hist_bytes", coll["hist_bytes"])
+            ses.set_gauge("collective_count_bytes", coll["count_bytes"])
+            ses.set_gauge(
+                "collective_ring_bytes_per_device",
+                coll["ring_bytes_per_device"],
+            )
+        ses.inc("iterations")
+        # deferred: the engine annotates eval metrics into this event before
+        # the JSONL line is flushed (next record / flush_pending)
+        ses.record(event, defer=True)
+        return finished
+
+    def _update_impl(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         if train_set is not None and train_set is not self.train_set:
             self._init_train(train_set)
+        ses = get_session()
         cfg = self.config
         k = self.num_tree_per_iteration
         n = self.train_set.num_data
@@ -1469,8 +1553,12 @@ class Booster:
             and type(self) is Booster
             and eff_len >= k  # init/boost-from-avg settled
         ):
-            grad, hess = self._get_gradients()
-            mask, grad, hess = self._sample(grad, hess)
+            with ses.phase("gradients"):
+                grad, hess = self._get_gradients()
+                ses.sync(grad)
+            with ses.phase("sample"):
+                mask, grad, hess = self._sample(grad, hess)
+                ses.sync(mask)
             feature_mask = self._feature_mask_for_iter()
             return self._update_pipelined(grad, hess, mask, feature_mask, k)
 
@@ -1493,7 +1581,9 @@ class Booster:
                         self._score = self._score.at[kk].add(s)
                         for entry in self._valid:
                             entry.score = entry.score.at[kk].add(s)
-            grad, hess = self._get_gradients()
+            with ses.phase("gradients"):
+                grad, hess = self._get_gradients()
+                ses.sync(grad)
         else:
             if self._multiproc:
                 raise ValueError(
@@ -1516,7 +1606,9 @@ class Booster:
             hess = jnp.asarray(h)
 
         # bagging / GOSS (reference: SampleStrategy::Bagging gbdt.cpp:384)
-        mask, grad, hess = self._sample(grad, hess)
+        with ses.phase("sample"):
+            mask, grad, hess = self._sample(grad, hess)
+            ses.sync(mask)
         feature_mask = self._feature_mask_for_iter()
 
         should_continue = False
@@ -1534,7 +1626,8 @@ class Booster:
                 ta = self._quant_renew(ta, leaf_id, grad[kk], hess[kk], mask)
                 # two bulk transfers instead of ~14 small ones (remote TPU
                 # round-trips dominate otherwise)
-                ta_host = fetch_tree_arrays(ta)
+                with get_session().phase("host_materialize"):
+                    ta_host = fetch_tree_arrays(ta)
                 n_leaves = int(ta_host.num_leaves)
             else:
                 n_leaves = 1
@@ -1804,6 +1897,19 @@ class Booster:
         raise ValueError("dataset was not added with add_valid")
 
     # =============================================================== predict
+    def telemetry(self) -> Dict[str, Any]:
+        """Snapshot of the process-global telemetry session: per-iteration
+        events, counters/gauges, and the global jit retrace count."""
+        ses = get_session()
+        ses.flush_pending()
+        return {
+            "enabled": ses.enabled,
+            "events": list(ses.events),
+            "counters": dict(ses.counters),
+            "gauges": dict(ses.gauges),
+            "compile_count": _obs_compile_count(),
+        }
+
     def current_iteration(self) -> int:
         return self._iter
 

@@ -184,3 +184,31 @@ def early_stopping(
 
     _callback.order = 30
     return _callback
+
+class TelemetryCallback:
+    """Collect each iteration's telemetry event (phases, compile counts,
+    eval results) into ``self.history`` — requires ``telemetry=True`` in the
+    training params so the obs session records events."""
+
+    order = 25
+    before_iteration = False
+
+    def __init__(self) -> None:
+        self.history: List[Dict[str, Any]] = []
+
+    def __call__(self, env: CallbackEnv) -> None:
+        from .obs.registry import get_session
+
+        ses = get_session()
+        if not ses.enabled:
+            return
+        for ev in ses.events:
+            if ev.get("event") == "iteration" and ev.get("iter") == env.iteration:
+                entry = dict(ev)
+                if env.evaluation_result_list:
+                    entry["eval"] = {
+                        f"{item[0]}/{item[1]}": item[2]
+                        for item in env.evaluation_result_list
+                    }
+                self.history.append(entry)
+                break

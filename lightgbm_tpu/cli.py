@@ -74,6 +74,8 @@ def run_train(params: Dict[str, Any], cfg: Config) -> None:
     out = params.get("output_model", "LightGBM_model.txt")
     booster.save_model(out)
     print(f"Finished training; model written to {out}")
+    if cfg.telemetry and cfg.telemetry_out:
+        print(f"Telemetry events written to {cfg.telemetry_out}")
 
 
 def run_predict(params: Dict[str, Any], cfg: Config) -> None:

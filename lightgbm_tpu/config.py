@@ -166,6 +166,10 @@ _PARAM_ALIASES: Dict[str, str] = {
     "ndcg_at": "eval_at",
     "map_eval_at": "eval_at",
     "map_at": "eval_at",
+    # observability
+    "telemetry_output": "telemetry_out",
+    "telemetry_file": "telemetry_out",
+    "trace_dir": "profile_trace_dir",
     # network
     "num_machine": "num_machines",
     "local_port": "local_listen_port",
@@ -353,6 +357,16 @@ class Config:
     path_smooth: float = 0.0
     interaction_constraints: Any = ""
     verbosity: int = 1
+    # Observability (lightgbm_tpu/obs/): structured per-iteration telemetry,
+    # optional JSONL sink, per-phase block_until_ready timing, and a
+    # jax.profiler trace window over iterations [profile_iter_start,
+    # profile_iter_end] (end < 0 = until training ends)
+    telemetry: bool = False
+    telemetry_out: str = ""
+    obs_sync_timing: bool = False
+    profile_trace_dir: str = ""
+    profile_iter_start: int = 0
+    profile_iter_end: int = -1
     use_quantized_grad: bool = False
     num_grad_quant_bins: int = 4
     quant_train_renew_leaf: bool = False

@@ -17,6 +17,8 @@ from .boosting.gbdt import Booster
 from .callback import CallbackEnv, EarlyStopException, early_stopping, log_evaluation
 from .config import Config
 from .dataset import Dataset
+from .obs.profiler import TraceWindow
+from .obs.registry import get_session
 from .utils.log import log_info
 from .utils.timer import global_timer
 
@@ -38,6 +40,22 @@ def train(
     global_timer.reset()
     params = dict(params or {})
     cfg = Config.from_params(params)
+    ses = get_session()
+    if cfg.telemetry:
+        ses.configure(
+            enabled=True,
+            sync_timing=cfg.obs_sync_timing,
+            sink_path=cfg.telemetry_out,
+        )
+    trace = (
+        TraceWindow(
+            cfg.profile_trace_dir,
+            start_iter=cfg.profile_iter_start,
+            end_iter=cfg.profile_iter_end,
+        )
+        if cfg.profile_trace_dir
+        else None
+    )
     if "num_iterations" in cfg.raw:
         num_boost_round = cfg.num_iterations
     if cfg.objective in ("none", "custom", "na", "null", "") and fobj is None:
@@ -100,8 +118,12 @@ def train(
                         evaluation_result_list=None,
                     )
                 )
+            if trace is not None:
+                trace.on_iteration_start(it)
             with global_timer.timed("boosting/update"):
                 is_finished = booster.update(fobj=fobj)
+            if trace is not None:
+                trace.on_iteration_end(it)
 
             # periodic model snapshot (reference GBDT::Train gbdt.cpp:258)
             sf = booster.config.snapshot_freq
@@ -119,6 +141,14 @@ def train(
                             [(train_data_name, n, v, hib) for (_, n, v, hib) in res]
                         )
                     evaluation_result_list.extend(booster.eval_valid(feval))
+                if ses.enabled and evaluation_result_list:
+                    # lands inside the deferred iteration JSONL line
+                    ses.annotate_last({
+                        "eval": {
+                            f"{d}/{n}": v
+                            for (d, n, v, _hib) in evaluation_result_list
+                        }
+                    })
             for cb in callbacks_after:
                 cb(
                     CallbackEnv(
@@ -135,6 +165,10 @@ def train(
     except EarlyStopException as e:
         booster.best_iteration = e.best_iteration + 1
         evaluation_result_list = e.best_score
+    finally:
+        if trace is not None:
+            trace.close()
+        ses.flush_pending()
     booster.best_score = {}
     for item in evaluation_result_list or []:
         data_name, eval_name, val = item[0], item[1], item[2]

@@ -18,6 +18,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..config import Config
+from ..obs.jit import instrumented_jit
 
 _EPS = 1e-15
 
@@ -392,7 +393,7 @@ class MultiLoglossMetric(Metric):
             )
         global _mlogloss_device_jit
         if _mlogloss_device_jit is None:
-            _mlogloss_device_jit = jax.jit(_mlogloss_device)
+            _mlogloss_device_jit = instrumented_jit(_mlogloss_device, label="metrics/mlogloss")
         total = _mlogloss_device_jit(
             score_dev, self._label_dev, self._weight_dev
         )

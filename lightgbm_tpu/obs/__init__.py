@@ -1,0 +1,43 @@
+"""Unified training/inference telemetry.
+
+One process-global :class:`TelemetrySession` that every hot path reports
+into:
+
+* per-iteration event records (phase walls, commit counts, bagging counts,
+  eval metrics) with an optional JSONL sink — ``registry``;
+* compile accounting — ``instrumented_jit`` counts actual retraces at every
+  ``jax.jit`` call site, ``compile_count()`` is the global no-recompile
+  invariant — ``jit``;
+* collective accounting — the data-parallel grower's psum bytes, modeled
+  analytically (``parallel.psum_bytes_per_iteration``) and recorded as
+  gauges;
+* ``jax.profiler`` trace capture over an iteration window — ``profiler``.
+
+Enable with ``telemetry=True`` (params/Config), stream to a file with
+``telemetry_out=<path.jsonl>``, make phase walls measure device time with
+``obs_sync_timing=True``.  See README "Observability".
+"""
+
+from .jit import (  # noqa: F401
+    compile_count,
+    compile_counts_by_label,
+    instrumented_jit,
+    note_compile,
+)
+from .profiler import TraceWindow  # noqa: F401
+from .registry import (  # noqa: F401
+    TelemetrySession,
+    get_session,
+    session_disabled,
+)
+
+__all__ = [
+    "TelemetrySession",
+    "get_session",
+    "session_disabled",
+    "instrumented_jit",
+    "note_compile",
+    "compile_count",
+    "compile_counts_by_label",
+    "TraceWindow",
+]

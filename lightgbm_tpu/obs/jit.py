@@ -1,0 +1,70 @@
+"""Compile accounting: count actual XLA retraces across the whole library.
+
+``jax.jit`` only re-invokes the wrapped Python callable on a trace-cache
+miss, so wrapping the function with a counter increment counts retraces
+EXACTLY — including AOT ``fn.lower(...).compile()`` paths, which trace once
+per lower.  Every ``jax.jit`` call site in the library routes through
+:func:`instrumented_jit`; the streaming predictor's executable cache
+additionally reports each compiled bucket via :func:`note_compile`, so
+``compile_count()`` is the one process-global number a no-recompile test can
+assert on (generalizing ``predict.streaming_compile_count()``).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+
+_lock = threading.Lock()
+_count = 0
+_by_label: Dict[str, int] = {}
+
+
+def note_compile(label: str = "jit") -> None:
+    """Record one trace/compile under ``label``."""
+    global _count
+    with _lock:
+        _count += 1
+        _by_label[label] = _by_label.get(label, 0) + 1
+
+
+def compile_count() -> int:
+    """Total traces/compiles this process (instrumented jits + the
+    streaming predictor's AOT bucket executables)."""
+    return _count
+
+
+def compile_counts_by_label() -> Dict[str, int]:
+    """Per-call-site breakdown of :func:`compile_count`."""
+    with _lock:
+        return dict(_by_label)
+
+
+def instrumented_jit(fun=None, *, label: Optional[str] = None, **jit_kwargs):
+    """Drop-in ``jax.jit`` that counts retraces.
+
+    Usable like ``jax.jit``: direct call, decorator, or through
+    ``functools.partial``-style keyword binding::
+
+        f = instrumented_jit(impl)
+        @instrumented_jit
+        def g(x): ...
+        @functools.partial(instrumented_jit, static_argnames=("n",))
+        def h(x, n): ...
+
+    ``functools.wraps`` preserves ``__wrapped__`` so jax's signature
+    inspection (static_argnames resolution) sees the original function.
+    """
+    if fun is None:
+        return functools.partial(instrumented_jit, label=label, **jit_kwargs)
+    name = label or getattr(fun, "__name__", "jit")
+
+    @functools.wraps(fun)
+    def _traced(*args: Any, **kwargs: Any):
+        note_compile(name)
+        return fun(*args, **kwargs)
+
+    return jax.jit(_traced, **jit_kwargs)

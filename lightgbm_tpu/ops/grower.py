@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.jit import instrumented_jit
 from .histogram import leaf_histogram
 from .split import CatParams, SplitCandidate, best_split, leaf_gain, leaf_output
 
@@ -476,7 +477,7 @@ def _set_cand(
     ])
 
 
-@jax.jit
+@instrumented_jit
 def pack_tree_arrays(ta: "TreeArrays"):
     """Pack a TreeArrays into (ints, floats) flat vectors so the host can
     fetch a whole tree in two transfers instead of ~14 (each transfer is a
@@ -553,7 +554,7 @@ def fetch_tree_arrays(ta: "TreeArrays") -> "TreeArrays":
     return unpack_tree_arrays(np.asarray(ints_d), np.asarray(floats_d), nn, L)
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
+@functools.partial(instrumented_jit, static_argnames=("params",))
 def grow_tree(
     bins: jnp.ndarray,  # [N, F] int32
     grad: jnp.ndarray,  # [N] f32 (bagging/GOSS weights already applied)
@@ -781,6 +782,14 @@ def grow_tree(
 
     def cand_for_leaf(hist, g, h, c, fm, lb=None, ub=None, pout=0.0,
                       rand=None, cpen=None, adv=None, depth=None):
+        with jax.named_scope("split_scan"):
+            return _cand_for_leaf_impl(
+                hist, g, h, c, fm, lb=lb, ub=ub, pout=pout,
+                rand=rand, cpen=cpen, adv=adv, depth=depth,
+            )
+
+    def _cand_for_leaf_impl(hist, g, h, c, fm, lb=None, ub=None, pout=0.0,
+                            rand=None, cpen=None, adv=None, depth=None):
         """Leaf candidate with the distributed-mode plumbing: per-feature
         operand slicing + winner all-reduce under feature-parallel; voting
         election happens inside _candidate_for_leaf."""
@@ -1303,21 +1312,22 @@ def grow_tree(
                     jnp.where(mine, glv.astype(jnp.float32), 0.0),
                     p.axis_name,
                 )
-            order, nleft, nright = sort_partition(
-                st.order,
-                begin_l,
-                seg_cnt_l,
-                feat,
-                tbin,
-                dl.astype(jnp.int32),
-                nan_bins[feat],
-                cis.astype(jnp.int32),
-                cmask.astype(jnp.float32),
-                f=f_seg,
-                n_pad=n_pad_seg,
-                wide=seg_wide,
-                gl_vec=gl_vec,
-            )
+            with jax.named_scope("partition"):
+                order, nleft, nright = sort_partition(
+                    st.order,
+                    begin_l,
+                    seg_cnt_l,
+                    feat,
+                    tbin,
+                    dl.astype(jnp.int32),
+                    nan_bins[feat],
+                    cis.astype(jnp.int32),
+                    cmask.astype(jnp.float32),
+                    f=f_seg,
+                    n_pad=n_pad_seg,
+                    wide=seg_wide,
+                    gl_vec=gl_vec,
+                )
             if p.axis_name is not None:
                 # global smaller-child choice (see gather-mode comment)
                 left_smaller = lax.psum(nleft, p.axis_name) <= lax.psum(
@@ -1327,7 +1337,8 @@ def grow_tree(
                 left_smaller = nleft <= nright
             child_start = begin_l + jnp.where(left_smaller, 0, nleft)
             child_cnt = jnp.where(left_smaller, nleft, nright)
-            sm = _seg_hist(order, child_start, child_cnt)
+            with jax.named_scope("histogram"):
+                sm = _seg_hist(order, child_start, child_cnt)
             leaf_id = st.leaf_id
         elif use_ordered:
             # stable in-place partition of the parent's contiguous
@@ -1339,11 +1350,12 @@ def grow_tree(
                 0,
                 len(pcaps) - 1,
             ).astype(jnp.int32)
-            order, nleft = lax.switch(
-                pbucket,
-                part_branches,
-                (st.order, begin_l, cnt_l, feat, tbin, dl, cis, cmask),
-            )
+            with jax.named_scope("partition"):
+                order, nleft = lax.switch(
+                    pbucket,
+                    part_branches,
+                    (st.order, begin_l, cnt_l, feat, tbin, dl, cis, cmask),
+                )
             nright = cnt_l - nleft
             leaf_id = st.leaf_id
             if p.axis_name is not None:
@@ -1363,11 +1375,12 @@ def grow_tree(
             cbucket = jnp.clip(
                 jnp.searchsorted(caps_arr, tc, side="left"), 0, len(caps) - 1
             ).astype(jnp.int32)
-            sm = lax.switch(
-                cbucket,
-                hist_branches_ordered,
-                (order, child_start, child_cnt),
-            )
+            with jax.named_scope("histogram"):
+                sm = lax.switch(
+                    cbucket,
+                    hist_branches_ordered,
+                    (order, child_start, child_cnt),
+                )
         elif use_gather:
             # gather mode: the child's rows are compacted into a
             # static-capacity buffer (jnp.nonzero with static size) and the
@@ -1412,7 +1425,8 @@ def grow_tree(
             bucket = jnp.clip(
                 jnp.searchsorted(caps_arr, tc, side="left"), 0, len(caps) - 1
             ).astype(jnp.int32)
-            sm = lax.switch(bucket, hist_branches, (leaf_id == target) & can_split)
+            with jax.named_scope("histogram"):
+                sm = lax.switch(bucket, hist_branches, (leaf_id == target) & can_split)
         else:
             order = st.order
             begin_l = nleft = nright = jnp.int32(0)
@@ -1429,64 +1443,66 @@ def grow_tree(
             left_smaller = c_lc <= c_rc
             target = jnp.where(left_smaller, l, nl)
             mask = count_mask * (leaf_id == target) * can_split
-            sm = leaf_histogram(
-                bins_loc, grad, hess, mask, B,
-                method=p.hist_method,
-                axis_name=hist_axis, quant_scales=quant_scales,
-            )
+            with jax.named_scope("histogram"):
+                sm = leaf_histogram(
+                    bins_loc, grad, hess, mask, B,
+                    method=p.hist_method,
+                    axis_name=hist_axis, quant_scales=quant_scales,
+                )
 
         def _set1(arr, idx, val):
             """Value-preserving write: old value back when not splitting."""
             return arr.at[idx].set(jnp.where(can_split, val, arr[idx]))
 
-        # ---- record node t (reference Tree::Split, src/io/tree.cpp:65)
-        pg, ph, pc = st.leaf_g[l], st.leaf_h[l], st.leaf_cnt[l]
-        left_child = _set1(st.left_child, t, -(l + 1))
-        right_child = _set1(st.right_child, t, -(nl + 1))
-        par = st.leaf_parent[l]
-        is_r = st.leaf_is_right[l]
-        fix = (node_ids == par) & (par >= 0) & can_split
-        left_child = jnp.where(fix & ~is_r, t, left_child)
-        right_child = jnp.where(fix & is_r, t, right_child)
+        with jax.named_scope("bookkeeping"):
+            # ---- record node t (reference Tree::Split, src/io/tree.cpp:65)
+            pg, ph, pc = st.leaf_g[l], st.leaf_h[l], st.leaf_cnt[l]
+            left_child = _set1(st.left_child, t, -(l + 1))
+            right_child = _set1(st.right_child, t, -(nl + 1))
+            par = st.leaf_parent[l]
+            is_r = st.leaf_is_right[l]
+            fix = (node_ids == par) & (par >= 0) & can_split
+            left_child = jnp.where(fix & ~is_r, t, left_child)
+            right_child = jnp.where(fix & is_r, t, right_child)
 
-        split_feature = _set1(st.split_feature, t, feat)
-        split_bin = _set1(st.split_bin, t, tbin)
-        split_gain = _set1(st.split_gain, t, c_gain + p.min_gain_to_split)
-        default_left = _set1(st.default_left, t, dl)
-        split_is_cat = _set1(st.split_is_cat, t, cis)
-        node_cat_mask = _set1(st.node_cat_mask, t, cmask)
-        internal_value = _set1(
-            st.internal_value,
-            t,
-            leaf_output(pg, ph, p.lambda_l1, p.lambda_l2, p.max_delta_step),
-        )
-        internal_weight = _set1(st.internal_weight, t, ph)
-        internal_count = _set1(st.internal_count, t, pc)
+            split_feature = _set1(st.split_feature, t, feat)
+            split_bin = _set1(st.split_bin, t, tbin)
+            split_gain = _set1(st.split_gain, t, c_gain + p.min_gain_to_split)
+            default_left = _set1(st.default_left, t, dl)
+            split_is_cat = _set1(st.split_is_cat, t, cis)
+            node_cat_mask = _set1(st.node_cat_mask, t, cmask)
+            internal_value = _set1(
+                st.internal_value,
+                t,
+                leaf_output(pg, ph, p.lambda_l1, p.lambda_l2, p.max_delta_step),
+            )
+            internal_weight = _set1(st.internal_weight, t, ph)
+            internal_count = _set1(st.internal_count, t, pc)
 
-        # ---- leaf bookkeeping
-        lg, lh, lc = c_lg, c_lh, c_lc
-        rg, rh, rc = c_rg, c_rh, c_rc
-        leaf_g = _set1(_set1(st.leaf_g, l, lg), nl, rg)
-        leaf_h = _set1(_set1(st.leaf_h, l, lh), nl, rh)
-        leaf_cnt = _set1(_set1(st.leaf_cnt, l, lc), nl, rc)
-        d_new = st.leaf_depth[l] + 1
-        leaf_depth = _set1(_set1(st.leaf_depth, l, d_new), nl, d_new)
-        leaf_parent = _set1(_set1(st.leaf_parent, l, t), nl, t)
-        leaf_is_right = _set1(
-            _set1(st.leaf_is_right, l, jnp.asarray(False)), nl, jnp.asarray(True)
-        )
+            # ---- leaf bookkeeping
+            lg, lh, lc = c_lg, c_lh, c_lc
+            rg, rh, rc = c_rg, c_rh, c_rc
+            leaf_g = _set1(_set1(st.leaf_g, l, lg), nl, rg)
+            leaf_h = _set1(_set1(st.leaf_h, l, lh), nl, rh)
+            leaf_cnt = _set1(_set1(st.leaf_cnt, l, lc), nl, rc)
+            d_new = st.leaf_depth[l] + 1
+            leaf_depth = _set1(_set1(st.leaf_depth, l, d_new), nl, d_new)
+            leaf_parent = _set1(_set1(st.leaf_parent, l, t), nl, t)
+            leaf_is_right = _set1(
+                _set1(st.leaf_is_right, l, jnp.asarray(False)), nl, jnp.asarray(True)
+            )
 
-        # ---- histograms: smaller child measured, sibling by subtraction
-        parent_hist = st.hist_buf[l]
-        other = parent_hist - sm
-        left_hist = jnp.where(left_smaller, sm, other)
-        right_hist = jnp.where(left_smaller, other, sm)
-        hist_buf = st.hist_buf.at[l].set(
-            jnp.where(can_split, left_hist, parent_hist)
-        )
-        hist_buf = hist_buf.at[nl].set(
-            jnp.where(can_split, right_hist, st.hist_buf[nl])
-        )
+            # ---- histograms: smaller child measured, sibling by subtraction
+            parent_hist = st.hist_buf[l]
+            other = parent_hist - sm
+            left_hist = jnp.where(left_smaller, sm, other)
+            right_hist = jnp.where(left_smaller, other, sm)
+            hist_buf = st.hist_buf.at[l].set(
+                jnp.where(can_split, left_hist, parent_hist)
+            )
+            hist_buf = hist_buf.at[nl].set(
+                jnp.where(can_split, right_hist, st.hist_buf[nl])
+            )
 
         # ---- monotone bounds for the children.
         # basic: split midpoint partitions the parent's output interval
@@ -1737,7 +1753,8 @@ def grow_tree(
                 depth=dv,
             )
 
-        cand2 = jax.vmap(_child_cand)(hist2, g2, h2, c2, fm2, po2, *opt2)
+        with jax.named_scope("candidate_refresh"):
+            cand2 = jax.vmap(_child_cand)(hist2, g2, h2, c2, fm2, po2, *opt2)
         cand_l = SplitCandidate(*[a[0] for a in cand2])
         cand_r = SplitCandidate(*[a[1] for a in cand2])
         depth_ok = (p.max_depth <= 0) | (d_new < p.max_depth)
@@ -1919,20 +1936,21 @@ def grow_tree(
         if use_seg:
             begin_k = st.leaf_begin[l_k]
             cnt_k = jnp.where(active_k, st.leaf_nrows[l_k], 0)
-            order, nleft_k, nright_k = sort_partition_batch(
-                st.order,
-                begin_k,
-                cnt_k,
-                c_feat_k,
-                c_bin_k,
-                c_dl_k.astype(jnp.int32),
-                nan_bins[c_feat_k],
-                c_cis_k.astype(jnp.int32),
-                c_cmask_k.astype(jnp.float32),
-                f=f_seg,
-                n_pad=n_pad_seg,
-                wide=seg_wide,
-            )
+            with jax.named_scope("partition"):
+                order, nleft_k, nright_k = sort_partition_batch(
+                    st.order,
+                    begin_k,
+                    cnt_k,
+                    c_feat_k,
+                    c_bin_k,
+                    c_dl_k.astype(jnp.int32),
+                    nan_bins[c_feat_k],
+                    c_cis_k.astype(jnp.int32),
+                    c_cmask_k.astype(jnp.float32),
+                    f=f_seg,
+                    n_pad=n_pad_seg,
+                    wide=seg_wide,
+                )
             if p.axis_name is not None:
                 cnts_g = lax.psum(
                     jnp.stack([nleft_k, nright_k], axis=1), p.axis_name
@@ -1942,38 +1960,40 @@ def grow_tree(
                 left_smaller_k = nleft_k <= nright_k
             child_start_k = begin_k + jnp.where(left_smaller_k, 0, nleft_k)
             child_cnt_k = jnp.where(left_smaller_k, nleft_k, nright_k)
-            sm_k = seg_hist_batch(
-                order,
-                jnp.stack([child_start_k, child_cnt_k], axis=1).astype(
-                    jnp.int32
-                ),
-                f=f_seg,
-                num_bins=B,
-                n_pad=n_pad_seg,
-                quant_scales=seg_qs,
-                wide=seg_wide,
-            )
+            with jax.named_scope("histogram"):
+                sm_k = seg_hist_batch(
+                    order,
+                    jnp.stack([child_start_k, child_cnt_k], axis=1).astype(
+                        jnp.int32
+                    ),
+                    f=f_seg,
+                    num_bins=B,
+                    n_pad=n_pad_seg,
+                    quant_scales=seg_qs,
+                    wide=seg_wide,
+                )
             if hist_axis is not None:
                 sm_k = lax.psum(sm_k, hist_axis)
         elif use_ordered:
             begin_k = st.leaf_begin[l_k]
             cnt_k = jnp.where(active_k, st.leaf_nrows[l_k], 0)
             order = st.order
-            nleft_list = []
-            for i in range(K):
-                pbucket_i = jnp.clip(
-                    jnp.searchsorted(pcaps_arr, cnt_k[i], side="left"),
-                    0,
-                    len(pcaps) - 1,
-                ).astype(jnp.int32)
-                order, nleft_i = lax.switch(
-                    pbucket_i,
-                    part_branches,
-                    (order, begin_k[i], cnt_k[i], c_feat_k[i], c_bin_k[i],
-                     c_dl_k[i], c_cis_k[i], c_cmask_k[i]),
-                )
-                nleft_list.append(nleft_i)
-            nleft_k = jnp.stack(nleft_list)
+            with jax.named_scope("partition"):
+                nleft_list = []
+                for i in range(K):
+                    pbucket_i = jnp.clip(
+                        jnp.searchsorted(pcaps_arr, cnt_k[i], side="left"),
+                        0,
+                        len(pcaps) - 1,
+                    ).astype(jnp.int32)
+                    order, nleft_i = lax.switch(
+                        pbucket_i,
+                        part_branches,
+                        (order, begin_k[i], cnt_k[i], c_feat_k[i], c_bin_k[i],
+                         c_dl_k[i], c_cis_k[i], c_cmask_k[i]),
+                    )
+                    nleft_list.append(nleft_i)
+                nleft_k = jnp.stack(nleft_list)
             nright_k = cnt_k - nleft_k
             if p.axis_name is not None:
                 cnts_g = lax.psum(
@@ -1988,21 +2008,22 @@ def grow_tree(
                 tc_k = jnp.minimum(nleft_k, nright_k)
             child_start_k = begin_k + jnp.where(left_smaller_k, 0, nleft_k)
             child_cnt_k = jnp.where(left_smaller_k, nleft_k, nright_k)
-            sm_list = []
-            for i in range(K):
-                cbucket_i = jnp.clip(
-                    jnp.searchsorted(caps_arr, tc_k[i], side="left"),
-                    0,
-                    len(caps) - 1,
-                ).astype(jnp.int32)
-                sm_list.append(
-                    lax.switch(
-                        cbucket_i,
-                        hist_branches_ordered_loc,
-                        (order, child_start_k[i], child_cnt_k[i]),
+            with jax.named_scope("histogram"):
+                sm_list = []
+                for i in range(K):
+                    cbucket_i = jnp.clip(
+                        jnp.searchsorted(caps_arr, tc_k[i], side="left"),
+                        0,
+                        len(caps) - 1,
+                    ).astype(jnp.int32)
+                    sm_list.append(
+                        lax.switch(
+                            cbucket_i,
+                            hist_branches_ordered_loc,
+                            (order, child_start_k[i], child_cnt_k[i]),
+                        )
                     )
-                )
-            sm_k = jnp.stack(sm_list)
+                sm_k = jnp.stack(sm_list)
             if hist_axis is not None:
                 sm_k = lax.psum(sm_k, hist_axis)
         else:
@@ -2050,40 +2071,43 @@ def grow_tree(
                 member_k = in_leaf_k & jnp.where(
                     left_smaller_k[:, None], go_left_k, ~go_left_k
                 )
-                sm_list = []
-                for i in range(K):
-                    bucket_i = jnp.clip(
-                        jnp.searchsorted(caps_arr, tc_k[i], side="left"),
-                        0,
-                        len(caps) - 1,
-                    ).astype(jnp.int32)
-                    sm_list.append(
-                        lax.switch(bucket_i, hist_branches_loc, member_k[i])
-                    )
-                sm_k = jnp.stack(sm_list)
+                with jax.named_scope("histogram"):
+                    sm_list = []
+                    for i in range(K):
+                        bucket_i = jnp.clip(
+                            jnp.searchsorted(caps_arr, tc_k[i], side="left"),
+                            0,
+                            len(caps) - 1,
+                        ).astype(jnp.int32)
+                        sm_list.append(
+                            lax.switch(bucket_i, hist_branches_loc, member_k[i])
+                        )
+                    sm_k = jnp.stack(sm_list)
             else:
                 left_smaller_k = c_lc_k <= c_rc_k
                 member_k = in_leaf_k & jnp.where(
                     left_smaller_k[:, None], go_left_k, ~go_left_k
                 )
-                mask_k = count_mask[None, :] * member_k
-                sm_k = jax.vmap(
-                    lambda m: leaf_histogram(
-                        bins_loc, grad, hess, m, B,
-                        method=p.hist_method,
-                        axis_name=None,
-                        quant_scales=quant_scales,
-                    )
-                )(mask_k)
+                with jax.named_scope("histogram"):
+                    mask_k = count_mask[None, :] * member_k
+                    sm_k = jax.vmap(
+                        lambda m: leaf_histogram(
+                            bins_loc, grad, hess, m, B,
+                            method=p.hist_method,
+                            axis_name=None,
+                            quant_scales=quant_scales,
+                        )
+                    )(mask_k)
             if hist_axis is not None:
                 sm_k = lax.psum(sm_k, hist_axis)
 
-        # ---- sibling histograms by subtraction, per pair
-        parent_hist_k = st.hist_buf[l_k]  # [K, f_loc, B, 3]
-        other_k = parent_hist_k - sm_k
-        ls4 = left_smaller_k[:, None, None, None]
-        left_hist_k = jnp.where(ls4, sm_k, other_k)
-        right_hist_k = jnp.where(ls4, other_k, sm_k)
+        with jax.named_scope("bookkeeping"):
+            # ---- sibling histograms by subtraction, per pair
+            parent_hist_k = st.hist_buf[l_k]  # [K, f_loc, B, 3]
+            other_k = parent_hist_k - sm_k
+            ls4 = left_smaller_k[:, None, None, None]
+            left_hist_k = jnp.where(ls4, sm_k, other_k)
+            right_hist_k = jnp.where(ls4, other_k, sm_k)
 
         lg_k, lh_k, lc_k = c_lg_k, c_lh_k, c_lc_k
         rg_k, rh_k, rc_k = c_rg_k, c_rh_k, c_rc_k
@@ -2150,7 +2174,8 @@ def grow_tree(
                 lb=lbv, ub=ubv, pout=po, rand=rbv, depth=dv,
             )
 
-        cand2 = jax.vmap(_child_cand_b)(hist2, g2, h2, c2, fm2, po2, *opt2)
+        with jax.named_scope("candidate_refresh"):
+            cand2 = jax.vmap(_child_cand_b)(hist2, g2, h2, c2, fm2, po2, *opt2)
         depth_ok_k = (p.max_depth <= 0) | (d_new_k < p.max_depth)
         gain_l_k = jnp.where(depth_ok_k, cand2.gain[:K], -jnp.inf)
         gain_r_k = jnp.where(depth_ok_k, cand2.gain[K:], -jnp.inf)

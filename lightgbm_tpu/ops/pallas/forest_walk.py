@@ -35,6 +35,8 @@ from typing import NamedTuple
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from ...obs.jit import instrumented_jit
 from jax import lax
 from jax.experimental import pallas as pl
 
@@ -389,7 +391,7 @@ def forest_walk(
 
 
 @functools.partial(
-    jax.jit,
+    instrumented_jit,
     static_argnames=(
         "n_trees", "max_depth", "k", "m_nodes", "has_cat", "interpret"
     ),
@@ -429,7 +431,7 @@ def _forest_walk_jit(
     )(bins, pk1, pk2, leaf, cw)
 
 
-@functools.partial(jax.jit, static_argnames=("n_pad",))
+@functools.partial(instrumented_jit, static_argnames=("n_pad",))
 def _pack_bins_device(mat_u8: jnp.ndarray, n_pad: int) -> jnp.ndarray:
     """Device-side bin packing: [N, F] u8 -> [n_tiles, P, 8, 128] i32."""
     n, f = mat_u8.shape
@@ -506,7 +508,7 @@ def build_devbin_tables(mappers, used_features):
     )
 
 
-@jax.jit
+@instrumented_jit
 def bin_numeric_device(
     X: jnp.ndarray,  # [N, F] f32 — used-feature columns
     ub: jnp.ndarray,  # [F, Bmax] f32, +inf padded

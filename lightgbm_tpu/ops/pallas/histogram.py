@@ -33,6 +33,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ...obs.jit import instrumented_jit
 from jax.experimental import pallas as pl
 
 try:
@@ -144,7 +146,7 @@ def tile_pallas_histogram(
     return out, bpad
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "interpret"))
+@functools.partial(instrumented_jit, static_argnames=("num_bins", "interpret"))
 def histogram_pallas(
     bins: jnp.ndarray,  # [N, F] integer bins (int8/uint8/int32 ...)
     grad: jnp.ndarray,  # [N] f32

@@ -23,6 +23,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ...obs.jit import instrumented_jit
 from jax.experimental import pallas as pl
 
 try:
@@ -77,7 +79,7 @@ def _hist_kernel_int8(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_bins", "interpret")
+    instrumented_jit, static_argnames=("num_bins", "interpret")
 )
 def histogram_pallas_int8(
     bins: jnp.ndarray,  # [N, F] integer bins

@@ -41,6 +41,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ...obs.jit import instrumented_jit
 from jax import lax
 from jax.experimental import pallas as pl
 
@@ -306,7 +308,7 @@ def _seg_partition_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("f", "n_pad", "use_cat", "wide", "interpret")
+    instrumented_jit, static_argnames=("f", "n_pad", "use_cat", "wide", "interpret")
 )
 def seg_partition_pallas(
     seg: jnp.ndarray,  # [LANES, n_pad] i16 plane-major packed rows
@@ -383,7 +385,7 @@ def seg_partition_pallas(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("f", "n_pad", "use_cat", "wide", "interpret")
+    instrumented_jit, static_argnames=("f", "n_pad", "use_cat", "wide", "interpret")
 )
 def seg_partition_pallas_batch(
     seg: jnp.ndarray,  # [LANES, n_pad] i16 plane-major packed rows

@@ -49,6 +49,8 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ...obs.jit import instrumented_jit
 from jax import lax
 from jax.experimental import pallas as pl
 
@@ -369,7 +371,7 @@ def _seg_hist_kernel(
 
 
 @functools.partial(
-    jax.jit,
+    instrumented_jit,
     static_argnames=("f", "num_bins", "n_pad", "quantized", "wide", "interpret"),
 )
 def seg_hist_pallas(
@@ -426,7 +428,7 @@ def seg_hist_pallas(
 
 
 @functools.partial(
-    jax.jit,
+    instrumented_jit,
     static_argnames=("f", "num_bins", "n_pad", "quantized", "wide", "interpret"),
 )
 def seg_hist_pallas_batch(

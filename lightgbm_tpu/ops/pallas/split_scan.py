@@ -28,6 +28,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ...obs.jit import instrumented_jit
 from jax import lax
 from jax.experimental import pallas as pl
 
@@ -168,7 +170,7 @@ def _split_scan_kernel(
 
 
 @functools.partial(
-    jax.jit,
+    instrumented_jit,
     static_argnames=(
         "f", "num_bins_pad", "l1", "l2", "min_data", "min_hess", "interpret"
     ),

@@ -22,11 +22,13 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.jit import instrumented_jit
+
 from .split import leaf_output
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_bins", "stochastic", "constant_hessian")
+    instrumented_jit, static_argnames=("num_bins", "stochastic", "constant_hessian")
 )
 def quantize_gradients(
     grad: jnp.ndarray,  # [N] f32
@@ -71,7 +73,7 @@ def quantize_gradients(
 
 
 @functools.partial(
-    jax.jit,
+    instrumented_jit,
     static_argnames=(
         "num_leaves",
         "lambda_l1",

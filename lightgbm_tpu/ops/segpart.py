@@ -31,6 +31,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..obs.jit import instrumented_jit
 from jax import lax
 
 from .pallas.seg import _u16, used_lanes
@@ -57,7 +59,7 @@ def _go_left(colv, tbin, dl, nanb, iscat, catmask):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("f", "n_pad", "wide", "use_gl_vec")
+    instrumented_jit, static_argnames=("f", "n_pad", "wide", "use_gl_vec")
 )
 def sort_partition_xla(
     seg: jnp.ndarray,  # [LANES, n_pad] i16 packed rows, PLANE-MAJOR — the

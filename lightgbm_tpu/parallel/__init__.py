@@ -38,9 +38,51 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs.jit import instrumented_jit
 from ..ops.grower import GrowerParams, TreeArrays, grow_tree
 
 DATA_AXIS = "data"
+
+
+def psum_bytes_per_iteration(
+    n_splits: int,
+    n_features: int,
+    num_bins: int,
+    leaf_batch: int = 1,
+    mesh_size: int = 1,
+) -> dict:
+    """Analytic bytes moved by the grower's psums for one boosting iteration
+    under ``tree_learner=data`` (recorded as telemetry gauges).
+
+    The psums sit inside a jitted while_loop — traced once, executed per
+    split step — so runtime interception can't count them; the payloads are
+    fully determined by shapes instead (tools/collective_model.py):
+
+    * root: one ``[F, B, 3]`` f32 histogram psum per tree;
+    * serial (``leaf_batch=1``): per split, one smaller-child ``[F, B, 3]``
+      f32 histogram psum plus a ``[2]`` i32 count psum;
+    * batched (``leaf_batch=K``): per loop step, ONE ``[K, F, B, 3]``
+      histogram psum plus ONE ``[K, 2]`` count psum.  The prefix-commit rule
+      may commit fewer than K members per step, so ``ceil(splits / K)``
+      steps is a lower bound — the model's documented approximation.
+
+    ``ring_bytes_per_device`` scales the summed payload by the ring
+    all-reduce factor ``2 * (D - 1) / D``.
+    """
+    f, b, k = int(n_features), int(num_bins), max(1, int(leaf_batch))
+    splits = max(0, int(n_splits))
+    hist_payload = f * b * 3 * 4  # [F, B, 3] f32
+    steps = -(-splits // k) if splits else 0
+    hist_bytes = (steps * k + 1) * hist_payload  # + 1 root histogram
+    count_bytes = steps * k * 2 * 4 + 8  # [K, 2] i32 + root totals
+    d = max(1, int(mesh_size))
+    ring = 2.0 * (d - 1) / d
+    return {
+        "steps": steps,
+        "hist_bytes": hist_bytes,
+        "count_bytes": count_bytes,
+        "ring_bytes_per_device": (hist_bytes + count_bytes) * ring,
+    }
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs):
@@ -147,7 +189,7 @@ def make_sharded_grow(
             leaf_out,
         ),
     )
-    return jax.jit(fn)
+    return instrumented_jit(fn, label="parallel/sharded_grow")
 
 
 def make_mesh(n_devices: Optional[int] = None, axis_name: str = DATA_AXIS) -> Mesh:
@@ -271,7 +313,7 @@ def make_data_parallel_train_step(
         in_specs=(sharded2, sharded, sharded, rep, rep, rep),
         out_specs=(sharded, rep),
     )
-    return jax.jit(fn)
+    return instrumented_jit(fn, label="parallel/train_step")
 
 
 def l2_gradients(score: jnp.ndarray, label: jnp.ndarray):

@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .obs.jit import instrumented_jit, note_compile
+from .obs.registry import get_session
 from .tree import (
     K_CATEGORICAL_MASK,
     K_DEFAULT_LEFT_MASK,
@@ -213,7 +215,7 @@ def _predict_bins_leaves_impl(batch: BinTreeBatch, bins: jnp.ndarray, nan_bins: 
     return ~nodes  # [N, T] leaf indices
 
 
-predict_bins_leaves = jax.jit(_predict_bins_leaves_impl)
+predict_bins_leaves = instrumented_jit(_predict_bins_leaves_impl, label="predict/bins_leaves")
 
 
 def _predict_bins_raw_impl(batch: BinTreeBatch, bins: jnp.ndarray, nan_bins: jnp.ndarray) -> jnp.ndarray:
@@ -224,7 +226,7 @@ def _predict_bins_raw_impl(batch: BinTreeBatch, bins: jnp.ndarray, nan_bins: jnp
     return batch.leaf_value[tree_ids, leaves]  # [N, T]
 
 
-predict_bins_raw = jax.jit(_predict_bins_raw_impl)
+predict_bins_raw = instrumented_jit(_predict_bins_raw_impl, label="predict/bins_raw")
 
 
 def _predict_real_leaves_impl(batch: RealTreeBatch, X: jnp.ndarray) -> jnp.ndarray:
@@ -261,7 +263,7 @@ def _predict_real_leaves_impl(batch: RealTreeBatch, X: jnp.ndarray) -> jnp.ndarr
     return ~nodes
 
 
-predict_real_leaves = jax.jit(_predict_real_leaves_impl)
+predict_real_leaves = instrumented_jit(_predict_real_leaves_impl, label="predict/real_leaves")
 
 
 def _predict_real_raw_impl(batch: RealTreeBatch, X: jnp.ndarray) -> jnp.ndarray:
@@ -271,7 +273,7 @@ def _predict_real_raw_impl(batch: RealTreeBatch, X: jnp.ndarray) -> jnp.ndarray:
     return batch.leaf_value[tree_ids, leaves]
 
 
-predict_real_raw = jax.jit(_predict_real_raw_impl)
+predict_real_raw = instrumented_jit(_predict_real_raw_impl, label="predict/real_raw")
 
 
 def _stacked_bins_value_impl(batch: BinTreeBatch, nan_bins: jnp.ndarray, bins: jnp.ndarray):
@@ -284,7 +286,7 @@ def _stacked_bins_leaves_impl(batch: BinTreeBatch, nan_bins: jnp.ndarray, bins: 
     return _predict_bins_leaves_impl(batch, bins, nan_bins)
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+@functools.partial(instrumented_jit, donate_argnums=(0,))
 def add_tree_to_score(
     score_k: jnp.ndarray,  # [N] f32 (donated)
     bins: jnp.ndarray,  # [N, F_used]
@@ -597,6 +599,7 @@ class StreamingPredictor:
         compiled = fn.lower(*avals).compile()
         _EXEC_CACHE[key] = compiled
         _COMPILE_COUNT += 1
+        note_compile("predict/stream")
         return compiled
 
     def warmup(
@@ -663,6 +666,7 @@ class StreamingPredictor:
         concatenation (e.g. the per-class sum), running on host while the
         next chunk computes on device."""
         b = self._b
+        ses = get_session()
         n = int(X.shape[0])
         t_count = t1 - t0
         chunk = max(LADDER_MIN, int(chunk))
@@ -737,7 +741,8 @@ class StreamingPredictor:
         def drain_one():
             dev, rows, patch = inflight.popleft()
             t_w = time.perf_counter()
-            host = np.asarray(dev)
+            with jax.profiler.TraceAnnotation("predict/walk"):
+                host = np.asarray(dev)
             stats["walk_ms"] += (time.perf_counter() - t_w) * 1e3
             t_h = time.perf_counter()
             blk = host[:rows]
@@ -755,7 +760,8 @@ class StreamingPredictor:
             rows = min(chunk, n - lo)
             bucket = bucket_rows(rows, chunk)
             t_b = time.perf_counter()
-            mat, x_orig = host_rows(lo, rows)
+            with jax.profiler.TraceAnnotation("predict/bin"):
+                mat, x_orig = host_rows(lo, rows)
             if bucket > rows:
                 padded = np.zeros((bucket, width), dtype)
                 padded[:rows] = mat
@@ -786,10 +792,18 @@ class StreamingPredictor:
                 variant, kind, tables, statics, bucket, width, dtype, ndev
             )
             t_t = time.perf_counter()
-            dev = compiled(*tables, padded)
+            with jax.profiler.TraceAnnotation("predict/transfer"):
+                dev = compiled(*tables, padded)
             stats["transfer_ms"] += (time.perf_counter() - t_t) * 1e3
             inflight.append((dev, rows, patch))
             stats["chunks"] += 1
+            if ses.enabled:
+                ses.record({
+                    "event": "predict_chunk",
+                    "chunk": stats["chunks"] - 1,
+                    "rows": rows,
+                    "bucket": bucket,
+                })
             if bucket not in stats["buckets"]:
                 stats["buckets"].append(bucket)
             while len(inflight) >= num_buffers:
@@ -801,6 +815,22 @@ class StreamingPredictor:
         stats["host_ms"] += (time.perf_counter() - t_h) * 1e3
         stats["compiles"] = _COMPILE_COUNT - compiles_before
         self.last_stats = stats
+        if ses.enabled:
+            ses.inc("predict_chunks", stats["chunks"])
+            ses.record({
+                "event": "predict",
+                "path": stats["path"],
+                "rows": n,
+                "chunks": stats["chunks"],
+                "shard_devices": ndev,
+                "phases": {
+                    "bin_ms": stats["bin_ms"],
+                    "transfer_ms": stats["transfer_ms"],
+                    "walk_ms": stats["walk_ms"],
+                    "host_ms": stats["host_ms"],
+                },
+                "compiles": stats["compiles"],
+            })
         return out
 
 

@@ -50,6 +50,14 @@ def register_logger(logger: Any, info_method_name: str = "info",
     _bridge.register(logger, info_method_name, warning_method_name)
 
 
+def unregister_logger() -> None:
+    """Restore the default print-to-stdout logging (undoes
+    :func:`register_logger`)."""
+    _bridge._logger = None
+    _bridge._info_name = "info"
+    _bridge._warning_name = "warning"
+
+
 def log_info(msg: str) -> None:
     _bridge.info(msg)
 

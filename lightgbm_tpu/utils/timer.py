@@ -11,6 +11,7 @@ same phases for ``jax.profiler`` traces.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
 from typing import Dict, Iterator
@@ -20,6 +21,9 @@ class GlobalTimer:
     def __init__(self) -> None:
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
+        # defaultdict += is read-modify-write: concurrent phases (dask
+        # workers, threaded predict) would drop increments without a lock
+        self._lock = threading.Lock()
 
     @contextlib.contextmanager
     def timed(self, name: str) -> Iterator[None]:
@@ -27,23 +31,27 @@ class GlobalTimer:
         try:
             yield
         finally:
-            self.totals[name] += time.perf_counter() - t0
-            self.counts[name] += 1
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.totals[name] += dt
+                self.counts[name] += 1
 
     def reset(self) -> None:
-        self.totals.clear()
-        self.counts.clear()
+        with self._lock:
+            self.totals.clear()
+            self.counts.clear()
 
     def summary(self) -> str:
-        if not self.totals:
+        with self._lock:
+            totals = dict(self.totals)
+            counts = dict(self.counts)
+        if not totals:
             return "LightGBM::timer: (no phases recorded)"
-        width = max(len(k) for k in self.totals)
+        width = max(len(k) for k in totals)
         lines = ["LightGBM::timer (host wall per phase)"]
-        for name, total in sorted(
-            self.totals.items(), key=lambda kv: -kv[1]
-        ):
+        for name, total in sorted(totals.items(), key=lambda kv: -kv[1]):
             lines.append(
-                f"  {name.ljust(width)}  {total:9.3f}s  x{self.counts[name]}"
+                f"  {name.ljust(width)}  {total:9.3f}s  x{counts[name]}"
             )
         return "\n".join(lines)
 

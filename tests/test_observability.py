@@ -7,7 +7,6 @@ import pytest
 jax = pytest.importorskip("jax")
 
 import lightgbm_tpu as lgb  # noqa: E402
-from lightgbm_tpu.utils import log as _log  # noqa: E402
 
 
 class _Capture:
@@ -39,12 +38,23 @@ def test_register_logger_redirects_eval_lines():
         )
         assert any("l2" in m for m in cap.infos)
     finally:
-        _log._bridge._logger = None  # restore default stdout logging
+        lgb.unregister_logger()  # restore default stdout logging
 
 
 def test_register_logger_validates():
     with pytest.raises(TypeError):
         lgb.register_logger(object())
+
+
+def test_unregister_logger_restores_stdout(capsys):
+    cap = _Capture()
+    lgb.register_logger(cap)
+    lgb.unregister_logger()
+    from lightgbm_tpu.utils.log import log_info
+
+    log_info("back to stdout")
+    assert "back to stdout" in capsys.readouterr().out
+    assert cap.infos == []
 
 
 def test_global_timer_records_phases(capsys):

@@ -24,4 +24,26 @@ for chunk in "${chunks[@]}"; do
   # shellcheck disable=SC2086
   python -m pytest $chunk -q "$@" || rc=$?
 done
+
+# telemetry smoke: a 3-iteration instrumented train must produce a JSONL
+# stream the rollup tool can parse (one event per iteration, no recompiles
+# hiding in steady state)
+echo "=== telemetry smoke (3-iteration train -> tools/telemetry_summary.py) ==="
+tel_out=$(mktemp /tmp/telemetry_smoke.XXXXXX.jsonl)
+python - "$tel_out" <<'PYEOF' && python tools/telemetry_summary.py "$tel_out" || rc=$?
+import sys
+import numpy as np
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(400, 6))
+y = X[:, 0] + 0.1 * rng.normal(size=400)
+lgb.train(
+    {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+     "metric": "l2", "telemetry": True, "telemetry_out": sys.argv[1]},
+    lgb.Dataset(X, y), 3,
+    valid_sets=[lgb.Dataset(X, y)], valid_names=["t"],
+)
+PYEOF
+rm -f "$tel_out"
 exit $rc

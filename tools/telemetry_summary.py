@@ -1,0 +1,97 @@
+"""Roll up a telemetry JSONL stream (telemetry_out=...) into one summary.
+
+Usage:
+    python tools/telemetry_summary.py events.jsonl [more.jsonl ...]
+    python -m lightgbm_tpu ... telemetry=true telemetry_out=events.jsonl
+
+Prints one human block per file: iteration count, wall/phase means, compile
+deltas, collective-byte totals, plus predict-event rollups when present.
+Exits non-zero on empty or unparseable input so CI smoke checks can gate on
+it (tools/run_tests.sh runs a 3-iteration train through this).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    events = []
+    with open(path) as fp:
+        for lineno, line in enumerate(fp, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"{path}:{lineno}: bad JSONL line: {e}")
+    return events
+
+
+def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    iters = [e for e in events if e.get("event") == "iteration"]
+    preds = [e for e in events if e.get("event") == "predict"]
+    chunks = [e for e in events if e.get("event") == "predict_chunk"]
+    out: Dict[str, Any] = {"events": len(events)}
+    if iters:
+        phase_tot: Dict[str, float] = defaultdict(float)
+        for e in iters:
+            for k, v in (e.get("phases") or {}).items():
+                phase_tot[k] += float(v)
+        n = len(iters)
+        out["iterations"] = n
+        out["wall_ms_mean"] = round(
+            sum(float(e.get("wall_ms", 0.0)) for e in iters) / n, 2
+        )
+        out["phases_ms_mean"] = {
+            k: round(v / n, 2) for k, v in sorted(phase_tot.items())
+        }
+        out["compiles_total"] = sum(
+            int(e.get("compiles_delta", 0)) for e in iters
+        )
+        out["recompiles_after_first"] = sum(
+            int(e.get("compiles_delta", 0)) for e in iters[1:]
+        )
+        out["splits_total"] = sum(int(e.get("splits", 0)) for e in iters)
+        colls = [e["collective"] for e in iters if "collective" in e]
+        if colls:
+            out["collective_bytes_total"] = {
+                k: round(sum(float(c[k]) for c in colls))
+                for k in ("hist_bytes", "count_bytes", "ring_bytes_per_device")
+            }
+        evals = [e["eval"] for e in iters if "eval" in e]
+        if evals:
+            out["final_eval"] = evals[-1]
+    if preds:
+        out["predict_runs"] = len(preds)
+        out["predict_rows"] = sum(int(e.get("rows", 0)) for e in preds)
+        out["predict_chunks"] = len(chunks) or sum(
+            int(e.get("chunks", 0)) for e in preds
+        )
+        out["predict_compiles"] = sum(int(e.get("compiles", 0)) for e in preds)
+    return out
+
+
+def main(argv: List[str]) -> int:
+    if not argv:
+        print(__doc__)
+        return 2
+    rc = 0
+    for path in argv:
+        events = load_events(path)
+        if not events:
+            print(f"{path}: no events", file=sys.stderr)
+            rc = 1
+            continue
+        print(f"== {path}")
+        for k, v in summarize(events).items():
+            print(f"  {k}: {json.dumps(v)}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
