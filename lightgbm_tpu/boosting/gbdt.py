@@ -180,6 +180,7 @@ class Booster:
             )
             if int(ta_host.num_leaves) > 1:
                 should_continue = True
+                self._note_commit_rate(ta_host)
             decoded.append((kk, ta_host))
         if not should_continue:
             # no class found a positive-gain split: the iteration left no
@@ -225,6 +226,51 @@ class Booster:
             self._models_store.append(tree)
             self._bin_records_store.append(rec)
             self._bump_model_version()
+
+    def _note_commit_rate(self, ta_host) -> None:
+        """Frontier-batch commit-rate gauge + adaptive leaf_batch clamp.
+
+        commit rate = splits committed / split slots offered
+        = (num_leaves - 1) / (grow_steps * K).  Round-8 measured K=8 at
+        3.4% SLOWER than serial near the 255-leaf cap: late batched steps
+        mostly speculate (partition + histogram work for members whose gain
+        an earlier member's children beat).  When the EMA commit rate drops
+        below leaf_batch_min_commit_rate the cap halves.  Sticky DOWNWARD
+        only: each K owns its own compiled loop, so a cap that oscillated
+        would retrace on every flip — halving costs at most log2(K) traces
+        per run."""
+        k = int(self._grower_params.leaf_batch)
+        if k <= 1 or self._mesh is not None:
+            # mesh path: grower params are baked into the shard_map closure
+            # at _init_train time; fused grow doesn't engage there either
+            return
+        steps = int(ta_host.grow_steps)
+        if steps <= 0:
+            return
+        rate = (int(ta_host.num_leaves) - 1) / float(steps * k)
+        ema = getattr(self, "_commit_rate_ema", None)
+        ema = rate if ema is None else 0.7 * ema + 0.3 * rate
+        self._commit_rate_ema = ema
+        ses = get_session()
+        ses.set_gauge("grower.commit_rate", ema)
+        ses.set_gauge("grower.leaf_batch_effective", float(k))
+        cfg = self.config
+        if cfg.leaf_batch_adaptive and ema < cfg.leaf_batch_min_commit_rate:
+            self._leaf_batch_cap = max(1, k // 2)
+            self._commit_rate_ema = None  # fresh EMA window for the new K
+            self._grower_params = self._make_grower_params()
+            ses.set_gauge(
+                "grower.leaf_batch_effective",
+                float(self._grower_params.leaf_batch),
+            )
+            if self.config.verbosity >= 2:
+                from ..utils.log import log_info
+
+                log_info(
+                    f"leaf_batch clamp: commit rate {ema:.3f} < "
+                    f"{cfg.leaf_batch_min_commit_rate} at K={k}; "
+                    f"continuing with K={self._grower_params.leaf_batch}"
+                )
 
     def _update_pipelined(self, grad, hess, mask, feature_mask, k: int) -> bool:
         """Dispatch one iteration's device work; defer host bookkeeping.
@@ -1144,7 +1190,20 @@ class Booster:
                     + "; falling back to serial (leaf_batch=1) growth"
                 )
                 leaf_k = 1
+        # remaining-leaf budget: a tree can never commit more than
+        # num_leaves - 1 splits, so offering more slots only speculates
         leaf_k = min(leaf_k, max(1, cfg.num_leaves - 1))
+        # adaptive commit-rate clamp: a prior tree's low commit rate halved
+        # the cap (see _note_commit_rate); sticky for the rest of the run
+        cap = getattr(self, "_leaf_batch_cap", None)
+        if cap is not None:
+            leaf_k = min(leaf_k, cap)
+        if cfg.grow_fused == "on":
+            grow_fused = True
+        elif cfg.grow_fused == "off":
+            grow_fused = False
+        else:  # 'auto' — on when the seg fast path is active
+            grow_fused = hist_mode == "seg"
         return GrowerParams(
             num_leaves=cfg.num_leaves,
             max_bin=self._max_bin_padded,
@@ -1188,6 +1247,7 @@ class Booster:
             fused_split_scan=cfg.fused_split_scan,
             use_bundle=self._has_bundle,
             leaf_batch=leaf_k,
+            grow_fused=grow_fused,
             monotone_penalty=cfg.monotone_penalty,
             use_feature_contri=self._feature_contri is not None,
         )

@@ -318,6 +318,24 @@ class Config:
     # exact via the prefix-commit rule, see ops/grower.py).  1 = serial,
     # byte-identical to the unbatched grower.
     leaf_batch: int = 1
+    # TPU extension: adaptively clamp the effective leaf_batch by the
+    # remaining-leaf budget and the observed commit rate (splits committed /
+    # slots offered, from TreeArrays.grow_steps).  Near the num_leaves cap a
+    # large K mostly speculates — round-8 measured K=8 at 3.4% SLOWER than
+    # serial there — so when the EMA commit rate drops below
+    # leaf_batch_min_commit_rate the booster halves K (sticky: it never
+    # grows back within a training run; every K has a warm compiled loop).
+    leaf_batch_adaptive: bool = True
+    leaf_batch_min_commit_rate: float = 0.625
+    # TPU extension: fused Pallas grow step — partition + smaller-child
+    # election + histogram for the whole frontier batch in ONE kernel launch
+    # (ops/pallas/grow_step.py), collapsing the fixed dispatch/fusion-
+    # boundary cost between the separately-launched grower phases.
+    # 'auto' = on whenever the seg fast path is active (hist_mode='seg',
+    # no feature-parallel, no data-parallel axis); 'on' / 'off' force it.
+    # Off TPU the fused dispatcher lowers to the same XLA composition as the
+    # two-launch path, so tree structures are byte-identical either way.
+    grow_fused: str = "auto"
     early_stopping_round: int = 0
     early_stopping_min_delta: float = 0.0
     first_metric_only: bool = False
@@ -539,6 +557,10 @@ class Config:
             raise ValueError("max_bin must be >= 2")
         if self.leaf_batch < 1:
             raise ValueError("leaf_batch must be >= 1")
+        if self.grow_fused not in ("auto", "on", "off"):
+            raise ValueError("grow_fused must be one of 'auto', 'on', 'off'")
+        if not (0.0 <= self.leaf_batch_min_commit_rate <= 1.0):
+            raise ValueError("leaf_batch_min_commit_rate must be in [0, 1]")
         if self.bagging_freq > 0 and (self.pos_bagging_fraction < 1.0 or self.neg_bagging_fraction < 1.0):
             if self.objective != "binary":
                 raise ValueError("pos/neg bagging fractions require binary objective")

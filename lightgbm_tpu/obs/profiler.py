@@ -5,8 +5,10 @@ drive ``jax.profiler.start_trace``/``stop_trace`` from the training loop —
 the standard way to get a TensorBoard-loadable device trace of exactly the
 steady-state iterations (skipping compile/warmup noise).  The grower's
 ``jax.named_scope`` labels (partition / histogram / split_scan /
-candidate_refresh / bookkeeping) and the predictor's ``TraceAnnotation``
-phases appear inside the captured trace.
+candidate_refresh / bookkeeping — or ``fused_grow_step`` replacing the
+partition/histogram pair when the fused Pallas grow step is engaged, see
+ops/pallas/grow_step.py) and the predictor's ``TraceAnnotation`` phases
+appear inside the captured trace.
 """
 
 from __future__ import annotations

@@ -133,6 +133,15 @@ class GrowerParams:
     # is identical to serial leaf-wise growth.  1 = the serial fori_loop,
     # byte-identical to the pre-batching grower.
     leaf_batch: int = 1
+    # fused Pallas grow step (ops/pallas/grow_step.py): partition + local
+    # smaller-child election + histogram for all K frontier members in ONE
+    # kernel launch, collapsing the per-step dispatch/fusion-boundary share.
+    # Engages only on the seg fast path with NO axis_name (the data-parallel
+    # election needs a mid-step psum of per-shard counts, so that mode keeps
+    # the two-launch path); the XLA composition stays the fallback and
+    # correctness oracle everywhere else.  boosting/gbdt.py resolves the
+    # user-facing 'auto'/'on'/'off' config into this bool.
+    grow_fused: bool = False
     # depth-scaled split-gain penalty on monotone features (reference
     # ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:357)
     monotone_penalty: float = 0.0
@@ -197,6 +206,10 @@ class TreeArrays(NamedTuple):
     leaf_count: jnp.ndarray  # [L] f32
     leaf_depth: jnp.ndarray  # [L] int32
     num_leaves: jnp.ndarray  # scalar int32
+    # compiled grow-loop steps taken (serial: committed splits; batched: the
+    # while_loop trip count) — the host derives the frontier-batch commit
+    # rate (num_leaves-1)/(steps*K) from it to clamp leaf_batch adaptively
+    grow_steps: jnp.ndarray  # scalar int32
     split_is_cat: jnp.ndarray  # [L-1] bool
     cat_mask: jnp.ndarray  # [L-1, Bm] bool — bin goes left (Bm=1 if no cat)
 
@@ -234,6 +247,7 @@ class _State(NamedTuple):
     done: jnp.ndarray
     forced_ok: jnp.ndarray  # still applying forced splits (n_forced > 0)
     cegb_used: jnp.ndarray  # [F] bool — feature bought (use_cegb)
+    steps: jnp.ndarray  # scalar i32 — grow-loop steps (TreeArrays.grow_steps)
 
 
 def voting_active(p: "GrowerParams", f: int) -> bool:
@@ -342,7 +356,9 @@ def _candidate_for_leaf(
     elected-feature ReduceScatter)."""
     f = hist.shape[0]
     fused_ok = (
-        p.fused_split_scan
+        # grow_fused implies the Pallas scan too: the fused grow step already
+        # emits the stacked hist, so the scan is the only launch left to save
+        (p.fused_split_scan or p.grow_fused)
         # basic numeric path only — every feature below changes the gain
         # math or the candidate set in ways the kernel does not implement
         and monotone is None
@@ -491,6 +507,7 @@ def pack_tree_arrays(ta: "TreeArrays"):
             ta.default_left.astype(jnp.int32),
             ta.leaf_depth,
             ta.num_leaves[None],
+            ta.grow_steps[None],
             ta.split_is_cat.astype(jnp.int32),
             ta.cat_mask.astype(jnp.int32).reshape(-1),
         ]
@@ -516,7 +533,8 @@ def unpack_tree_arrays(ints, floats, nn: int, L: int) -> "TreeArrays":
     default_left = ints[off : off + nn].astype(bool)
     leaf_depth = ints[off + nn : off + nn + L]
     num_leaves = ints[off + nn + L]
-    off = off + nn + L + 1
+    grow_steps = ints[off + nn + L + 1]
+    off = off + nn + L + 2
     split_is_cat = ints[off : off + nn].astype(bool)
     off += nn
     bm = max(1, (len(ints) - off) // max(nn, 1))
@@ -539,6 +557,7 @@ def unpack_tree_arrays(ints, floats, nn: int, L: int) -> "TreeArrays":
         leaf_count=fl[2],
         leaf_depth=leaf_depth,
         num_leaves=num_leaves,
+        grow_steps=grow_steps,
         split_is_cat=split_is_cat,
         cat_mask=cat_mask,
     )
@@ -830,6 +849,7 @@ def grow_tree(
             sort_partition,
             sort_partition_batch,
         )
+        from .pallas.grow_step import fused_grow_step
 
         # bins byte-pack two features per i16 plane up to max_bin 256; wider
         # bin spaces use one u16 plane per feature (the reference's
@@ -879,6 +899,19 @@ def grow_tree(
             if hist_axis is not None:
                 hist = lax.psum(hist, hist_axis)
             return hist
+
+        # single-launch fused grow step: partition + smaller-child election +
+        # histogram in one kernel.  Data-parallel (axis_name) keeps the
+        # two-launch path — electing the smaller child there needs a psum of
+        # per-shard partition counts BETWEEN partition and histogram, which a
+        # single kernel launch cannot host.  Feature-parallel likewise: the
+        # winner feature's go-left bits come from the owning shard via a
+        # gl_vec psum at partition time.
+        use_fused_grow = (
+            p.grow_fused and p.axis_name is None and not use_featpar
+        )
+    else:
+        use_fused_grow = False
     if use_ordered or use_gather:
         caps = sorted(
             _hist_caps(
@@ -1159,6 +1192,7 @@ def grow_tree(
         done=jnp.asarray(False),
         forced_ok=jnp.asarray(p.n_forced > 0),
         cegb_used=cegb_used0,
+        steps=jnp.asarray(0, jnp.int32),
     )
 
     node_ids = jnp.arange(L - 1, dtype=jnp.int32)
@@ -1277,7 +1311,35 @@ def grow_tree(
         # ---- partition rows of leaf l (reference DataPartition::Split) and
         # histogram the smaller child (serial_tree_learner.cpp:558-583), all
         # with a zero count when not splitting (value-level no-ops)
-        if use_seg:
+        if use_seg and use_fused_grow:
+            # one kernel launch: partition + smaller-child election +
+            # histogram (K=1 window) — dispatched as the XLA composition off
+            # TPU, so structures are byte-identical to the two-launch path
+            begin_l = st.leaf_begin[l]
+            seg_cnt_l = jnp.where(can_split, st.leaf_nrows[l], 0)
+            with jax.named_scope("fused_grow_step"):
+                order, nl1, nr1, _cs1, _cc1, sm1 = fused_grow_step(
+                    st.order,
+                    begin_l[None],
+                    seg_cnt_l[None],
+                    feat[None],
+                    tbin[None],
+                    dl.astype(jnp.int32)[None],
+                    nan_bins[feat][None],
+                    cis.astype(jnp.int32)[None],
+                    cmask.astype(jnp.float32)[None],
+                    f=f_seg,
+                    num_bins=B,
+                    n_pad=n_pad_seg,
+                    quant_scales=seg_qs,
+                    wide=seg_wide,
+                )
+            nleft = nl1[0]
+            nright = nr1[0]
+            left_smaller = nleft <= nright
+            sm = sm1[0]
+            leaf_id = st.leaf_id
+        elif use_seg:
             begin_l = st.leaf_begin[l]
             seg_cnt_l = jnp.where(can_split, st.leaf_nrows[l], 0)
             gl_vec = None
@@ -1813,6 +1875,9 @@ def grow_tree(
             done=done,
             forced_ok=forced_ok_next,
             cegb_used=cegb_used_new,
+            # serial fori_loop runs L-1 trips regardless of early done;
+            # count only productive steps so commit rate reads 1.0
+            steps=st.steps + can_split.astype(jnp.int32),
         )
 
     def body_batched(st: _State) -> _State:
@@ -1933,7 +1998,37 @@ def grow_tree(
         # histogram pass (speculative for members that end up uncommitted:
         # rows only move WITHIN their leaf's window, so nothing leaks)
         in_leaf_k = go_left_k = None
-        if use_seg:
+        if use_seg and use_fused_grow:
+            # K partitions + K elections + K histograms in ONE kernel launch
+            # (grid over members; windows are disjoint so members commute)
+            begin_k = st.leaf_begin[l_k]
+            cnt_k = jnp.where(active_k, st.leaf_nrows[l_k], 0)
+            with jax.named_scope("fused_grow_step"):
+                (
+                    order,
+                    nleft_k,
+                    nright_k,
+                    _cs_k,
+                    _cc_k,
+                    sm_k,
+                ) = fused_grow_step(
+                    st.order,
+                    begin_k,
+                    cnt_k,
+                    c_feat_k,
+                    c_bin_k,
+                    c_dl_k.astype(jnp.int32),
+                    nan_bins[c_feat_k],
+                    c_cis_k.astype(jnp.int32),
+                    c_cmask_k.astype(jnp.float32),
+                    f=f_seg,
+                    num_bins=B,
+                    n_pad=n_pad_seg,
+                    quant_scales=seg_qs,
+                    wide=seg_wide,
+                )
+            left_smaller_k = nleft_k <= nright_k
+        elif use_seg:
             begin_k = st.leaf_begin[l_k]
             cnt_k = jnp.where(active_k, st.leaf_nrows[l_k], 0)
             with jax.named_scope("partition"):
@@ -2326,6 +2421,7 @@ def grow_tree(
             done=done,
             forced_ok=forced_ok_next,
             cegb_used=st.cegb_used,
+            steps=st.steps + 1,
         )
 
     with jax.named_scope("leaf_loop"):
@@ -2376,6 +2472,7 @@ def grow_tree(
         leaf_count=state.leaf_cnt,
         leaf_depth=state.leaf_depth,
         num_leaves=state.num_leaves,
+        grow_steps=state.steps,
         split_is_cat=state.split_is_cat,
         cat_mask=state.node_cat_mask,
     )

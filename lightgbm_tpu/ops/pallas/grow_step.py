@@ -1,0 +1,378 @@
+"""Fused grow step — partition + smaller-child histogram in ONE Pallas launch.
+
+The frontier-batched grower (ops/grower.py, leaf_batch=K) already amortizes
+per-split fixed cost, but each compiled step still runs partition ->
+election -> histogram as separately-launched regions with full HBM
+round-trips and dispatch gaps between them (the 36% "bookkeeping" share in
+BENCH_NOTES round 8).  This kernel fuses the per-member pipeline: for each
+of the K disjoint frontier windows, one grid program
+
+  1. streams the window once and stably partitions it in place
+     (partition._partition_window — the exact machinery of the standalone
+     seg partition kernel);
+  2. elects the smaller child locally (nl <= cnt - nl — the grower's
+     single-host election; under tree_learner=data the election needs a
+     psum of per-shard counts MID-STEP, which is why the fused path only
+     engages when no axis_name is set and the two-launch path remains the
+     data-parallel fallback);
+  3. histograms the smaller child over the freshly-partitioned rows
+     (seg._hist_window), reading tiles through the OUTPUT alias so phase 3
+     observes phase 1's writes (partition.read_aliased_tile — the same
+     idiom that fixes cross-program boundary reads, and the reason the
+     fused kernel works at all: the partition happened in the SAME program
+     invocation);
+
+and emits the packed per-member split decision (nl, nr, child_start,
+child_cnt) plus the stacked [K, 3, F*bpad] histogram block.  The best-split
+scan stays a separate launch: it needs the psummed histogram under
+tree_learner=data and the parent-minus-child sibling subtraction, neither
+of which is per-member-local.  On the basic numeric path it runs as the
+existing fused Pallas scan (ops/pallas/split_scan.py), so the whole grow
+step is two kernel launches instead of three compiled regions plus their
+dispatch boundaries.
+
+The XLA composition (`sort_partition_xla` chain + local election + masked
+reference histogram) is the always-available fallback AND the correctness
+oracle — it is definitionally the same computation the two-launch grower
+path performs, so CPU results are byte-identical by construction and
+tests/test_fused_step.py asserts the Pallas kernel (interpret mode off-TPU)
+matches it bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...obs.jit import instrumented_jit
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from .partition import T, W, _partition_window, read_aliased_tile
+from .seg import (
+    COL_ALIGN,
+    TILE,
+    _hist_window,
+    hist_bpad,
+    hist_group,
+    hist_sub,
+    used_lanes,
+)
+
+# Test hook: route the fused step through the Pallas interpret-mode kernel
+# even off-TPU (tools/run_tests.sh smoke + tests/test_fused_step.py).  Read
+# at TRACE time — flip it before the first train in a fresh process, or use
+# params that force a fresh trace; a cached trace keeps the path it was
+# traced with (the XLA oracle, which is parity-identical).
+_INTERPRET = False
+
+
+def _fused_grow_kernel(
+    scal_ref,  # SMEM [K, 8] i32: sbegin, cnt, feat, tbin, dl, nanb, iscat, 0
+    scales_ref,  # SMEM [2] f32: g_scale, h_scale (quantized mode; else 1s)
+    seg_any,  # ANY [LANES, n_pad] i16 (aliased to seg_out)
+    cat_ref,  # VMEM [1, bmt] f32 block — bin -> goes-left, one row/program
+    tri_ref,  # VMEM [T, T] bf16 — tri[i, j] = (i <= j), cumsum-by-matmul
+    gl_any,  # ANY [1, COL_ALIGN] f32 dummy (featpar never takes this path)
+    seg_out,  # ANY [LANES, n_pad] i16 (aliased with seg_any)
+    scratch_out,  # ANY [SUB_P, n_pad] i16 — partition right-stream spill
+    dec_ref,  # SMEM [K, 4] i32: nl, nr, child_start, child_cnt per member
+    hist_ref,  # VMEM [1, 3, F * bpad] f32 block, one row per program
+    in_stage,  # VMEM [SUB_P, T] i16 — partition staging
+    out_stage,  # VMEM [SUB_P, T] i16
+    stage_lo,  # VMEM [SUB_P, W] f32
+    stage_hi,  # VMEM [SUB_P, W] f32
+    rstage_lo,  # VMEM [SUB_P, W] f32
+    rstage_hi,  # VMEM [SUB_P, W] f32
+    gl_stage,  # VMEM [1, T] f32 (unused: use_gl is always False here)
+    hist_stage,  # VMEM [SUB_H, TILE] i16 — histogram staging
+    acc,  # VMEM [8 | 4, F * bpad] f32 | i32
+    onehot,  # VMEM [TILE, group * bpad] bf16 | i8
+    sem_in,
+    sem_out,
+    sem_gl,
+    sem_hist,
+    *,
+    f: int,
+    n_pad: int,
+    use_cat: bool,
+    sub_p: int,
+    sub_h: int,
+    wide: bool,
+    bmt: int,
+    bpad: int,
+    group: int,
+    quantized: bool,
+    read_via_input: bool = False,
+):
+    i = pl.program_id(0)
+    sbegin = scal_ref[i, 0]
+    cnt = scal_ref[i, 1]
+
+    # ---- phase 1: in-place stable partition of this member's window
+    nl = _partition_window(
+        sbegin,
+        cnt,
+        scal_ref[i, 2],
+        scal_ref[i, 3],
+        scal_ref[i, 4],
+        scal_ref[i, 5],
+        scal_ref[i, 6],
+        seg_any,
+        seg_out,
+        scratch_out,
+        cat_ref,
+        tri_ref,
+        gl_any,
+        in_stage,
+        out_stage,
+        stage_lo,
+        stage_hi,
+        rstage_lo,
+        rstage_hi,
+        gl_stage,
+        sem_in,
+        sem_out,
+        sem_gl,
+        use_cat=use_cat,
+        sub=sub_p,
+        wide=wide,
+        bmt=bmt,
+        use_gl=False,
+        read_via_input=read_via_input,
+    )
+
+    # ---- phase 2: local smaller-child election (single-host rule; the
+    # data-parallel psummed election cannot live mid-kernel, so that mode
+    # keeps the two-launch path — see module docstring)
+    nr = cnt - nl
+    left_smaller = nl <= nr
+    child_start = sbegin + jnp.where(left_smaller, 0, nl)
+    child_cnt = jnp.where(left_smaller, nl, nr)
+
+    # ---- phase 3: smaller-child histogram over the JUST-partitioned rows;
+    # tiles come through the output alias so phase 1's writes are visible
+    def read_fn(base_col):
+        return read_aliased_tile(
+            seg_any, seg_out, hist_stage, sem_hist, base_col,
+            read_via_input=read_via_input,
+        )
+
+    row0, row1, row2 = _hist_window(
+        child_start,
+        child_cnt,
+        read_fn,
+        scales_ref,
+        acc,
+        onehot,
+        f=f,
+        bpad=bpad,
+        group=group,
+        quantized=quantized,
+        wide=wide,
+    )
+    dec_ref[i, 0] = nl
+    dec_ref[i, 1] = nr
+    dec_ref[i, 2] = child_start
+    dec_ref[i, 3] = child_cnt
+    hist_ref[0, 0, :] = row0
+    hist_ref[0, 1, :] = row1
+    hist_ref[0, 2, :] = row2
+
+
+@functools.partial(
+    instrumented_jit,
+    static_argnames=(
+        "f", "num_bins", "n_pad", "use_cat", "quantized", "wide",
+        "interpret", "read_via_input",
+    ),
+)
+def fused_grow_step_pallas(
+    seg: jnp.ndarray,  # [LANES, n_pad] i16 plane-major packed rows
+    scal: jnp.ndarray,  # [K, 8] i32 rows: sbegin, cnt, feat, tbin, dl,
+    #                     nanb, iscat, 0 — one DISJOINT window per member
+    catmask: jnp.ndarray,  # [K, bmt] f32 (bmt >= 256, 128-multiple)
+    scales: jnp.ndarray,  # [2] f32 grid scales (quantized; else 1s)
+    *,
+    f: int,
+    num_bins: int,
+    n_pad: int,
+    use_cat: bool,
+    quantized: bool = False,
+    wide: bool = False,
+    interpret: bool = False,
+    read_via_input: bool = False,
+):
+    """K fused partition+election+histogram steps in ONE kernel launch.
+
+    Returns (seg', dec[K, 4], hist[K, F, B, 3]) with dec rows
+    (nl, nr, child_start, child_cnt).  Grid programs run sequentially on
+    the core, so the in-place aliasing and shared scratch stay safe
+    program-to-program (same argument as the batched partition kernel)."""
+    k = scal.shape[0]
+    lanes = seg.shape[0]
+    bmt = catmask.shape[1]
+    # partition DMAs need second-minor 8-sublane multiples; hist tiles DMA
+    # only the used planes padded to an i16 sublane multiple
+    sub_p = -(-used_lanes(f, wide) // 8) * 8
+    sub_h = hist_sub(f, wide)
+    bpad = hist_bpad(num_bins)
+    group = hist_group(f, bpad)
+    tri = jnp.tril(jnp.ones((T, T), jnp.bfloat16)).T  # tri[i, j] = i <= j
+    gl_arr = jnp.zeros((1, COL_ALIGN), jnp.float32)
+    kernel = functools.partial(
+        _fused_grow_kernel, f=f, n_pad=n_pad, use_cat=use_cat, sub_p=sub_p,
+        sub_h=sub_h, wide=wide, bmt=bmt, bpad=bpad, group=group,
+        quantized=quantized, read_via_input=read_via_input,
+    )
+    seg_new, _, dec, hist = pl.pallas_call(
+        kernel,
+        grid=(k,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, bmt), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (1, 3, f * bpad), lambda i: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((lanes, n_pad), jnp.int16),
+            jax.ShapeDtypeStruct((sub_p, n_pad), jnp.int16),
+            jax.ShapeDtypeStruct((k, 4), jnp.int32),
+            jax.ShapeDtypeStruct((k, 3, f * bpad), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((sub_p, T), jnp.int16),
+            pltpu.VMEM((sub_p, T), jnp.int16),
+            pltpu.VMEM((sub_p, W), jnp.float32),
+            pltpu.VMEM((sub_p, W), jnp.float32),
+            pltpu.VMEM((sub_p, W), jnp.float32),
+            pltpu.VMEM((sub_p, W), jnp.float32),
+            pltpu.VMEM((1, T), jnp.float32),
+            pltpu.VMEM((sub_h, TILE), jnp.int16),
+            pltpu.VMEM(
+                (4, f * bpad) if quantized else (8, f * bpad),
+                jnp.int32 if quantized else jnp.float32,
+            ),
+            pltpu.VMEM(
+                (TILE, group * bpad), jnp.int8 if quantized else jnp.bfloat16
+            ),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA,
+        ],
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(scal.astype(jnp.int32), scales.astype(jnp.float32), seg, catmask, tri,
+      gl_arr)
+    hist = hist.reshape(k, 3, f, bpad)[:, :, :, :num_bins].transpose(0, 2, 3, 1)
+    return seg_new, dec, hist
+
+
+def fused_grow_step(
+    seg,
+    sbegins,  # [K] i32 — segment begins (disjoint windows; K=1 for serial)
+    cnts,  # [K] i32 — segment rows (0 = no-op member)
+    feats,  # [K] i32
+    tbins,  # [K] i32
+    dls,  # [K] i32
+    nanbs,  # [K] i32
+    iscats,  # [K] i32
+    catmasks,  # [K, Bm] f32
+    *,
+    f: int,
+    num_bins: int,
+    n_pad: int,
+    quant_scales=None,
+    wide: bool = False,
+):
+    """Platform dispatch for the fused grow step.
+
+    TPU: one K-program Pallas launch (int8 grid accumulation when
+    ``quant_scales`` is given, like seg_hist).  Elsewhere: the XLA oracle
+    composition — sequential stable-sort partitions (disjoint windows make
+    the chain order-independent), the same local election, and the masked
+    reference histogram; exactly the computation the two-launch grower path
+    performs, so CPU training is byte-identical by construction.  The
+    ``_INTERPRET`` hook routes off-TPU calls through the interpret-mode
+    kernel instead, which is how tier-1 exercises the kernel without a TPU.
+
+    Returns (seg', nl[K], nr[K], child_start[K], child_cnt[K],
+    hist[K, F, B, 3])."""
+    from ..segpart import sort_partition_xla
+    from .seg import seg_hist_ref
+
+    k = sbegins.shape[0]
+    quantized = quant_scales is not None
+    scales = (
+        jnp.stack([quant_scales[0], quant_scales[1]]).astype(jnp.float32)
+        if quantized
+        else jnp.ones((2,), jnp.float32)
+    )
+
+    def _pallas(seg, sbegins, cnts, feats, tbins, dls, nanbs, iscats,
+                catmasks, scales, interpret=False):
+        bm = catmasks.shape[1]
+        bmt = max(256, -(-bm // 128) * 128)  # cat-table width (wide bins)
+        catm = jnp.zeros((k, bmt), jnp.float32)
+        catm = catm.at[:, :bm].set(catmasks.astype(jnp.float32))
+        scal = jnp.stack(
+            [sbegins, cnts, feats, tbins, dls, nanbs, iscats,
+             jnp.zeros_like(sbegins)],
+            axis=1,
+        ).astype(jnp.int32)
+        seg_new, dec, hist = fused_grow_step_pallas(
+            seg, scal, catm, scales, f=f, num_bins=num_bins, n_pad=n_pad,
+            use_cat=bm > 1, quantized=quantized, wide=wide,
+            interpret=interpret,
+        )
+        return seg_new, dec[:, 0], dec[:, 1], dec[:, 2], dec[:, 3], hist
+
+    def _xla(seg, sbegins, cnts, feats, tbins, dls, nanbs, iscats,
+             catmasks, _scales):
+        # the oracle ignores quant_scales, matching seg_hist's CPU behavior
+        nls = []
+        for i in range(k):
+            seg, nl_i, _ = sort_partition_xla(
+                seg, sbegins[i], cnts[i], feats[i], tbins[i], dls[i],
+                nanbs[i], iscats[i], catmasks[i],
+                f=f, n_pad=n_pad, wide=wide, use_gl_vec=False,
+            )
+            nls.append(nl_i)
+        nl = jnp.stack(nls)
+        nr = cnts - nl
+        left_smaller = nl <= nr
+        child_start = sbegins + jnp.where(left_smaller, 0, nl)
+        child_cnt = jnp.where(left_smaller, nl, nr)
+        hist = jax.vmap(
+            lambda s: seg_hist_ref(
+                seg, s, f=f, num_bins=num_bins, n_pad=n_pad, wide=wide
+            )
+        )(jnp.stack([child_start, child_cnt], axis=1).astype(jnp.int32))
+        return seg, nl, nr, child_start, child_cnt, hist
+
+    args = (seg, sbegins, cnts, feats, tbins, dls, nanbs, iscats, catmasks,
+            scales)
+    if jax.default_backend() != "tpu":
+        if _INTERPRET:
+            return _pallas(*args, interpret=True)
+        return _xla(*args)
+    return jax.lax.platform_dependent(*args, tpu=_pallas, default=_xla)

@@ -33,6 +33,12 @@ Algorithm (stable, in place, ~2.5 HBM passes over the window):
 Stability: both children preserve original row order (streams keep tile
 order and the in-tile compaction keeps column order), so results are
 bit-identical to the stable-sort path this replaces.
+
+The per-window body is factored into ``_partition_window`` so the fused
+grow-step kernel (ops/pallas/grow_step.py) can run partition + smaller-child
+histogram in ONE launch; ``read_aliased_tile`` is the shared
+read-through-the-output-alias helper both kernels use (see its docstring
+for the interpret-mode aliasing pitfall it guards against).
 """
 
 from __future__ import annotations
@@ -64,46 +70,70 @@ def _bytes_bf16(xu):
     return lo, hi
 
 
-def _seg_partition_kernel(
-    scal_ref,  # SMEM [K, 8] i32: sbegin, cnt, feat, tbin, dl, nanb, iscat,
-    #          pad — one row per grid program (K=1 for the serial call)
+def read_aliased_tile(seg_in, seg_out, stage, sem, base_col, *,
+                      read_via_input: bool = False):
+    """DMA one aligned ``[sub, cols]`` tile of an IN-PLACE (input/output-
+    aliased) packed segment matrix into VMEM ``stage``; return u16-in-i32.
+
+    Reads go through the OUTPUT alias, not the input ref: on TPU they are
+    the same HBM buffer, but batched grids re-read boundary tiles an
+    earlier program (or an earlier phase of the SAME program, in the fused
+    grow-step kernel) already rewrote — adjacent leaf windows share
+    COL_ALIGN blocks — and Pallas interpret mode only makes those writes
+    visible on the output ref.  Shared by the seg partition kernel and the
+    fused grow-step kernel (ops/pallas/grow_step.py).
+
+    ``read_via_input=True`` recreates the PR-3 aliasing bug by reading the
+    input ref instead — a TEST-ONLY knob for the regression test in
+    tests/test_partition_kernel.py; never set it from production code.
+    """
+    sub, cols = stage.shape
+    src = seg_in if read_via_input else seg_out
+    dma = pltpu.make_async_copy(
+        src.at[pl.ds(0, sub), pl.ds(pl.multiple_of(base_col, COL_ALIGN), cols)],
+        stage,
+        sem,
+    )
+    dma.start()
+    dma.wait()
+    return stage[...].astype(jnp.int32) & 0xFFFF
+
+
+def _partition_window(
+    sbegin,  # scalar i32 — segment begin
+    cnt,  # scalar i32 — segment rows (0 = no-op)
+    feat,  # scalar i32 — split feature (used-feature index)
+    tbin,  # scalar i32
+    dl,  # scalar i32 (default-left)
+    nanb,  # scalar i32 (NaN bin or -1)
+    iscat,  # scalar i32
     seg_any,  # ANY [LANES, n_pad] i16 (aliased to seg_out)
-    cat_ref,  # VMEM [1, 256] f32 — bin -> goes-left (categorical); batched
-    #          calls block a [K, bmt] table to one row per program
-    tri_ref,  # VMEM [T, T] bf16 — tri[i, j] = (i <= j), cumsum-by-matmul
-    gl_any,  # ANY [1, n_pad] f32 — precomputed go-left bits (use_gl; else
-    #          a [1, COL_ALIGN] dummy)
     seg_out,  # ANY [LANES, n_pad] i16 (aliased with seg_any)
     scratch_out,  # ANY [SUB, n_pad] i16 — right-stream spill
-    nl_ref,  # SMEM [K, 1] i32 — rows of the segment going left, per program
+    cat_ref,  # VMEM [1, bmt] f32 — bin -> goes-left (categorical)
+    tri_ref,  # VMEM [T, T] bf16 — tri[i, j] = (i <= j), cumsum-by-matmul
+    gl_any,  # ANY [1, n_pad] f32 go-left bits, or None when not use_gl
     in_stage,  # VMEM [SUB, T] i16
     out_stage,  # VMEM [SUB, T] i16
     stage_lo,  # VMEM [SUB, W] f32 — left/main stream staging (lo bytes)
     stage_hi,  # VMEM [SUB, W] f32
     rstage_lo,  # VMEM [SUB, W] f32 — right stream staging
     rstage_hi,  # VMEM [SUB, W] f32
-    gl_stage,  # VMEM [1, T] f32 — go-left tile (use_gl)
+    gl_stage,  # VMEM [1, T] f32 go-left tile, or None when not use_gl
     sem_in,
     sem_out,
     sem_gl,
     *,
-    f: int,
-    n_pad: int,
     use_cat: bool,
     sub: int,
     wide: bool,
     bmt: int,
     use_gl: bool,
+    read_via_input: bool = False,
 ):
-    pid = pl.program_id(0)
-    sbegin = scal_ref[pid, 0]
-    cnt = scal_ref[pid, 1]
-    feat = scal_ref[pid, 2]
-    tbin = scal_ref[pid, 3]
-    dl = scal_ref[pid, 4]
-    nanb = scal_ref[pid, 5]
-    iscat = scal_ref[pid, 6]
-
+    """Stable in-place partition of ONE leaf window (the per-program body of
+    the seg partition kernel, factored out so the fused grow-step kernel can
+    run it before its histogram phase).  Returns nl — rows going left."""
     abegin = (sbegin // COL_ALIGN) * COL_ALIGN
     off = sbegin - abegin
     nt = (off + cnt + T - 1) // T
@@ -119,7 +149,6 @@ def _seg_partition_kernel(
     stage_hi[...] = jnp.zeros_like(stage_hi)
     rstage_lo[...] = jnp.zeros_like(rstage_lo)
     rstage_hi[...] = jnp.zeros_like(rstage_hi)
-    nl_ref[pid, 0] = 0
 
     def _append(lo, hi, keep, fill, slo, shi):
         """Matmul-compact `keep` columns of the tile into staging at `fill`.
@@ -182,23 +211,14 @@ def _seg_partition_kernel(
         doi = do.astype(jnp.int32)
         return fill - doi * T, nblk + doi
 
-    def _read_tile(src, base_col):
-        dma = pltpu.make_async_copy(
-            src.at[pl.ds(0, sub), pl.ds(pl.multiple_of(base_col, COL_ALIGN), T)],
-            in_stage,
-            sem_in,
-        )
-        dma.start()
-        dma.wait()
-        return in_stage[...].astype(jnp.int32) & 0xFFFF  # [SUB, T]
-
     def body1(t, carry):
         fill_l, bl, fill_r, br, nl = carry
-        # read through the OUTPUT alias, not seg_any: on TPU they are the
-        # same buffer, but batched grids re-read boundary tiles an earlier
-        # program rewrote (adjacent leaf windows share COL_ALIGN blocks) and
-        # interpret mode only makes those writes visible on the output ref
-        xu = _read_tile(seg_out, abegin + t * T)
+        # boundary tiles must come through the OUTPUT alias — see
+        # read_aliased_tile for the interpret-mode pitfall this guards
+        xu = read_aliased_tile(
+            seg_any, seg_out, in_stage, sem_in, abegin + t * T,
+            read_via_input=read_via_input,
+        )
         rpos = iota_j + t * T
         in_seg = (rpos >= off) & (rpos < off + cnt)
         if use_gl:
@@ -273,7 +293,6 @@ def _seg_partition_kernel(
         body1,
         (jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
     )
-    nl_ref[pid, 0] = nl
 
     # spill the partial right-stream block (cols beyond fill_r are garbage;
     # pass 2 masks them out via the stream length)
@@ -296,7 +315,9 @@ def _seg_partition_kernel(
 
     def body2(t2, carry):
         fill_l, bl = carry
-        xu = _read_tile(scratch_out, t2 * T)
+        xu = read_aliased_tile(
+            scratch_out, scratch_out, in_stage, sem_in, t2 * T,
+        )
         spos = iota_j + t2 * T
         keep = spos < sr
         lo, hi = _bytes_bf16(xu)
@@ -305,10 +326,80 @@ def _seg_partition_kernel(
         return fill_l, bl
 
     lax.fori_loop(0, nt2, body2, (fill_l, bl))
+    return nl
+
+
+def _seg_partition_kernel(
+    scal_ref,  # SMEM [K, 8] i32: sbegin, cnt, feat, tbin, dl, nanb, iscat,
+    #          pad — one row per grid program (K=1 for the serial call)
+    seg_any,  # ANY [LANES, n_pad] i16 (aliased to seg_out)
+    cat_ref,  # VMEM [1, 256] f32 — bin -> goes-left (categorical); batched
+    #          calls block a [K, bmt] table to one row per program
+    tri_ref,  # VMEM [T, T] bf16 — tri[i, j] = (i <= j), cumsum-by-matmul
+    gl_any,  # ANY [1, n_pad] f32 — precomputed go-left bits (use_gl; else
+    #          a [1, COL_ALIGN] dummy)
+    seg_out,  # ANY [LANES, n_pad] i16 (aliased with seg_any)
+    scratch_out,  # ANY [SUB, n_pad] i16 — right-stream spill
+    nl_ref,  # SMEM [K, 1] i32 — rows of the segment going left, per program
+    in_stage,  # VMEM [SUB, T] i16
+    out_stage,  # VMEM [SUB, T] i16
+    stage_lo,  # VMEM [SUB, W] f32 — left/main stream staging (lo bytes)
+    stage_hi,  # VMEM [SUB, W] f32
+    rstage_lo,  # VMEM [SUB, W] f32 — right stream staging
+    rstage_hi,  # VMEM [SUB, W] f32
+    gl_stage,  # VMEM [1, T] f32 — go-left tile (use_gl)
+    sem_in,
+    sem_out,
+    sem_gl,
+    *,
+    f: int,
+    n_pad: int,
+    use_cat: bool,
+    sub: int,
+    wide: bool,
+    bmt: int,
+    use_gl: bool,
+    read_via_input: bool = False,
+):
+    pid = pl.program_id(0)
+    nl = _partition_window(
+        scal_ref[pid, 0],
+        scal_ref[pid, 1],
+        scal_ref[pid, 2],
+        scal_ref[pid, 3],
+        scal_ref[pid, 4],
+        scal_ref[pid, 5],
+        scal_ref[pid, 6],
+        seg_any,
+        seg_out,
+        scratch_out,
+        cat_ref,
+        tri_ref,
+        gl_any,
+        in_stage,
+        out_stage,
+        stage_lo,
+        stage_hi,
+        rstage_lo,
+        rstage_hi,
+        gl_stage,
+        sem_in,
+        sem_out,
+        sem_gl,
+        use_cat=use_cat,
+        sub=sub,
+        wide=wide,
+        bmt=bmt,
+        use_gl=use_gl,
+        read_via_input=read_via_input,
+    )
+    nl_ref[pid, 0] = nl
 
 
 @functools.partial(
-    instrumented_jit, static_argnames=("f", "n_pad", "use_cat", "wide", "interpret")
+    instrumented_jit,
+    static_argnames=("f", "n_pad", "use_cat", "wide", "interpret",
+                     "read_via_input"),
 )
 def seg_partition_pallas(
     seg: jnp.ndarray,  # [LANES, n_pad] i16 plane-major packed rows
@@ -321,12 +412,15 @@ def seg_partition_pallas(
     use_cat: bool,
     wide: bool = False,
     interpret: bool = False,
+    read_via_input: bool = False,
 ):
     """Partition seg[sbegin : sbegin+cnt) by the split rule, in place.
 
     ``gl_vec``: the go-left decision comes from precomputed bits instead of
     the feature column (feature-parallel seg — only the owning shard holds
     the winner's bin plane).
+
+    ``read_via_input``: test-only knob (see read_aliased_tile).
 
     Returns (seg', nl).  Left child lands at [sbegin, sbegin+nl), right at
     [sbegin+nl, sbegin+cnt), both in stable (original) order; every column
@@ -345,6 +439,7 @@ def seg_partition_pallas(
     kernel = functools.partial(
         _seg_partition_kernel, f=f, n_pad=n_pad, use_cat=use_cat, sub=sub,
         wide=wide, bmt=catmask.shape[1], use_gl=use_gl,
+        read_via_input=read_via_input,
     )
     seg_new, _, nl = pl.pallas_call(
         kernel,
@@ -385,7 +480,9 @@ def seg_partition_pallas(
 
 
 @functools.partial(
-    instrumented_jit, static_argnames=("f", "n_pad", "use_cat", "wide", "interpret")
+    instrumented_jit,
+    static_argnames=("f", "n_pad", "use_cat", "wide", "interpret",
+                     "read_via_input"),
 )
 def seg_partition_pallas_batch(
     seg: jnp.ndarray,  # [LANES, n_pad] i16 plane-major packed rows
@@ -398,6 +495,7 @@ def seg_partition_pallas_batch(
     use_cat: bool,
     wide: bool = False,
     interpret: bool = False,
+    read_via_input: bool = False,
 ):
     """K in-place stable partitions over K disjoint windows in ONE launch.
 
@@ -409,6 +507,8 @@ def seg_partition_pallas_batch(
     Frontier-batched growth (ops/grower.py leaf_batch) pays ONE program's
     fixed cost for K splits.
 
+    ``read_via_input``: test-only knob (see read_aliased_tile).
+
     Returns (seg', nl[K])."""
     k = scal.shape[0]
     sub = -(-used_lanes(f, wide) // 8) * 8
@@ -418,7 +518,7 @@ def seg_partition_pallas_batch(
     gl_arr = jnp.zeros((1, COL_ALIGN), jnp.float32)
     kernel = functools.partial(
         _seg_partition_kernel, f=f, n_pad=n_pad, use_cat=use_cat, sub=sub,
-        wide=wide, bmt=bmt, use_gl=False,
+        wide=wide, bmt=bmt, use_gl=False, read_via_input=read_via_input,
     )
     seg_new, _, nl = pl.pallas_call(
         kernel,

@@ -209,27 +209,43 @@ def unpack_stats(seg: jnp.ndarray, f: int, n: Optional[int] = None,
 _TARGET_LANES = 2048
 
 
-def _seg_hist_kernel(
-    scal_ref,  # SMEM [K, 2] i32: (start, cnt) per grid program (K=1 serial)
+def hist_bpad(num_bins: int) -> int:
+    """Bin-axis padding (128-lane multiple) used by the hist kernels."""
+    return (max(num_bins, 1) + 127) // 128 * 128
+
+
+def hist_group(f: int, bpad: int) -> int:
+    """Features per one-hot matmul group (bounded by the MXU lane target)."""
+    return min(max(1, _TARGET_LANES // bpad), f)
+
+
+def hist_sub(f: int, wide: bool) -> int:
+    """DMA sublanes: only the used planes (bins + stats), padded to an i16
+    sublane multiple — 32 planes at F=28, 4x less tile traffic than the
+    128-plane cap."""
+    return min(storage_lanes(f, wide), (used_lanes(f, wide) + 15) // 16 * 16)
+
+
+def _hist_window(
+    start,  # scalar i32 — window begin (data-row index)
+    cnt,  # scalar i32 — window rows (0 = all-zero histogram)
+    read_fn,  # (base_col: i32) -> [SUB, TILE] u16-in-i32 staged tile
     scales_ref,  # SMEM [2] f32: g_scale, h_scale (quantized mode; else 1s)
-    seg_any,  # ANY [LANES, n_pad] i16 (plane-major)
-    out_ref,  # VMEM [3, F * bpad] f32 (batched: [1, 3, F * bpad] block)
-    in_stage,  # VMEM [SUB, TILE] i16 — only the used planes are DMA'd
     acc,  # VMEM [8 | 4, F * bpad] f32 | i32
     onehot,  # VMEM [TILE, group * bpad] bf16 | i8
-    sem_in,
     *,
     f: int,
     bpad: int,
     group: int,
-    sub: int,
     quantized: bool,
     wide: bool,
-    batched: bool = False,
 ):
-    i = pl.program_id(0)
-    start = scal_ref[i, 0]
-    cnt = scal_ref[i, 1]
+    """Histogram accumulation over ONE packed-row window (the per-program
+    body of the seg hist kernel, factored out so the fused grow-step kernel
+    can run it over just-partitioned data — its ``read_fn`` reads tiles
+    through the output alias; see partition.read_aliased_tile).
+
+    Returns (g_row, h_row, count_row), each [F * bpad] f32."""
     abegin = (start // COL_ALIGN) * COL_ALIGN
     off = start - abegin
     nt = (off + cnt + TILE - 1) // TILE
@@ -244,18 +260,8 @@ def _seg_hist_kernel(
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (TILE, bpad), 1)
 
     def body(t, _):
-        dma = pltpu.make_async_copy(
-            seg_any.at[
-                pl.ds(0, sub),
-                pl.ds(pl.multiple_of(abegin + t * TILE, COL_ALIGN), TILE),
-            ],
-            in_stage,
-            sem_in,
-        )
-        dma.start()
-        dma.wait()
         # transpose the plane-major tile to row-major for the one-hot matmul
-        xu = (in_stage[...].astype(jnp.int32) & 0xFFFF).T  # [TILE, SUB]
+        xu = read_fn(abegin + t * TILE).T  # [TILE, SUB]
         pos = iota_rows + t * TILE
         valid = ((pos >= off) & (pos < off + cnt)).astype(jnp.float32)
         g = lax.bitcast_convert_type(
@@ -360,6 +366,55 @@ def _seg_hist_kernel(
         row0 = acc[0, :] + acc[3, :] + acc[6, :]
         row1 = acc[1, :] + acc[4, :] + acc[7, :]
         row2 = acc[2, :] + acc[5, :]
+    return row0, row1, row2
+
+
+def _seg_hist_kernel(
+    scal_ref,  # SMEM [K, 2] i32: (start, cnt) per grid program (K=1 serial)
+    scales_ref,  # SMEM [2] f32: g_scale, h_scale (quantized mode; else 1s)
+    seg_any,  # ANY [LANES, n_pad] i16 (plane-major)
+    out_ref,  # VMEM [3, F * bpad] f32 (batched: [1, 3, F * bpad] block)
+    in_stage,  # VMEM [SUB, TILE] i16 — only the used planes are DMA'd
+    acc,  # VMEM [8 | 4, F * bpad] f32 | i32
+    onehot,  # VMEM [TILE, group * bpad] bf16 | i8
+    sem_in,
+    *,
+    f: int,
+    bpad: int,
+    group: int,
+    sub: int,
+    quantized: bool,
+    wide: bool,
+    batched: bool = False,
+):
+    i = pl.program_id(0)
+
+    def read_fn(base_col):
+        dma = pltpu.make_async_copy(
+            seg_any.at[
+                pl.ds(0, sub),
+                pl.ds(pl.multiple_of(base_col, COL_ALIGN), TILE),
+            ],
+            in_stage,
+            sem_in,
+        )
+        dma.start()
+        dma.wait()
+        return in_stage[...].astype(jnp.int32) & 0xFFFF
+
+    row0, row1, row2 = _hist_window(
+        scal_ref[i, 0],
+        scal_ref[i, 1],
+        read_fn,
+        scales_ref,
+        acc,
+        onehot,
+        f=f,
+        bpad=bpad,
+        group=group,
+        quantized=quantized,
+        wide=wide,
+    )
     if batched:
         out_ref[0, 0, :] = row0
         out_ref[0, 1, :] = row1
@@ -390,11 +445,9 @@ def seg_hist_pallas(
 
     ``quantized=True`` (requires ``scales``): integer grid accumulation on
     the int8 MXU path — exact and ~2x the bf16 throughput."""
-    bpad = (max(num_bins, 1) + 127) // 128 * 128
-    group = min(max(1, _TARGET_LANES // bpad), f)
-    # DMA only the used planes (bins + stats), padded to an i16 sublane
-    # multiple — 32 planes at F=28, 4x less tile traffic than the 128 cap
-    sub = min(storage_lanes(f, wide), (used_lanes(f, wide) + 15) // 16 * 16)
+    bpad = hist_bpad(num_bins)
+    group = hist_group(f, bpad)
+    sub = hist_sub(f, wide)
     kernel = functools.partial(
         _seg_hist_kernel, f=f, bpad=bpad, group=group, sub=sub,
         quantized=quantized, wide=wide,
@@ -450,9 +503,9 @@ def seg_hist_pallas_batch(
     (ops/grower.py leaf_batch) uses this to build all K smaller-child
     histograms per step with one program's fixed cost."""
     k = scal.shape[0]
-    bpad = (max(num_bins, 1) + 127) // 128 * 128
-    group = min(max(1, _TARGET_LANES // bpad), f)
-    sub = min(storage_lanes(f, wide), (used_lanes(f, wide) + 15) // 16 * 16)
+    bpad = hist_bpad(num_bins)
+    group = hist_group(f, bpad)
+    sub = hist_sub(f, wide)
     kernel = functools.partial(
         _seg_hist_kernel, f=f, bpad=bpad, group=group, sub=sub,
         quantized=quantized, wide=wide, batched=True,

@@ -183,3 +183,116 @@ def test_partition_kernel_batch_matches_serial_loop():
         nls.append(int(nl))
     assert [int(v) for v in nl_b] == nls
     assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def _aliasing_case():
+    """Batched K=2 case where the windows are adjacent and the second
+    window's aligned DMA base falls INSIDE the first window: program 1
+    re-reads the shared COL_ALIGN boundary block that program 0's
+    partition already rewrote."""
+    from lightgbm_tpu.ops.pallas.seg import COL_ALIGN
+
+    rng = np.random.default_rng(21)
+    f, n = 9, 2000
+    n_pad = padded_rows(n)
+    bins = rng.integers(0, 256, size=(n, f)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    seg = pack_rows(
+        jnp.asarray(bins), jnp.asarray(g), jnp.ones((n,), jnp.float32),
+        jnp.ones((n,), jnp.float32), n_pad,
+    )
+    # window 0 ends mid-block at 900 (900 % 128 != 0), window 1 begins
+    # there: its aligned DMA base (896) re-reads the tail block window 0
+    # rewrote
+    assert 900 % COL_ALIGN != 0
+    rows = [
+        (0, 900, 3, 120, 0, -1, 0, 0),
+        (900, 1100, 5, 80, 0, -1, 0, 0),
+    ]
+    return seg, rows, f, n_pad
+
+
+def test_batch_aliased_boundary_reads_are_correct():
+    """Adjacent windows sharing a COL_ALIGN block: the batched kernel must
+    equal the sequential sort oracle (program 1 sees program 0's writes)."""
+    seg, rows, f, n_pad = _aliasing_case()
+    from lightgbm_tpu.ops.pallas.partition import seg_partition_pallas_batch
+
+    scal = jnp.asarray(rows, jnp.int32)
+    catm = jnp.zeros((2, 256), jnp.float32)
+    got, nl_b = seg_partition_pallas_batch(
+        seg, scal, catm, f=f, n_pad=n_pad, use_cat=False, interpret=True,
+    )
+    want = seg
+    nls = []
+    for r in rows:
+        want, nl, _ = sort_partition_xla(
+            want, *(jnp.int32(v) for v in r[:7]),
+            jnp.zeros((1,), jnp.float32), f=f, n_pad=n_pad,
+        )
+        nls.append(int(nl))
+    assert [int(v) for v in nl_b] == nls
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_read_via_input_recreates_aliasing_bug():
+    """Regression guard for read_aliased_tile: reading boundary tiles
+    through the INPUT ref of the input/output-aliased seg matrix (the
+    PR-3 bug) makes interpret mode serve stale pre-partition data to the
+    second program — this test FAILS (i.e. the outputs differ) if someone
+    reverts the helper to input-ref reads.  If this test ever starts
+    asserting equality, the read_via_input knob has stopped modelling the
+    bug and both it and this test should be removed together."""
+    seg, rows, f, n_pad = _aliasing_case()
+    from lightgbm_tpu.ops.pallas.partition import seg_partition_pallas_batch
+
+    scal = jnp.asarray(rows, jnp.int32)
+    catm = jnp.zeros((2, 256), jnp.float32)
+    good, _ = seg_partition_pallas_batch(
+        seg, scal, catm, f=f, n_pad=n_pad, use_cat=False, interpret=True,
+    )
+    bad, _ = seg_partition_pallas_batch(
+        seg, scal, catm, f=f, n_pad=n_pad, use_cat=False, interpret=True,
+        read_via_input=True,
+    )
+    assert not np.array_equal(np.asarray(bad), np.asarray(good)), (
+        "read_via_input=True no longer corrupts the shared boundary block; "
+        "the aliasing regression knob is not exercising the bug path"
+    )
+
+
+def test_fused_step_aliased_boundary_reads_are_correct():
+    """Same aliasing hazard through the FUSED grow-step kernel, which
+    re-reads partitioned tiles in its own histogram phase on top of the
+    program-to-program boundary: adjacent windows must still match the
+    oracle partition state and split decisions bit-for-bit (histogram is
+    bf16-vs-f32, compared at kernel tolerance)."""
+    from lightgbm_tpu.ops.pallas.grow_step import fused_grow_step_pallas
+    from lightgbm_tpu.ops.pallas.grow_step import fused_grow_step
+
+    seg, rows, f, n_pad = _aliasing_case()
+    scal = jnp.asarray(rows, jnp.int32)
+    catm = jnp.zeros((2, 256), jnp.float32)
+    ones = jnp.ones((2,), jnp.float32)
+    seg_k, dec, hist = fused_grow_step_pallas(
+        seg, scal, catm, ones, f=f, num_bins=256, n_pad=n_pad,
+        use_cat=False, interpret=True,
+    )
+    args = tuple(
+        jnp.asarray([rows[0][j], rows[1][j]], jnp.int32) for j in range(7)
+    )
+    want = fused_grow_step(
+        seg, *args, jnp.zeros((2, 1), jnp.float32),
+        f=f, num_bins=256, n_pad=n_pad,
+    )
+    assert np.array_equal(np.asarray(seg_k), np.asarray(want[0]))
+    assert np.array_equal(np.asarray(dec[:, 0]), np.asarray(want[1]))  # nl
+    np.testing.assert_allclose(
+        np.asarray(hist), np.asarray(want[5]), rtol=1e-3, atol=1e-3
+    )
+    # the input-ref read corrupts this kernel the same way
+    seg_bad, _, _ = fused_grow_step_pallas(
+        seg, scal, catm, ones, f=f, num_bins=256, n_pad=n_pad,
+        use_cat=False, interpret=True, read_via_input=True,
+    )
+    assert not np.array_equal(np.asarray(seg_bad), np.asarray(seg_k))

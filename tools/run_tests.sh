@@ -46,4 +46,39 @@ lgb.train(
 )
 PYEOF
 rm -f "$tel_out"
+
+# fused grow-step smoke: run the Pallas kernel itself (interpret mode,
+# JAX_PLATFORMS=cpu) through a 3-iteration train and require structural
+# parity with the XLA oracle.  A fresh process matters: grow_step._INTERPRET
+# is read at trace time, so flipping it next to an already-traced config
+# would silently reuse the oracle trace.
+echo "=== fused grow-step smoke (3-iteration interpret-mode train vs oracle) ==="
+python - <<'PYEOF' || rc=$?
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.pallas import grow_step
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(1200, 10)).astype(np.float32)
+y = (X[:, 0] + 0.6 * X[:, 1] + 0.1 * rng.normal(size=1200) > 0.2).astype(
+    np.float32)
+KEEP = ("split_feature=", "threshold=", "decision_type=", "left_child=",
+        "right_child=", "num_leaves=")
+
+def structure(**over):
+    p = dict(objective="binary", num_leaves=15, learning_rate=0.2,
+             hist_mode="seg", min_data_in_leaf=20, verbosity=-1,
+             deterministic=True, seed=7)
+    p.update(over)
+    b = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=3)
+    s = b.model_to_string()
+    return [l for l in s[s.index("Tree=0"):s.index("end of trees")].splitlines()
+            if l.startswith(KEEP)]
+
+ref = structure(grow_fused="off")
+grow_step._INTERPRET = True
+got = structure(grow_fused="on")
+assert got == ref, "fused interpret-mode structure diverged from oracle"
+print("fused grow-step interpret smoke: structure parity OK")
+PYEOF
 exit $rc
