@@ -21,6 +21,7 @@ from .boosting.gbdt import Booster
 from .callback import (
     EarlyStopException,
     TelemetryCallback,
+    checkpoint_callback,
     early_stopping,
     log_evaluation,
     print_evaluation,
@@ -43,6 +44,12 @@ from .obs import (
     compile_count,
     compile_counts_by_label,
     get_session,
+)
+from .resilience import (
+    NumericsError,
+    latest_checkpoint,
+    restore_checkpoint,
+    save_checkpoint,
 )
 from .parser import register_parser
 from .utils.log import register_logger, unregister_logger
@@ -77,6 +84,11 @@ __all__ = [
     "get_session",
     "compile_count",
     "compile_counts_by_label",
+    "NumericsError",
+    "checkpoint_callback",
+    "save_checkpoint",
+    "restore_checkpoint",
+    "latest_checkpoint",
     "plot_importance",
     "plot_metric",
     "plot_split_value_histogram",

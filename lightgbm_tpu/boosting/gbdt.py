@@ -31,6 +31,7 @@ from ..metrics import Metric, create_metric
 from ..obs.jit import compile_count as _obs_compile_count
 from ..obs.registry import get_session
 from ..objectives import ObjectiveFunction, create_objective
+from ..resilience import NumericsError, chaos
 from ..ops.grower import (
     GrowerParams,
     fetch_tree_arrays,
@@ -178,6 +179,8 @@ class Booster:
             ta_host = unpack_tree_arrays(
                 np.asarray(ints_d), np.asarray(floats_d), nn, L
             )
+            if self.config.check_numerics:
+                self._guard_tree(ta_host, pend.get("iter", self._iter - 1))
             if int(ta_host.num_leaves) > 1:
                 should_continue = True
                 self._note_commit_rate(ta_host)
@@ -323,7 +326,11 @@ class Booster:
                 )
             else:
                 pend.append((kk, None, None, 0, 0))
-        self._pending = {"classes": pend, "rate": self._shrinkage_rate}
+        self._pending = {
+            "classes": pend,
+            "rate": self._shrinkage_rate,
+            "iter": self._iter,
+        }
         self._iter += 1
         if prev is not None:
             with get_session().phase("host_materialize"):
@@ -921,9 +928,48 @@ class Booster:
 
         ses = get_session()
         with global_timer.timed("tree/grow"), ses.phase("grow"):
-            res = self._grow_one_inner(grad_k, hess_k, mask, feature_mask, rng)
-            ses.sync(res)
+            fused = self._mesh is None and bool(self._grower_params.grow_fused)
+            try:
+                if fused:
+                    # fault-injection consult: stands in for a Mosaic
+                    # compile/launch failure surfacing at dispatch
+                    chaos.maybe_raise_pallas("fused_grow_step", self._iter)
+                res = self._grow_one_inner(grad_k, hess_k, mask, feature_mask, rng)
+                ses.sync(res)
+            except Exception as exc:
+                if not fused:
+                    raise
+                self._degrade_fused(exc)
+                res = self._grow_one_inner(grad_k, hess_k, mask, feature_mask, rng)
+                ses.sync(res)
             return res
+
+    def _degrade_fused(self, exc: Exception) -> None:
+        """Permanently fall back from the fused Pallas grow step to the
+        two-launch XLA composition (the byte-identical correctness oracle)
+        after a kernel compile/launch failure.  The latch flips grow_fused
+        off in GrowerParams, so the cost is ONE bounded retrace — not a
+        retrace storm — and the run completes instead of dying."""
+        from ..utils.log import log_warning
+
+        self._grow_fused_disabled = True
+        self._grower_params = self._make_grower_params()
+        ses = get_session()
+        ses.inc("degradations")
+        ses.record(
+            {
+                "event": "degradation",
+                "component": "fused_grow_step",
+                "action": "fallback_to_xla_oracle",
+                "iter": int(self._iter),
+                "error": f"{type(exc).__name__}: {exc}"[:300],
+            }
+        )
+        log_warning(
+            "[resilience] fused Pallas grow step failed "
+            f"({type(exc).__name__}); permanently falling back to the "
+            "two-launch XLA path for the rest of the run"
+        )
 
     def _grow_one_inner(self, grad_k, hess_k, mask, feature_mask, rng):
         if self._mesh is not None:
@@ -1204,6 +1250,10 @@ class Booster:
             grow_fused = False
         else:  # 'auto' — on when the seg fast path is active
             grow_fused = hist_mode == "seg"
+        if getattr(self, "_grow_fused_disabled", False):
+            # a runtime kernel failure latched the XLA fallback
+            # (_degrade_fused); the latch survives checkpoint/restore
+            grow_fused = False
         return GrowerParams(
             num_leaves=cfg.num_leaves,
             max_bin=self._max_bin_padded,
@@ -1496,6 +1546,39 @@ class Booster:
 
         return _assemble(g), _assemble(h)
 
+    def _objective_name(self) -> str:
+        if self.objective is not None:
+            return type(self.objective).__name__
+        return str(self.params.get("objective", "custom"))
+
+    def _guard_gradients(self, grad, hess) -> None:
+        """check_numerics guard: ONE device-side finiteness reduce over
+        gradients+hessians per iteration, pulled as a single host bool.
+        Catches poisoned labels/init_score/learning-rate blowups at the
+        iteration that produced them instead of training NaN into the
+        model silently."""
+        ok = bool(jnp.isfinite(grad).all() & jnp.isfinite(hess).all())
+        if not ok:
+            raise NumericsError(
+                f"non-finite gradients/hessians at iteration {self._iter} "
+                f"(objective={self._objective_name()}); model state is "
+                "intact up to the previous iteration — inspect labels, "
+                "init_score, and learning_rate"
+            )
+
+    def _guard_tree(self, ta_host, iteration: int) -> None:
+        """check_numerics guard: split gains and leaf values of a
+        materialized tree must be finite (host-side; arrays already
+        fetched, so this costs two np reductions)."""
+        nn = max(0, int(ta_host.num_leaves) - 1)
+        gains = np.asarray(ta_host.split_gain)[:nn]
+        leaves = np.asarray(ta_host.leaf_value)[: int(ta_host.num_leaves)]
+        if not (np.isfinite(gains).all() and np.isfinite(leaves).all()):
+            raise NumericsError(
+                f"non-finite split gain or leaf value in the tree grown at "
+                f"iteration {iteration} (objective={self._objective_name()})"
+            )
+
     def _sample(self, grad, hess):
         """Bagging/GOSS row sampling; padded (mesh-fill) rows never count.
 
@@ -1528,6 +1611,7 @@ class Booster:
         Returns True when training cannot continue (no positive-gain split),
         mirroring the reference's is_finished flag.
         """
+        chaos.on_iteration(self._iter)  # no-op unless a test armed a fault
         ses = get_session()
         if not ses.enabled:
             return self._update_impl(train_set, fobj)
@@ -1616,6 +1700,9 @@ class Booster:
             with ses.phase("gradients"):
                 grad, hess = self._get_gradients()
                 ses.sync(grad)
+            grad, hess = chaos.maybe_poison_gradients(grad, hess, self._iter)
+            if cfg.check_numerics:
+                self._guard_gradients(grad, hess)
             with ses.phase("sample"):
                 mask, grad, hess = self._sample(grad, hess)
                 ses.sync(mask)
@@ -1665,6 +1752,10 @@ class Booster:
             grad = jnp.asarray(g)
             hess = jnp.asarray(h)
 
+        grad, hess = chaos.maybe_poison_gradients(grad, hess, self._iter)
+        if cfg.check_numerics:
+            self._guard_gradients(grad, hess)
+
         # bagging / GOSS (reference: SampleStrategy::Bagging gbdt.cpp:384)
         with ses.phase("sample"):
             mask, grad, hess = self._sample(grad, hess)
@@ -1688,6 +1779,8 @@ class Booster:
                 # round-trips dominate otherwise)
                 with get_session().phase("host_materialize"):
                     ta_host = fetch_tree_arrays(ta)
+                if cfg.check_numerics:
+                    self._guard_tree(ta_host, self._iter)
                 n_leaves = int(ta_host.num_leaves)
             else:
                 n_leaves = 1
@@ -2655,8 +2748,14 @@ class Booster:
         importance_type: Optional[str] = None,
     ) -> "Booster":
         # None defers to saved_feature_importance_type (model_to_string)
-        with open(filename, "w") as f:
-            f.write(self.model_to_string(num_iteration, start_iteration, importance_type))
+        # tmp+fsync+rename: a kill mid-save leaves the previous file intact,
+        # never a truncated model (resilience/checkpoint.py idiom)
+        from ..resilience.checkpoint import atomic_write_text
+
+        atomic_write_text(
+            str(filename),
+            self.model_to_string(num_iteration, start_iteration, importance_type),
+        )
         return self
 
     def _load_model_string(self, s: str) -> None:
@@ -3114,6 +3213,143 @@ class Booster:
         nb._iter = n_iters
         return nb
 
+    # ============================================================== resilience
+    def _checkpoint_state(self) -> Dict[str, Any]:
+        """Full trainer-state snapshot for resilience/checkpoint.py.
+
+        Everything the update loop reads that evolves across iterations:
+        host model + bin records, device score caches (train and valid),
+        the RNG key, the bagging-mask cache, the adaptive leaf_batch
+        EMA/cap, the fused-fallback latch, the CEGB feature-usage set, and
+        telemetry counters.  Restoring this dict into a freshly constructed
+        Booster over the same Dataset+params reproduces the uninterrupted
+        run byte-for-byte (the kill/resume parity tests assert it).
+        """
+        if self.train_set is None:
+            raise ValueError("checkpointing requires a training Booster")
+        if getattr(self, "_multiproc", False):
+            raise NotImplementedError(
+                "checkpointing under multi-process feeding is not supported "
+                "(scores are process-sharded); checkpoint from a "
+                "single-process run"
+            )
+        from .sampling import BaggingStrategy
+
+        models = self.models_  # property: drains the in-flight fetch first
+        sampler_state = None
+        if isinstance(self._sampler, BaggingStrategy):
+            sampler_state = {"mask": np.asarray(self._sampler._mask)}
+        ses = get_session()
+        return {
+            "format_version": 1,
+            "iter": int(self._iter),
+            "finished": bool(self._finished),
+            "models": list(models),
+            "bin_records": [dict(r) if r else r for r in self._bin_records_store],
+            "score": np.asarray(self._score),
+            "valid_scores": {
+                e.name: np.asarray(e.score)
+                for e in self._valid
+                if e.score is not None
+            },
+            "rng": np.asarray(self._rng),
+            "sampler": sampler_state,
+            "commit_rate_ema": getattr(self, "_commit_rate_ema", None),
+            "leaf_batch_cap": getattr(self, "_leaf_batch_cap", None),
+            "grow_fused_disabled": bool(
+                getattr(self, "_grow_fused_disabled", False)
+            ),
+            "cegb_used": (
+                None if self._cegb_used is None else np.asarray(self._cegb_used)
+            ),
+            "shrinkage_rate": float(self._shrinkage_rate),
+            "best_iteration": int(self.best_iteration),
+            "num_tree_per_iteration": int(self.num_tree_per_iteration),
+            "num_features": int(self._bins.shape[1]),
+            "seed": self.config.seed,
+            "telemetry_counters": dict(ses.counters) if ses.enabled else None,
+        }
+
+    def _restore_checkpoint_state(self, state: Dict[str, Any]) -> None:
+        """Rehydrate a training Booster from a _checkpoint_state dict.
+
+        The Booster must already be constructed over the SAME Dataset and
+        params as the checkpointed run (engine.train does this before
+        calling restore); cheap invariants guard against mixups."""
+        if self.train_set is None:
+            raise ValueError("restore requires a training Booster")
+        if getattr(self, "_multiproc", False):
+            raise NotImplementedError(
+                "checkpoint restore under multi-process feeding is not "
+                "supported"
+            )
+        if int(state["num_tree_per_iteration"]) != self.num_tree_per_iteration:
+            raise ValueError(
+                "checkpoint num_tree_per_iteration mismatch: "
+                f"{state['num_tree_per_iteration']} vs "
+                f"{self.num_tree_per_iteration}"
+            )
+        if int(state["num_features"]) != int(self._bins.shape[1]):
+            raise ValueError(
+                "checkpoint was taken on a different dataset "
+                f"({state['num_features']} features vs {self._bins.shape[1]})"
+            )
+        if state.get("seed") != self.config.seed:
+            raise ValueError(
+                f"checkpoint seed {state.get('seed')} differs from params "
+                f"seed {self.config.seed}; the RNG streams would diverge"
+            )
+        from .sampling import BaggingStrategy
+
+        self._pending = None
+        self._models_store = list(state["models"])
+        self._bin_records_store = list(state["bin_records"])
+        self._bump_model_version()
+        self._iter = int(state["iter"])
+        self._finished = bool(state["finished"])
+        self._shrinkage_rate = float(state["shrinkage_rate"])
+        self.best_iteration = int(state.get("best_iteration", -1))
+        # re-place scores with the sharding _init_train chose (device_put
+        # handles replicated / col-sharded / single-device alike)
+        self._score = jax.device_put(
+            jnp.asarray(np.asarray(state["score"], np.float32)),
+            self._score.sharding,
+        )
+        valid_scores = state.get("valid_scores") or {}
+        for e in self._valid:
+            sc = valid_scores.get(e.name)
+            if sc is not None and e.score is not None:
+                e.score = jax.device_put(
+                    jnp.asarray(np.asarray(sc, np.float32)), e.score.sharding
+                )
+        self._rng = jnp.asarray(np.asarray(state["rng"]))
+        sampler_state = state.get("sampler")
+        if sampler_state is not None:
+            if not isinstance(self._sampler, BaggingStrategy):
+                raise ValueError(
+                    "checkpoint carries a bagging mask but bagging is not "
+                    "active under the current params"
+                )
+            self._sampler._mask = jnp.asarray(
+                np.asarray(sampler_state["mask"])
+            )
+        self._commit_rate_ema = state.get("commit_rate_ema")
+        cap = state.get("leaf_batch_cap")
+        if cap is not None:
+            self._leaf_batch_cap = int(cap)
+        if state.get("grow_fused_disabled"):
+            self._grow_fused_disabled = True
+        cegb_used = state.get("cegb_used")
+        if cegb_used is not None and self._cegb_used is not None:
+            self._cegb_used[:] = np.asarray(cegb_used, bool)
+        # grower params depend on the restored leaf_batch cap + fused latch
+        self._grower_params = self._make_grower_params()
+        if self._mesh is not None:
+            self._setup_sharded_grower()
+        counters = state.get("telemetry_counters")
+        if counters:
+            get_session().restore_counters(counters)
+
     def merge_from(self, other: "Booster") -> "Booster":
         """Continued training from an init model (reference: GBDT
         MergeFrom/continued-training via num_init_iteration_, gbdt.h:614)."""
@@ -3132,8 +3368,49 @@ class Booster:
                     tree.predict(self._train_raw_for_replay()), self._pad_rows
                 )
             )
-        self._iter += len(other.models_) // k
+        n_init = len(other.models_) // k
+        self._iter += n_init
+        self._replay_rng_stream(self._iter - n_init, n_init)
         return self
+
+    def _replay_rng_stream(self, start_iter: int, n_iters: int) -> None:
+        """Advance the per-iteration RNG stream (and the bagging-mask cache)
+        as if iterations [start_iter, start_iter + n_iters) had been trained.
+
+        Continued training via init_model used to restart the key stream at
+        the fold-0 position, so a 10+10 run drew different bagging masks and
+        extra-trees thresholds than the uninterrupted 20-iteration run.
+        Replaying the exact draw order of _update_impl — one gradient split,
+        one bagging split (plus the BaggingStrategy mask refresh), then per
+        trained class one quantize split and one tree split when those
+        features are active — makes the continuation byte-identical.
+        (Custom-fobj runs draw no gradient split and are not replayable.)
+        """
+        if not hasattr(self, "_rng"):
+            return  # model-only booster: no live training state to sync
+        from .sampling import BaggingStrategy
+
+        cfg = self.config
+        trained = (
+            sum(1 for need in self._class_need_train if need)
+            if self._bins.shape[1] > 0
+            else 0
+        )
+        per_class = 0
+        if cfg.use_quantized_grad:
+            per_class += 1  # _quant_grow_inputs
+        if cfg.feature_fraction_bynode < 1.0 or cfg.extra_trees:
+            per_class += 1  # _tree_rng
+        for it in range(start_iter, start_iter + n_iters):
+            self._next_rng()  # objective gradients (_get_gradients)
+            rng_bag = self._bagging_rng()  # row sampling (_sample)
+            if isinstance(self._sampler, BaggingStrategy):
+                # refresh the cached mask exactly as training would (the
+                # strategy ignores grad/hess); iterations between refreshes
+                # reuse it, so the resumed run starts from the right mask
+                self._sampler.sample(it, None, None, rng_bag)
+            for _ in range(trained * per_class):
+                self._next_rng()
 
     def _train_raw_for_replay(self) -> np.ndarray:
         return self._raw_for_replay(self.train_set)

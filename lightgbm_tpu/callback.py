@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import collections
 from .utils.log import log_info
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, Dict, List, Optional
 
 
 class EarlyStopException(Exception):
@@ -184,6 +184,24 @@ def early_stopping(
 
     _callback.order = 30
     return _callback
+
+def checkpoint_callback(checkpoint_dir: str, period: int = 1, keep_last: Optional[int] = None) -> Callable:
+    """Write a full resilience checkpoint every ``period`` iterations.
+
+    Callback-driven alternative to the ``checkpoint_dir``/
+    ``checkpoint_interval`` params (engine.py writes those in the train
+    loop) for callers who manage callbacks explicitly; resume either way
+    with ``lgb.train(..., resume_from=checkpoint_dir)``."""
+
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and (env.iteration + 1) % period == 0:
+            from .resilience.checkpoint import save_checkpoint as _save
+
+            _save(env.model, checkpoint_dir, keep_last=keep_last)
+
+    _callback.order = 40
+    return _callback
+
 
 class TelemetryCallback:
     """Collect each iteration's telemetry event (phases, compile counts,

@@ -70,10 +70,15 @@ def run_train(params: Dict[str, Any], cfg: Config) -> None:
         valid_names=valid_names,
         callbacks=callbacks,
         init_model=params.get("input_model") or None,
+        # resume_from=<ckpt file or checkpoint_dir>: full-state resume
+        # (engine also honors cfg.resume_from; explicit for clarity)
+        resume_from=cfg.resume_from or None,
     )
     out = params.get("output_model", "LightGBM_model.txt")
     booster.save_model(out)
     print(f"Finished training; model written to {out}")
+    if cfg.checkpoint_dir and cfg.checkpoint_interval > 0:
+        print(f"Checkpoints written to {cfg.checkpoint_dir}")
     if cfg.telemetry and cfg.telemetry_out:
         print(f"Telemetry events written to {cfg.telemetry_out}")
 

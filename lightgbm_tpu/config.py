@@ -170,6 +170,12 @@ _PARAM_ALIASES: Dict[str, str] = {
     "telemetry_output": "telemetry_out",
     "telemetry_file": "telemetry_out",
     "trace_dir": "profile_trace_dir",
+    # resilience
+    "checkpoint_path": "checkpoint_dir",
+    "checkpoint_freq": "checkpoint_interval",
+    "checkpoint_keep_last": "checkpoint_keep",
+    "restore_from": "resume_from",
+    "check_numeric": "check_numerics",
     # network
     "num_machine": "num_machines",
     "local_port": "local_listen_port",
@@ -385,6 +391,17 @@ class Config:
     profile_trace_dir: str = ""
     profile_iter_start: int = 0
     profile_iter_end: int = -1
+    # Resilience (lightgbm_tpu/resilience/): iteration-granular atomic
+    # checkpoints of FULL trainer state (model + score cache + RNG stream +
+    # bagging mask + adaptive leaf_batch EMA + telemetry counters) so a run
+    # killed mid-train resumes byte-identical; resume_from names a
+    # checkpoint file or directory (latest wins).  check_numerics adds
+    # opt-in finiteness guards on gradients/hessians and split gains.
+    checkpoint_dir: str = ""
+    checkpoint_interval: int = 0
+    checkpoint_keep: int = 3
+    resume_from: str = ""
+    check_numerics: bool = False
     use_quantized_grad: bool = False
     num_grad_quant_bins: int = 4
     quant_train_renew_leaf: bool = False
@@ -561,6 +578,14 @@ class Config:
             raise ValueError("grow_fused must be one of 'auto', 'on', 'off'")
         if not (0.0 <= self.leaf_batch_min_commit_rate <= 1.0):
             raise ValueError("leaf_batch_min_commit_rate must be in [0, 1]")
+        if self.checkpoint_interval < 0:
+            raise ValueError("checkpoint_interval must be >= 0 (0 disables)")
+        if self.checkpoint_interval > 0 and not self.checkpoint_dir:
+            raise ValueError(
+                "checkpoint_interval > 0 requires checkpoint_dir to be set"
+            )
+        if self.checkpoint_keep < 0:
+            raise ValueError("checkpoint_keep must be >= 0 (0 keeps all)")
         if self.bagging_freq > 0 and (self.pos_bagging_fraction < 1.0 or self.neg_bagging_fraction < 1.0):
             if self.objective != "binary":
                 raise ValueError("pos/neg bagging fractions require binary objective")

@@ -47,6 +47,21 @@ def _is_1d(a: np.ndarray) -> np.ndarray:
     return a
 
 
+def _check_label_finite(label: np.ndarray) -> None:
+    """Eager NaN/inf label validation (resilience guard rail): a poisoned
+    label would otherwise surface many iterations later as NaN gradients
+    (or, worse, silently as a degenerate model).  Fail at construction
+    with the offending row."""
+    bad = ~np.isfinite(label)
+    if bad.any():
+        first = int(np.argmax(bad))
+        raise ValueError(
+            f"label contains {int(bad.sum())} non-finite value(s) "
+            f"(NaN/inf); first at row {first} "
+            f"(value={label[first]!r})"
+        )
+
+
 @dataclasses.dataclass
 class Metadata:
     """Per-row metadata (reference: include/LightGBM/dataset.h:48)."""
@@ -769,6 +784,7 @@ class Dataset:
         label = _is_1d(np.asarray(label, dtype=np.float64))
         if len(label) != n:
             raise ValueError(f"label length {len(label)} != num rows {n}")
+        _check_label_finite(label)
 
         if isinstance(self._feature_name, str):
             self.feature_names = [f"Column_{i}" for i in range(num_features)]
@@ -1192,7 +1208,9 @@ class Dataset:
     # ----------------------------------------------------------- field API
     def set_label(self, label: np.ndarray) -> "Dataset":
         if self._constructed:
-            self.metadata.label = _is_1d(np.asarray(label, dtype=np.float64))
+            arr = _is_1d(np.asarray(label, dtype=np.float64))
+            _check_label_finite(arr)
+            self.metadata.label = arr
             self._device_cache.clear()
         else:
             self._label = label

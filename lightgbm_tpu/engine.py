@@ -34,8 +34,17 @@ def train(
     keep_training_booster: bool = False,
     callbacks: Optional[List[Callable]] = None,
     fobj: Optional[Callable] = None,
+    resume_from: Optional[str] = None,
 ) -> Booster:
-    """Train a GBDT model (reference: engine.py:109)."""
+    """Train a GBDT model (reference: engine.py:109).
+
+    ``resume_from`` (or the ``resume_from`` param) names a resilience
+    checkpoint file or directory (latest checkpoint wins) written by a run
+    with ``checkpoint_dir``/``checkpoint_interval`` set; the restored run
+    continues the SAME RNG/score/model state, so with identical
+    params+data it reproduces the uninterrupted run byte-for-byte.  Under
+    resume, ``num_boost_round`` counts TOTAL iterations (the resumed run
+    trains ``num_boost_round - restored_iteration`` more)."""
     # fresh per-run phase report (repeated fits would double-count otherwise)
     global_timer.reset()
     params = dict(params or {})
@@ -102,8 +111,21 @@ def train(
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    resume_path = resume_from if resume_from is not None else (cfg.resume_from or None)
+    resumed = False
+    if resume_path:
+        from .resilience.checkpoint import restore_checkpoint
+
+        restore_checkpoint(booster, resume_path)
+        resumed = True
+
     begin_iteration = booster.current_iteration()
-    end_iteration = begin_iteration + num_boost_round
+    if resumed:
+        # total-iteration semantics: the resumed run stops where the
+        # uninterrupted run would have
+        end_iteration = max(begin_iteration, num_boost_round)
+    else:
+        end_iteration = begin_iteration + num_boost_round
     evaluation_result_list: List = []
     try:
         for it in range(begin_iteration, end_iteration):
@@ -131,6 +153,17 @@ def train(
                 booster.save_model(
                     f"{booster.config.output_model}.snapshot_iter_{it + 1}"
                 )
+
+            # resilience checkpoint: full trainer state, atomic (tmp+rename);
+            # unlike the model snapshot above it captures RNG/score/sampler
+            # state so the resumed run is byte-identical
+            ck_dir = booster.config.checkpoint_dir
+            ck_int = booster.config.checkpoint_interval
+            if ck_dir and ck_int > 0 and (it + 1) % ck_int == 0:
+                from .resilience.checkpoint import save_checkpoint
+
+                with global_timer.timed("boosting/checkpoint"):
+                    save_checkpoint(booster, ck_dir)
 
             evaluation_result_list = []
             if (it + 1) % max(1, booster.config.metric_freq) == 0 or it + 1 == end_iteration:

@@ -128,6 +128,15 @@ class TelemetrySession:
         with self._lock:
             self.gauges[name] = value
 
+    def restore_counters(self, counters: Dict[str, int]) -> None:
+        """Merge checkpointed counter values into the live session so a
+        resumed run's counters continue from the killed run's totals."""
+        if not self.enabled:
+            return
+        with self._lock:
+            for name, value in counters.items():
+                self.counters[name] = self.counters.get(name, 0) + int(value)
+
     # -------------------------------------------------------------- events
     def record(self, event: Dict[str, Any], defer: bool = False) -> None:
         """Append an event; write its JSONL line (deferred events are

@@ -317,6 +317,12 @@ def fused_grow_step(
 
     Returns (seg', nl[K], nr[K], child_start[K], child_cnt[K],
     hist[K, F, B, 3])."""
+    # fault-injection consult (trace time — the moment a Mosaic compile
+    # failure would surface); disarmed it costs one dict truthiness check
+    from ...resilience import chaos
+
+    chaos.maybe_raise_pallas("fused_grow_step")
+
     from ..segpart import sort_partition_xla
     from .seg import seg_hist_ref
 

@@ -324,12 +324,23 @@ def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    retries: int = 3,
+    backoff: float = 1.0,
 ) -> None:
     """Multi-host initialization (the reference's machine-list / MPI init,
     src/network/linkers_socket.cpp:25 / linkers_mpi.cpp) via jax.distributed.
 
     Defaults come from the launcher's env vars when present
-    (``python -m lightgbm_tpu.parallel.launcher -n N script.py``)."""
+    (``python -m lightgbm_tpu.parallel.launcher -n N script.py``).
+
+    Coordination-service startup is the flakiest moment of a multi-host
+    run (coordinator not yet listening, port briefly in TIME_WAIT after a
+    relaunch), so the initialize call retries up to ``retries`` times with
+    exponential backoff starting at ``backoff`` seconds before giving up."""
+    import time as _time
+
+    from ..obs.registry import get_session
+    from ..utils.log import log_warning
     from .launcher import env_distributed_config
 
     kwargs = env_distributed_config() or {}
@@ -339,4 +350,26 @@ def init_distributed(
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    jax.distributed.initialize(**kwargs)
+    attempts = max(1, int(retries))
+    for attempt in range(attempts):
+        try:
+            jax.distributed.initialize(**kwargs)
+            return
+        except Exception as exc:
+            if attempt + 1 >= attempts:
+                raise
+            delay = backoff * (2.0**attempt)
+            get_session().record(
+                {
+                    "event": "init_distributed_retry",
+                    "attempt": attempt + 1,
+                    "delay_s": delay,
+                    "error": f"{type(exc).__name__}: {exc}"[:300],
+                }
+            )
+            log_warning(
+                f"[resilience] jax.distributed.initialize failed "
+                f"(attempt {attempt + 1}/{attempts}: {type(exc).__name__}); "
+                f"retrying in {delay:.1f}s"
+            )
+            _time.sleep(delay)

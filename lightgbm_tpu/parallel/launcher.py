@@ -54,9 +54,43 @@ def launch(
     argv: List[str],
     coordinator_port: int = 9462,
     extra_env: Optional[dict] = None,
+    retries: int = 1,
+    startup_window: float = 20.0,
+    backoff: float = 1.0,
 ) -> int:
     """Spawn ``num_processes`` copies of ``python argv...`` with the
-    coordination env set; returns the first nonzero exit code (0 if all ok)."""
+    coordination env set; returns the first nonzero exit code (0 if all ok).
+
+    With ``retries`` > 1, a group that dies nonzero within
+    ``startup_window`` seconds (the signature of a coordination-service
+    bind race or TIME_WAIT port collision, not a training failure) is
+    relaunched after exponential backoff, up to ``retries`` attempts."""
+    import time
+
+    attempts = max(1, int(retries))
+    for attempt in range(attempts):
+        t0 = time.monotonic()
+        rc = _launch_once(num_processes, argv, coordinator_port, extra_env)
+        elapsed = time.monotonic() - t0
+        if rc == 0 or attempt + 1 >= attempts or elapsed >= startup_window:
+            return rc
+        delay = backoff * (2.0**attempt)
+        print(
+            f"[resilience] launch group died rc={rc} after {elapsed:.1f}s "
+            f"(startup failure); retrying in {delay:.1f}s "
+            f"(attempt {attempt + 2}/{attempts})",
+            file=sys.stderr,
+        )
+        time.sleep(delay)
+    return rc
+
+
+def _launch_once(
+    num_processes: int,
+    argv: List[str],
+    coordinator_port: int,
+    extra_env: Optional[dict],
+) -> int:
     procs = []
     for pid in range(num_processes):
         env = dict(os.environ)
@@ -101,13 +135,54 @@ def launch_collect(
     coordinator_port: Optional[int] = None,
     extra_env: Optional[dict] = None,
     timeout: float = 300.0,
+    retries: int = 2,
+    startup_window: float = 20.0,
+    backoff: float = 1.0,
 ):
     """Like ``launch`` but captures each process's stdout (argv includes the
     interpreter). Returns (first_nonzero_rc, [stdout per process]).
     Picks a free coordinator port by default so concurrent launches (e.g.
-    parallel test runs) don't collide."""
-    if coordinator_port is None:
-        coordinator_port = _free_port()
+    parallel test runs) don't collide.
+
+    A group that dies nonzero within ``startup_window`` seconds is treated
+    as a startup failure (bind race / stale port) and relaunched on a FRESH
+    port after exponential backoff, up to ``retries`` attempts; timeouts
+    (rc 124) and slow failures are returned as-is — those are real."""
+    import time
+
+    attempts = max(1, int(retries))
+    for attempt in range(attempts):
+        port = coordinator_port if coordinator_port is not None else _free_port()
+        t0 = time.monotonic()
+        rc, outs = _launch_collect_once(
+            num_processes, argv, port, extra_env, timeout
+        )
+        elapsed = time.monotonic() - t0
+        if (
+            rc == 0
+            or rc == 124
+            or attempt + 1 >= attempts
+            or elapsed >= startup_window
+        ):
+            return rc, outs
+        delay = backoff * (2.0**attempt)
+        print(
+            f"[resilience] launch group died rc={rc} after {elapsed:.1f}s "
+            f"(startup failure); retrying on a fresh port in {delay:.1f}s "
+            f"(attempt {attempt + 2}/{attempts})",
+            file=sys.stderr,
+        )
+        time.sleep(delay)
+    return rc, outs
+
+
+def _launch_collect_once(
+    num_processes: int,
+    argv: List[str],
+    coordinator_port: int,
+    extra_env: Optional[dict],
+    timeout: float,
+):
     procs = []
     for pid in range(num_processes):
         env = dict(os.environ)
@@ -161,11 +236,19 @@ def main(args=None) -> None:
     )
     ap.add_argument("-n", "--num-processes", type=int, required=True)
     ap.add_argument("--port", type=int, default=9462)
+    ap.add_argument(
+        "--retries",
+        type=int,
+        default=1,
+        help="relaunch the group up to N times on fast startup failures",
+    )
     ap.add_argument("script", nargs=argparse.REMAINDER)
     ns = ap.parse_args(args)
     if not ns.script:
         ap.error("script.py [args...] required")
-    raise SystemExit(launch(ns.num_processes, ns.script, ns.port))
+    raise SystemExit(
+        launch(ns.num_processes, ns.script, ns.port, retries=ns.retries)
+    )
 
 
 if __name__ == "__main__":

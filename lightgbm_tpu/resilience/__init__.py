@@ -1,0 +1,44 @@
+"""Fault-tolerance subsystem: checkpoint/resume, graceful degradation,
+numerics guard rails, and a fault-injection harness.
+
+The reference implementation recovers from mid-run death only through
+``snapshot_freq`` model snapshots (src/boosting/gbdt_model_text.cpp), which
+lose sampler/RNG state and therefore cannot reproduce the uninterrupted
+run.  Long preemptible-TPU runs need more: ``checkpoint.py`` snapshots the
+FULL trainer state (model, score cache, RNG stream, bagging mask, adaptive
+``leaf_batch`` EMA, telemetry counters) atomically so a killed run resumes
+byte-identical; ``chaos.py`` injects the failures (SIGKILL, NaN gradients,
+Pallas raises) that the recovery tests prove we survive.
+"""
+
+class NumericsError(RuntimeError):
+    """Raised by the opt-in ``check_numerics`` guard when gradients,
+    hessians, or split gains go non-finite, naming the iteration and
+    objective so the poisoned step is identifiable without a debugger.
+
+    A plain ``RuntimeError`` subclass (not ``basic.LightGBMError``) because
+    ``basic`` imports the Booster, which imports this package — the guard
+    must stay import-cycle-free.
+    """
+
+
+from . import chaos  # noqa: E402
+from .checkpoint import (  # noqa: E402
+    atomic_write_bytes,
+    atomic_write_text,
+    latest_checkpoint,
+    list_checkpoints,
+    restore_checkpoint,
+    save_checkpoint,
+)
+
+__all__ = [
+    "NumericsError",
+    "chaos",
+    "atomic_write_bytes",
+    "atomic_write_text",
+    "latest_checkpoint",
+    "list_checkpoints",
+    "restore_checkpoint",
+    "save_checkpoint",
+]

@@ -1,0 +1,114 @@
+"""Fault-injection harness for the resilience tests.
+
+Hooks are armed from test (or smoke-script) code and consulted at three
+seams of the training loop:
+
+- ``kill_at_iteration(k)``   -> ``Booster.update`` SIGKILLs the process the
+  moment iteration ``k`` starts, simulating a preemption.  SIGKILL (not an
+  exception) so no ``finally:`` block can tidy up — resume must work from
+  the last on-disk checkpoint alone.
+- ``poison_gradients_at(k)`` -> the gradient fetch overwrites one entry
+  with NaN at iteration ``k``, exercising the ``check_numerics`` guard.
+- ``force_pallas_raise(k)``  -> the fused grow-step dispatcher raises
+  :class:`InjectedPallasFailure` from iteration ``k`` on, simulating a
+  Mosaic compile/launch failure so the XLA-oracle fallback path is
+  reachable on any backend.
+
+Every consult is a no-op costing one dict truthiness check when nothing is
+armed, so production runs pay nothing for carrying the hooks.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Any, Dict, Optional, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """Base class for faults raised by the chaos harness."""
+
+
+class InjectedPallasFailure(InjectedFault):
+    """Stands in for a Mosaic kernel compile/launch failure."""
+
+
+_ARMED: Dict[str, Any] = {}
+
+
+def arm(name: str, value: Any = True) -> None:
+    _ARMED[name] = value
+
+
+def disarm(name: str) -> None:
+    _ARMED.pop(name, None)
+
+
+def reset() -> None:
+    """Disarm every hook (call from test teardown)."""
+    _ARMED.clear()
+
+
+def armed(name: str) -> Any:
+    return _ARMED.get(name)
+
+
+def kill_at_iteration(iteration: int) -> None:
+    """SIGKILL this process when boosting iteration ``iteration`` starts."""
+    arm("kill_at_iteration", int(iteration))
+
+
+def poison_gradients_at(iteration: int, value: float = float("nan")) -> None:
+    """Overwrite one gradient entry with ``value`` at ``iteration``."""
+    arm("poison_gradients", (int(iteration), float(value)))
+
+
+def force_pallas_raise(at_iteration: int = 0) -> None:
+    """Make the fused grow-step dispatcher raise from ``at_iteration`` on."""
+    arm("force_pallas_raise", int(at_iteration))
+
+
+# ---------------------------------------------------------------- consults
+
+
+def on_iteration(iteration: int) -> None:
+    """Consulted at the top of ``Booster.update``."""
+    if not _ARMED:
+        return
+    k = _ARMED.get("kill_at_iteration")
+    if k is not None and iteration >= k:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_poison_gradients(grad, hess, iteration: int) -> Tuple[Any, Any]:
+    """Consulted after the gradient fetch; poisons grad[..., 0] once."""
+    if not _ARMED:
+        return grad, hess
+    p = _ARMED.get("poison_gradients")
+    if p is None or iteration != p[0]:
+        return grad, hess
+    flat = grad.reshape(-1)
+    flat = flat.at[0].set(p[1])
+    return flat.reshape(grad.shape), hess
+
+
+def maybe_raise_pallas(where: str, iteration: Optional[int] = None) -> None:
+    """Consulted before dispatching the fused Pallas grow step.
+
+    With an iteration (per-call host consult in ``_grow_one``) it fires
+    once the armed threshold is reached — simulating a runtime launch
+    failure mid-train.  With ``iteration=None`` (trace-time consult inside
+    the dispatcher) it fires only when armed at threshold <= 0 —
+    simulating a Mosaic COMPILE failure, which can only surface at trace
+    time, i.e. before the first iteration completes.
+    """
+    if not _ARMED:
+        return
+    t = _ARMED.get("force_pallas_raise")
+    if t is None:
+        return
+    if (iteration is None and t <= 0) or (iteration is not None and iteration >= t):
+        raise InjectedPallasFailure(
+            f"injected Pallas failure in {where}"
+            + ("" if iteration is None else f" at iteration {iteration}")
+        )

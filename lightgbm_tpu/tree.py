@@ -234,12 +234,23 @@ class Tree:
 
     # ---------------------------------------------------------------- mutate
     def apply_shrinkage(self, rate: float) -> None:
-        """Tree::Shrinkage (include/LightGBM/tree.h:197)."""
-        self.leaf_value = self.leaf_value * rate
-        self.internal_value = self.internal_value * rate
+        """Tree::Shrinkage (include/LightGBM/tree.h:197).
+
+        The rate is rounded to float32 before the multiply: the device score
+        update computes ``leaf_value(f32) * rate(f32)`` in f32, and leaf
+        values coming off the accelerator are f32-representable, so the f64
+        product here is exact and casting it back to f32 reproduces the
+        device addend bit-for-bit. That makes host-side score replay
+        (init_model continuation, checkpoint-free resume) byte-identical to
+        an uninterrupted run; with an unrounded f64 rate the two roundings
+        disagree by 1 ulp on a few percent of leaves.
+        """
+        r = float(np.float32(rate))
+        self.leaf_value = self.leaf_value * r
+        self.internal_value = self.internal_value * r
         if self.is_linear and self.leaf_const is not None:
-            self.leaf_const = self.leaf_const * rate
-            self.leaf_coeff = [c * rate for c in self.leaf_coeff]
+            self.leaf_const = self.leaf_const * r
+            self.leaf_coeff = [c * r for c in self.leaf_coeff]
         self.shrinkage *= rate
 
     def set_leaf_values(self, values: np.ndarray) -> None:

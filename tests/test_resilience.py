@@ -1,0 +1,376 @@
+"""Fault-tolerant training (resilience/ subsystem): checkpoint/resume,
+fused-kernel graceful degradation, non-finite guard rails, fault injection.
+
+Reference analog: the C++ tree has `continued training` via
+``input_model`` (GBDT::MergeFrom) but no iteration-granular checkpointing;
+the resilience/ subsystem is a superset required for preemptible TPU pods.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.obs.registry import get_session  # noqa: E402
+from lightgbm_tpu.resilience import (  # noqa: E402
+    NumericsError,
+    chaos,
+    latest_checkpoint,
+    list_checkpoints,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from lightgbm_tpu.resilience.chaos import InjectedPallasFailure  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    chaos.reset()
+    ses = get_session()
+    ses.configure(enabled=False)
+    ses.reset()
+    yield
+    chaos.reset()
+    ses = get_session()
+    ses.configure(enabled=False)
+    ses.reset()
+
+
+def _data(n=400, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n)
+    return X, y
+
+
+def _params(**over):
+    p = dict(
+        objective="regression",
+        num_leaves=15,
+        learning_rate=0.1,
+        min_data_in_leaf=20,
+        verbosity=-1,
+        deterministic=True,
+        seed=7,
+    )
+    p.update(over)
+    return p
+
+
+# ======================================================== checkpoint / resume
+# Byte-parity protocol: the params block is echoed into the model dump, so
+# the baseline, interrupted, and resumed runs all use IDENTICAL params —
+# including the same checkpoint_dir — and share one directory.  The resumed
+# run picks up the interrupted run's latest checkpoint (written last).
+CKPT_VARIANTS = {
+    "plain": {},
+    "bagging": dict(bagging_fraction=0.7, bagging_freq=2, bagging_seed=11),
+    "goss": dict(boosting="goss", top_rate=0.3, other_rate=0.2),
+    "leaf_batch": dict(leaf_batch=4),
+}
+
+
+@pytest.mark.parametrize("variant", sorted(CKPT_VARIANTS))
+def test_checkpoint_resume_byte_parity(tmp_path, variant):
+    X, y = _data()
+    ckdir = str(tmp_path / "ck")
+    p = _params(checkpoint_dir=ckdir, checkpoint_interval=5)
+    p.update(CKPT_VARIANTS[variant])
+
+    baseline = lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=14)
+    ref = baseline.model_to_string()
+
+    # "interrupted" run: same params, dies (returns) after 10 iterations,
+    # leaving checkpoints at iterations 5 and 10 in ckdir
+    lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=10)
+    assert latest_checkpoint(ckdir) is not None
+
+    # resume: num_boost_round is the TOTAL iteration count here
+    resumed = lgb.train(
+        p, lgb.Dataset(X, y, params=p), num_boost_round=14, resume_from=ckdir
+    )
+    assert resumed.current_iteration() == 14
+    assert resumed.model_to_string() == ref
+
+
+def test_checkpoint_resume_from_explicit_file(tmp_path):
+    X, y = _data()
+    ckdir = str(tmp_path / "ck")
+    p = _params(checkpoint_dir=ckdir, checkpoint_interval=3, checkpoint_keep=0)
+    baseline = lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=9)
+    ref = baseline.model_to_string()
+    ckpts = list_checkpoints(ckdir)
+    assert [it for it, _ in ckpts] == [3, 6, 9]
+    # resume from the iteration-6 file specifically (not the latest)
+    resumed = lgb.train(
+        p, lgb.Dataset(X, y, params=p), num_boost_round=9,
+        resume_from=ckpts[1][1],
+    )
+    assert resumed.model_to_string() == ref
+
+
+def test_checkpoint_pruning_keeps_last_n(tmp_path):
+    X, y = _data()
+    ckdir = str(tmp_path / "ck")
+    p = _params(checkpoint_dir=ckdir, checkpoint_interval=2, checkpoint_keep=2)
+    lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=10)
+    assert [it for it, _ in list_checkpoints(ckdir)] == [8, 10]
+
+
+def test_checkpoint_callback_writes_files(tmp_path):
+    X, y = _data()
+    ckdir = str(tmp_path / "ck")
+    p = _params()
+    lgb.train(
+        p, lgb.Dataset(X, y, params=p), num_boost_round=6,
+        callbacks=[lgb.checkpoint_callback(ckdir, period=3)],
+    )
+    assert [it for it, _ in list_checkpoints(ckdir)] == [3, 6]
+
+
+def test_restore_rejects_mismatched_run(tmp_path):
+    X, y = _data()
+    ckdir = str(tmp_path / "ck")
+    p = _params()
+    booster = lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=4)
+    save_checkpoint(booster, ckdir)
+
+    other = _params(seed=99)
+    fresh = lgb.train(other, lgb.Dataset(X, y, params=other), num_boost_round=1)
+    with pytest.raises(ValueError, match="seed"):
+        restore_checkpoint(fresh, ckdir)
+
+
+def test_config_checkpoint_validation():
+    X, y = _data(n=50)
+    with pytest.raises(Exception, match="checkpoint"):
+        lgb.train(
+            _params(checkpoint_interval=5),  # no checkpoint_dir
+            lgb.Dataset(X, y), num_boost_round=1,
+        )
+    with pytest.raises(Exception, match="checkpoint"):
+        lgb.train(
+            _params(checkpoint_interval=-1),
+            lgb.Dataset(X, y), num_boost_round=1,
+        )
+
+
+# ===================================================== atomic model writing
+def test_save_model_atomic_under_interrupt(tmp_path, monkeypatch):
+    X, y = _data()
+    p = _params()
+    booster = lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=3)
+    out = tmp_path / "model.txt"
+    booster.save_model(str(out))
+    good = out.read_bytes()
+
+    # a crash between tmp-file write and rename must leave the target intact
+    real_replace = os.replace
+
+    def boom(src, dst):
+        raise OSError("injected crash during rename")
+
+    monkeypatch.setattr(os, "replace", boom)
+    with pytest.raises(OSError, match="injected"):
+        booster.save_model(str(out))
+    assert out.read_bytes() == good
+    assert not [f for f in os.listdir(tmp_path) if f != "model.txt"], (
+        "tmp file leaked after interrupted save"
+    )
+
+    monkeypatch.setattr(os, "replace", real_replace)
+    booster.save_model(str(out))
+    reloaded = lgb.Booster(model_file=str(out))
+    assert reloaded.num_trees() == booster.num_trees()
+
+
+# ========================================== init_model continuation parity
+@pytest.mark.parametrize(
+    "extra",
+    [
+        pytest.param({}, id="plain"),
+        pytest.param(
+            dict(bagging_fraction=0.7, bagging_freq=2, bagging_seed=11),
+            id="bagging",
+        ),
+        pytest.param(
+            dict(boosting="goss", top_rate=0.3, other_rate=0.2), id="goss"
+        ),
+        pytest.param(
+            dict(extra_trees=True, extra_seed=5, feature_fraction_bynode=0.8),
+            id="extra_trees",
+        ),
+    ],
+)
+def test_init_model_continuation_byte_parity(extra):
+    """20 continuous iterations == 10 + 10 via init_model, byte-identical.
+
+    Exercises the RNG-stream re-fold in merge_from (bagging masks,
+    extra-trees thresholds) and the f32-exact score replay."""
+    X, y = _data(n=500, f=8, seed=3)
+    p = _params(boost_from_average=False)
+    p.update(extra)
+
+    full = lgb.train(p, lgb.Dataset(X, y, params=p, free_raw_data=False), 20)
+    b1 = lgb.train(p, lgb.Dataset(X, y, params=p, free_raw_data=False), 10)
+    cont = lgb.train(
+        p, lgb.Dataset(X, y, params=p, free_raw_data=False), 10, init_model=b1
+    )
+    assert cont.model_to_string() == full.model_to_string()
+
+
+# ============================================ fused-kernel graceful fallback
+def _fused_params(**over):
+    # hist_mode must be explicit off-TPU; grow_fused="on" then lowers to the
+    # two-launch XLA composition (the oracle) on CPU — byte-identical by
+    # construction, which is what makes the parity assertion meaningful
+    p = _params(hist_mode="seg", grow_fused="on", telemetry=True)
+    p.update(over)
+    return p
+
+
+def test_fused_failure_falls_back_to_xla_oracle():
+    X, y = _data(n=600, f=8, seed=1)
+    p = _fused_params()
+
+    clean = lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=6)
+    ref = clean.model_to_string()
+    get_session().reset()
+
+    chaos.force_pallas_raise(at_iteration=2)
+    booster = lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=6)
+    chaos.reset()
+
+    # the run completed on the XLA oracle with identical trees
+    assert booster.model_to_string() == ref
+
+    ses = get_session()
+    degr = [e for e in ses.events if e.get("event") == "degradation"]
+    assert len(degr) == 1, f"expected exactly one degradation event: {degr}"
+    assert degr[0]["component"] == "fused_grow_step"
+    assert degr[0]["action"] == "fallback_to_xla_oracle"
+    assert degr[0]["iter"] == 2
+    assert "InjectedPallasFailure" in degr[0]["error"]
+    assert ses.counters.get("degradations") == 1
+
+    # no retrace storm: the latch forces ONE rebuild of GrowerParams; after
+    # that, further iterations reuse the fallback's compiled program
+    from lightgbm_tpu.obs import compile_counts_by_label
+
+    before = compile_counts_by_label()
+    for _ in range(3):
+        booster.update()
+    assert compile_counts_by_label() == before, "fallback kept retracing"
+
+
+def test_fused_fallback_latch_survives_checkpoint(tmp_path):
+    X, y = _data(n=600, f=8, seed=1)
+    ckdir = str(tmp_path / "ck")
+    p = _fused_params(checkpoint_dir=ckdir, checkpoint_interval=4)
+
+    baseline = lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=8)
+    ref = baseline.model_to_string()
+
+    chaos.force_pallas_raise(at_iteration=1)
+    lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=4)
+    chaos.reset()
+
+    # resume from the DEGRADED run's checkpoint (iteration 4) explicitly —
+    # the baseline above shares the directory and left a later one at 8
+    ck4 = dict(list_checkpoints(ckdir))[4]
+    resumed = lgb.train(
+        p, lgb.Dataset(X, y, params=p), num_boost_round=8, resume_from=ck4
+    )
+    assert getattr(resumed, "_grow_fused_disabled", False), (
+        "degradation latch lost across checkpoint/restore"
+    )
+    assert resumed.model_to_string() == ref
+
+
+def test_chaos_pallas_raise_semantics():
+    # default arming simulates a compile-time failure: trace-time consult
+    # (iteration=None) fires
+    chaos.force_pallas_raise()
+    with pytest.raises(InjectedPallasFailure):
+        chaos.maybe_raise_pallas("unit")
+    # arming at a later iteration must NOT fire at trace time, only once
+    # training reaches that iteration
+    chaos.force_pallas_raise(at_iteration=2)
+    chaos.maybe_raise_pallas("unit")  # trace-time: no raise
+    chaos.maybe_raise_pallas("unit", iteration=1)  # earlier iter: no raise
+    with pytest.raises(InjectedPallasFailure):
+        chaos.maybe_raise_pallas("unit", iteration=2)
+    chaos.reset()
+    chaos.maybe_raise_pallas("unit")  # disarmed: no raise
+    chaos.maybe_raise_pallas("unit", iteration=100)
+
+
+# ================================================== non-finite guard rails
+def test_check_numerics_flags_poisoned_gradients():
+    X, y = _data()
+    p = _params(check_numerics=True)
+    chaos.poison_gradients_at(2)
+    with pytest.raises(NumericsError, match=r"iteration 2.*Regression"):
+        lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=6)
+
+
+def test_check_numerics_off_by_default_costs_nothing():
+    # without the flag the poisoned run must NOT raise from the guard —
+    # it silently degenerates (NaN gains kill every split and training
+    # finishes early), which is exactly the failure mode the flag names
+    X, y = _data()
+    p = _params()
+    chaos.poison_gradients_at(2)
+    booster = lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=4)
+    assert booster.current_iteration() >= 2
+
+
+def test_dataset_rejects_nonfinite_labels():
+    X, y = _data(n=100)
+    bad = y.copy()
+    bad[7] = np.nan
+    with pytest.raises(ValueError, match=r"non-finite.*row 7"):
+        lgb.Dataset(X, bad).construct()
+
+    ds = lgb.Dataset(X, y)
+    ds.construct()
+    inf_label = y.copy()
+    inf_label[3] = np.inf
+    with pytest.raises(ValueError, match=r"non-finite.*row 3"):
+        ds.set_label(inf_label)
+
+
+# ============================================== distributed init retry
+def test_init_distributed_retries_then_succeeds(monkeypatch):
+    from lightgbm_tpu import parallel as par
+
+    calls = []
+
+    def flaky(**kwargs):
+        calls.append(kwargs)
+        if len(calls) < 3:
+            raise RuntimeError("coordination service bind race")
+
+    monkeypatch.setattr(jax.distributed, "initialize", flaky)
+    par.init_distributed(
+        coordinator_address="localhost:1", num_processes=1, process_id=0,
+        retries=3, backoff=0.0,
+    )
+    assert len(calls) == 3
+
+
+def test_init_distributed_exhausts_retries(monkeypatch):
+    from lightgbm_tpu import parallel as par
+
+    def always_fails(**kwargs):
+        raise RuntimeError("unreachable coordinator")
+
+    monkeypatch.setattr(jax.distributed, "initialize", always_fails)
+    with pytest.raises(RuntimeError, match="unreachable"):
+        par.init_distributed(
+            coordinator_address="localhost:1", num_processes=1,
+            process_id=0, retries=2, backoff=0.0,
+        )

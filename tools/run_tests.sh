@@ -81,4 +81,52 @@ got = structure(grow_fused="on")
 assert got == ref, "fused interpret-mode structure diverged from oracle"
 print("fused grow-step interpret smoke: structure parity OK")
 PYEOF
+
+# kill-and-resume smoke: SIGKILL a checkpointing train mid-run (via the
+# chaos harness, the closest stand-in for a TPU-pod preemption), resume
+# from the latest checkpoint, and require a byte-identical model dump vs
+# the uninterrupted run.  Needs real process death, so it lives here and
+# not in pytest.
+echo "=== kill-and-resume smoke (SIGKILL at iteration 15, resume to 30) ==="
+python - <<'PYEOF' || rc=$?
+import subprocess
+import sys
+import tempfile
+
+ckdir = tempfile.mkdtemp(prefix="lgbm_tpu_ckpt_smoke_")
+
+COMMON = f"""
+import numpy as np
+import lightgbm_tpu as lgb
+rng = np.random.default_rng(0)
+X = rng.normal(size=(400, 6))
+y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=400)
+params = dict(objective="regression", num_leaves=15, learning_rate=0.1,
+              min_data_in_leaf=20, verbosity=-1, deterministic=True, seed=7,
+              bagging_fraction=0.7, bagging_freq=2, bagging_seed=11,
+              checkpoint_dir={ckdir!r}, checkpoint_interval=5)
+"""
+
+child = COMMON + """
+from lightgbm_tpu.resilience import chaos
+chaos.kill_at_iteration(15)
+lgb.train(params, lgb.Dataset(X, y, params=params), num_boost_round=30)
+raise SystemExit("unreachable: SIGKILL did not fire")
+"""
+proc = subprocess.run([sys.executable, "-c", child])
+assert proc.returncode == -9, f"expected SIGKILL (-9), got {proc.returncode}"
+
+exec(COMMON)
+resumed = lgb.train(
+    params, lgb.Dataset(X, y, params=params), num_boost_round=30,
+    resume_from=ckdir,
+)
+baseline = lgb.train(
+    params, lgb.Dataset(X, y, params=params), num_boost_round=30
+)
+assert resumed.current_iteration() == 30
+assert resumed.model_to_string() == baseline.model_to_string(), (
+    "resumed dump diverged from uninterrupted run")
+print("kill-and-resume smoke: byte-identical dump after SIGKILL+resume OK")
+PYEOF
 exit $rc
