@@ -1,0 +1,47 @@
+"""graftlint — tracer-safety & Pallas-contract static analysis.
+
+Purpose-built for this JAX/Pallas codebase: the rule set encodes the bug
+classes previous PRs paid for at runtime (interpret-mode aliased-ref
+reads, bare-jit retrace-accounting holes, accept-and-ignore config
+params) so they become build-time errors instead.  Run it as
+
+    python -m lightgbm_tpu.lint [--baseline lint_baseline.json] [paths...]
+
+or through the pytest gate (tests/test_lint.py) and the hard CI gate at
+the top of tools/run_tests.sh.  Rules:
+
+=====  ==============================================================
+GL001  bare ``jax.jit``/``jax.pmap`` outside obs/jit.py
+GL002  Pallas kernel reads the input side of ``input_output_aliases``
+GL003  host-sync call on a tracer-flowing value in jit-reachable code
+GL004  weak-typed float constant closed over by a jitted function
+GL005  ``pallas_call`` contract: block tiling, index_map arity,
+       out_shape/out_specs consistency
+GL006  Config field declared in config.py but never read
+=====  ==============================================================
+
+Per-line suppression: ``# graftlint: disable=GL001`` (comma-separated
+codes, or bare ``disable`` for all).  Intentional exceptions live in
+``lint_baseline.json`` with a one-line justification each; stale entries
+fail the run.  See README "Static analysis".
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    LintResult,
+    Project,
+    RULES,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "Project",
+    "RULES",
+    "load_baseline",
+    "run_lint",
+    "write_baseline",
+]

@@ -1,0 +1,113 @@
+"""CLI: ``python -m lightgbm_tpu.lint``.
+
+Exit status 0 when the tree is clean against the baseline (no new
+findings, no stale baseline entries); 1 otherwise.  ``--write-baseline``
+regenerates the baseline from the current findings with TODO
+justifications for review.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from .core import RULES, run_lint, write_baseline
+
+PKG_ROOT = Path(__file__).resolve().parents[1]  # the lightgbm_tpu package
+REPO_ROOT = PKG_ROOT.parent
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.lint",
+        description="graftlint: tracer-safety & Pallas-contract static "
+        "analysis for the lightgbm_tpu tree",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="optional path prefixes (relative to the repo root, e.g. "
+        "lightgbm_tpu/ops) to filter REPORTED findings; the whole package "
+        "is always analyzed so the call graph stays complete",
+    )
+    parser.add_argument(
+        "--baseline",
+        type=Path,
+        default=None,
+        help="baseline JSON of reviewed exceptions (default: "
+        "lint_baseline.json next to the package, when present)",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        type=Path,
+        metavar="PATH",
+        default=None,
+        help="write the current findings as a fresh baseline and exit 0",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule table"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code, (title, hint) in sorted(RULES.items()):
+            print(f"{code}  {title}\n       fix: {hint}")
+        return 0
+
+    baseline = args.baseline
+    if baseline is None and args.write_baseline is None:
+        cand = REPO_ROOT / "lint_baseline.json"
+        baseline = cand if cand.exists() else None
+
+    t0 = time.monotonic()
+    result = run_lint(PKG_ROOT, baseline=baseline, only_paths=args.paths)
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline is not None:
+        write_baseline(args.write_baseline, result.findings)
+        print(
+            f"graftlint: wrote {len(result.findings)} entries to "
+            f"{args.write_baseline} — fill in the TODO justifications"
+        )
+        return 0
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "new": [vars(f) for f in result.new],
+                    "baselined": len(result.findings) - len(result.new),
+                    "stale": result.stale,
+                    "elapsed_s": round(elapsed, 3),
+                },
+                indent=2,
+            )
+        )
+        return 0 if result.ok else 1
+
+    for f in result.new:
+        print(f.render())
+        print(f"    fix: {f.hint}")
+    for e in result.stale:
+        print(
+            f"stale baseline entry (no longer fires — remove it): "
+            f"{e['rule']} {e['path']} ident={e['ident']!r}"
+        )
+    n_base = len(result.findings) - len(result.new)
+    print(
+        f"graftlint: {len(result.findings)} finding(s) "
+        f"({n_base} baselined, {len(result.new)} new), "
+        f"{len(result.stale)} stale baseline entr(y/ies) "
+        f"[{elapsed:.2f}s]"
+    )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

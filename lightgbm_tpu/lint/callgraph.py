@@ -1,0 +1,237 @@
+"""Conservative intra-package call graph + tracer-taint propagation.
+
+Entry points are the places a value becomes a tracer:
+
+* jit-wrapped functions — ``@instrumented_jit``, ``@jax.jit``,
+  ``@functools.partial(instrumented_jit, static_argnames=...)``, and the
+  assignment forms ``g = instrumented_jit(f, ...)`` / ``jax.jit(f)``;
+* Pallas kernel bodies — the first argument of ``pl.pallas_call`` (resolved
+  through a local ``functools.partial(kernel_fn, ...)`` binding).
+
+Taint model (deliberately simple, biased against false positives):
+
+* at a jit entry every parameter is tainted EXCEPT names listed in
+  ``static_argnames``; in a pallas kernel every parameter (ref) is tainted;
+* an assignment whose right-hand side mentions a tainted name taints its
+  targets; a call result is tainted iff any argument is tainted;
+* taint flows into in-package callees positionally/by keyword, computed to
+  a fixpoint over (function, tainted-param-set) pairs — the "conservative
+  intra-package call graph" of GL003.  ``*args``/``**kwargs`` forwarding
+  and aliasing through containers are NOT modeled: an un-modeled flow can
+  only lose taint, i.e. miss a finding, never invent one.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .core import Module, Project, call_kwargs, const_names, names_in
+
+_JIT_NAMES = {"instrumented_jit"}
+_JIT_DOTTED = {"jax.jit", "jax.pmap", "jax.obs.jit.instrumented_jit"}
+
+
+def _jit_wrapper_call(
+    project: Project, mod: Module, node: ast.AST
+) -> Optional[ast.Call]:
+    """Return the jit-wrapper Call if ``node`` is one (possibly through
+    ``functools.partial(<jit>, ...)``), else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    dotted = project.dotted_callee(mod, node.func)
+    name = node.func.id if isinstance(node.func, ast.Name) else (
+        node.func.attr if isinstance(node.func, ast.Attribute) else None
+    )
+    if dotted in _JIT_DOTTED or name in _JIT_NAMES:
+        return node
+    if dotted == "functools.partial" and node.args:
+        inner = node.args[0]
+        idotted = project.dotted_callee(mod, inner)
+        iname = inner.id if isinstance(inner, ast.Name) else None
+        if idotted in _JIT_DOTTED or iname in _JIT_NAMES:
+            return node
+    return None
+
+
+def jit_entries(
+    project: Project,
+) -> List[Tuple[str, Module, ast.FunctionDef, FrozenSet[str]]]:
+    """All jit entry points: (module_rel, module, func, static_argnames)."""
+    out = []
+    for rel, mod in project.modules.items():
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    call = _jit_wrapper_call(project, mod, deco)
+                    is_bare = not isinstance(deco, ast.Call) and (
+                        project.dotted_callee(mod, deco) in _JIT_DOTTED
+                        or (
+                            isinstance(deco, ast.Name)
+                            and deco.id in _JIT_NAMES
+                        )
+                    )
+                    if call is None and not is_bare:
+                        continue
+                    statics: Set[str] = set()
+                    if call is not None:
+                        names = const_names(
+                            call_kwargs(call).get("static_argnames", ast.Tuple(elts=[]))
+                        )
+                        statics = set(names or ())
+                    out.append((rel, mod, node, frozenset(statics)))
+                    break
+            elif isinstance(node, ast.Call):
+                # assignment / expression form: instrumented_jit(fn, ...)
+                call = _jit_wrapper_call(project, mod, node)
+                if call is None or call is not node or not node.args:
+                    continue
+                target = project.internal_callee(mod, rel, node.args[0])
+                if target is None:
+                    continue
+                fn = project.function(*target)
+                if fn is None:
+                    continue
+                names = const_names(
+                    call_kwargs(node).get("static_argnames", ast.Tuple(elts=[]))
+                )
+                out.append(
+                    (target[0], project.modules[target[0]], fn,
+                     frozenset(names or ()))
+                )
+    return out
+
+
+def pallas_call_sites(
+    project: Project,
+) -> List[Tuple[str, Module, ast.Call, Optional[Tuple[str, ast.FunctionDef]], str]]:
+    """All ``pl.pallas_call(...)`` sites with their resolved kernel body:
+    (module_rel, module, call_node, (module_rel, kernel_def) | None,
+    enclosing_function_name).
+
+    The kernel argument is resolved through one level of local binding:
+    a bare function name, or ``k = functools.partial(kernel_fn, ...)``
+    assigned in the enclosing function before the call.  Sites inside
+    nested functions resolve against their INNERMOST enclosing scope
+    (``ast.walk`` yields outer scopes first, so the last write wins).
+    """
+    sites: Dict[int, Tuple] = {}
+    for rel, mod in project.modules.items():
+        for encl in ast.walk(mod.tree):
+            if not isinstance(encl, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # enclosing-scope partial bindings: name -> wrapped func expr
+            local_partials: Dict[str, ast.AST] = {}
+            for node in ast.walk(encl):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Call):
+                    dotted = project.dotted_callee(mod, node.value.func)
+                    if dotted == "functools.partial" and node.value.args:
+                        local_partials[node.targets[0].id] = node.value.args[0]
+            for node in ast.walk(encl):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = project.dotted_callee(mod, node.func)
+                if dotted is None or not dotted.endswith(".pallas_call"):
+                    continue
+                kernel = None
+                if node.args:
+                    kexpr = node.args[0]
+                    if isinstance(kexpr, ast.Name) and kexpr.id in local_partials:
+                        kexpr = local_partials[kexpr.id]
+                    target = project.internal_callee(mod, rel, kexpr)
+                    if target is not None:
+                        fn = project.function(*target)
+                        if fn is not None:
+                            kernel = (target[0], fn)
+                sites[id(node)] = (rel, mod, node, kernel, encl.name)
+    return list(sites.values())
+
+
+def positional_params(fn: ast.FunctionDef) -> List[str]:
+    return [a.arg for a in fn.args.posonlyargs + fn.args.args]
+
+
+class TaintWalker:
+    """Propagate tracer taint from entry functions through the in-package
+    call graph, invoking ``visit(module_rel, func, tainted_names, node)``
+    on every statement-level AST node of every reached function."""
+
+    def __init__(
+        self,
+        project: Project,
+        visit: Callable[[str, ast.FunctionDef, Set[str], ast.AST], None],
+        max_depth: int = 12,
+    ):
+        self.project = project
+        self.visit = visit
+        self.max_depth = max_depth
+        self._seen: Set[Tuple[int, FrozenSet[str]]] = set()
+
+    def walk(
+        self,
+        mod_rel: str,
+        fn: ast.FunctionDef,
+        tainted_params: FrozenSet[str],
+        depth: int = 0,
+    ) -> None:
+        key = (id(fn), tainted_params)
+        if key in self._seen or depth > self.max_depth:
+            return
+        self._seen.add(key)
+        mod = self.project.modules[mod_rel]
+        tainted: Set[str] = set(tainted_params)
+        # fixpoint over simple assignments (loops can forward-reference)
+        for _ in range(2):
+            before = len(tainted)
+            for node in ast.walk(fn):
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                    value = node.value
+                elif isinstance(node, ast.For):
+                    targets, value = [node.target], node.iter
+                if value is None:
+                    continue
+                if set(names_in(value)) & tainted:
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                tainted.add(n.id)
+            if len(tainted) == before:
+                break
+        for node in ast.walk(fn):
+            self.visit(mod_rel, fn, tainted, node)
+            if isinstance(node, ast.Call):
+                self._propagate(mod_rel, mod, node, tainted, depth)
+
+    def _propagate(
+        self,
+        mod_rel: str,
+        mod: Module,
+        call: ast.Call,
+        tainted: Set[str],
+        depth: int,
+    ) -> None:
+        target = self.project.internal_callee(mod, mod_rel, call.func)
+        if target is None:
+            return
+        fn = self.project.function(*target)
+        if fn is None:
+            return
+        params = positional_params(fn)
+        flowing: Set[str] = set()
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if i < len(params) and set(names_in(arg)) & tainted:
+                flowing.add(params[i])
+        for kw in call.keywords:
+            if kw.arg and set(names_in(kw.value)) & tainted:
+                flowing.add(kw.arg)
+        if flowing:
+            self.walk(target[0], fn, frozenset(flowing), depth + 1)

@@ -1,0 +1,73 @@
+"""GL006 — Config fields declared but never read.
+
+Generalizes tests/test_config_consumers.py: every field of the ``Config``
+dataclass in the package's top-level ``config.py`` must be READ somewhere
+outside config.py — as an attribute (``cfg.field``) or through
+``getattr(obj, "field", ...)``.  Mentions in strings/comments do not
+count.  Accept-and-ignore parameters (the VERDICT round-5 class) therefore
+fail the lint gate unless they carry a baseline entry whose justification
+documents WHY the TPU build deliberately has no consumer — the linter's
+baseline is the single reviewed allowlist.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .core import Finding, Project
+
+
+def _config_fields(project: Project):
+    mod = project.modules.get("config.py")
+    if mod is None:
+        return None, []
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            fields = [
+                (stmt.target.id, stmt.lineno)
+                for stmt in node.body
+                if isinstance(stmt, ast.AnnAssign)
+                and isinstance(stmt.target, ast.Name)
+                and stmt.target.id != "raw"
+            ]
+            return mod, fields
+    return mod, []
+
+
+def _consumed_names(project: Project) -> Set[str]:
+    names: Set[str] = set()
+    for rel, mod in project.modules.items():
+        if rel == "config.py":
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute):
+                names.add(node.attr)
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "getattr"
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+            ):
+                names.add(str(node.args[1].value))
+    return names
+
+
+def check(project: Project) -> List[Finding]:
+    mod, fields = _config_fields(project)
+    if mod is None or not fields:
+        return []
+    consumed = _consumed_names(project)
+    return [
+        Finding(
+            rule="GL006",
+            path=mod.rel,
+            line=line,
+            ident=name,
+            message=f"Config.{name} is declared but never read outside "
+            "config.py — an accept-and-ignore parameter",
+        )
+        for name, line in fields
+        if name not in consumed
+    ]

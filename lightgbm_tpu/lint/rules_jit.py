@@ -1,0 +1,217 @@
+"""Tracer-safety rules around jit call sites.
+
+GL001 — bare ``jax.jit``/``jax.pmap`` outside ``obs/jit.py``.  Every jit
+site must route through ``instrumented_jit`` so ``compile_count()`` counts
+actual retraces exactly (the PR-5 telemetry contract); a bare site is a
+hole in the no-recompile invariant the telemetry tests assert on.
+
+GL003 — host-sync calls (``float``/``int``/``bool``, ``.item()``/
+``.tolist()``, ``np.asarray``/``np.array``, ``jax.device_get``) on
+tracer-flowing values inside functions reachable from a jit or Pallas
+entry point.  Reachability and taint come from callgraph.TaintWalker; jit
+``static_argnames`` are excluded from taint, so ``float(l1)`` on a static
+hyper-parameter does not fire.
+
+GL004 — module-level Python FLOAT constants closed over by jitted
+functions without an explicit ``jnp.asarray(..., dtype=...)`` (or
+``jnp.float32(...)``-style) wrap at the use site.  Weak-typed closures
+promote by value and drift the traced dtype (retrace hazard).  Integer
+constants are deliberately out of scope: they are overwhelmingly shapes,
+strides and loop bounds, which are static by design.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .callgraph import TaintWalker, jit_entries, pallas_call_sites, positional_params
+from .core import Finding, Module, Project, names_in
+
+_NUMPY_SYNC = {
+    "asarray", "array", "float32", "float64", "int32", "int64", "ascontiguousarray",
+}
+_ASARRAY_WRAPPERS = {
+    "asarray", "array", "float32", "float64", "int32", "int16", "int8",
+    "bfloat16", "float16",
+}
+
+
+# ------------------------------------------------------------------ GL001
+def _check_gl001(project: Project) -> List[Finding]:
+    findings = []
+    for rel, mod in project.modules.items():
+        if rel == "obs/jit.py":
+            continue  # the one sanctioned wrapper site
+        stack: List[str] = []
+
+        def visit(node: ast.AST) -> None:
+            is_fn = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            if is_fn:
+                stack.append(node.name)
+            # flag REFERENCES, not just call expressions, so decorator form
+            # (@jax.jit) and functools.partial(jax.jit, ...) are caught too
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                dotted = project.dotted_callee(mod, node)
+                if dotted in ("jax.jit", "jax.pmap"):
+                    where = ".".join(stack) or "<module>"
+                    findings.append(
+                        Finding(
+                            rule="GL001",
+                            path=mod.rel,
+                            line=node.lineno,
+                            ident=where,
+                            message=f"bare {dotted} in {where}; route "
+                            "through instrumented_jit(label=...) so "
+                            "compile_count()/compile_counts_by_label() see "
+                            "its retraces",
+                        )
+                    )
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_fn:
+                stack.pop()
+
+        visit(mod.tree)
+    return findings
+
+
+# ------------------------------------------------------------------ GL003
+def _check_gl003(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def make_visitor(entry_label: str):
+        def visit(mod_rel: str, fn: ast.FunctionDef, tainted: Set[str],
+                  node: ast.AST) -> None:
+            if not isinstance(node, ast.Call):
+                return
+            mod = project.modules[mod_rel]
+            dotted = project.dotted_callee(mod, node.func)
+            hit = None  # (callable spelling, offending names)
+            if dotted == "jax.device_get":
+                hit = ("jax.device_get", set())
+            elif isinstance(node.func, ast.Name) and node.func.id in (
+                "float", "int", "bool"
+            ) and node.func.id not in mod.imports:
+                names = set()
+                for arg in node.args:
+                    names |= set(names_in(arg)) & tainted
+                if names:
+                    hit = (node.func.id, names)
+            elif isinstance(node.func, ast.Attribute) and node.func.attr in (
+                "item", "tolist"
+            ):
+                names = set(names_in(node.func.value)) & tainted
+                if names:
+                    hit = ("." + node.func.attr, names)
+            elif dotted is not None and dotted.startswith("numpy.") and \
+                    dotted.split(".")[-1] in _NUMPY_SYNC and node.args:
+                names = set(names_in(node.args[0])) & tainted
+                if names:
+                    hit = (dotted, names)
+            if hit is None:
+                return
+            spelling, names = hit
+            via = f" via {', '.join(sorted(names))}" if names else ""
+            findings.append(
+                Finding(
+                    rule="GL003",
+                    path=mod.rel,
+                    line=node.lineno,
+                    ident=f"{fn.name}:{spelling}:{','.join(sorted(names))}",
+                    message=f"host-sync {spelling}(){via} in {fn.name}(), "
+                    f"reachable from traced entry {entry_label} — this "
+                    "blocks (or fails) under tracing",
+                )
+            )
+
+        return visit
+
+    for rel, mod, fn, statics in jit_entries(project):
+        tainted = frozenset(set(positional_params(fn)) - set(statics))
+        walker = TaintWalker(project, make_visitor(f"{fn.name} (jit)"))
+        walker.walk(rel, fn, tainted)
+    for rel, mod, call, kernel, _encl in pallas_call_sites(project):
+        if kernel is None:
+            continue
+        krel, kfn = kernel
+        walker = TaintWalker(
+            project, make_visitor(f"{kfn.name} (pallas kernel)")
+        )
+        walker.walk(krel, kfn, frozenset(positional_params(kfn)))
+    return findings
+
+
+# ------------------------------------------------------------------ GL004
+def _bound_names(fn: ast.FunctionDef) -> Set[str]:
+    bound: Set[str] = {a.arg for a in (
+        fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs
+    )}
+    if fn.args.vararg:
+        bound.add(fn.args.vararg.arg)
+    if fn.args.kwarg:
+        bound.add(fn.args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+            node.ctx, (ast.Store, ast.Del)
+        ):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if node is not fn:
+                bound.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                bound.add(alias.asname or alias.name.split(".")[0])
+    return bound
+
+
+def _check_gl004(project: Project) -> List[Finding]:
+    findings = []
+    for rel, mod, fn, _statics in jit_entries(project):
+        float_consts = {
+            k for k, v in mod.consts.items() if isinstance(v, float)
+        }
+        if not float_consts:
+            continue
+        bound = _bound_names(fn)
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(fn):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in float_consts
+                and node.id not in bound
+            ):
+                continue
+            # exempt uses already wrapped in an explicit dtype pin
+            wrapped = False
+            cur = parents.get(node)
+            while cur is not None:
+                if isinstance(cur, ast.Call):
+                    d = project.dotted_callee(mod, cur.func)
+                    if d is not None and d.split(".")[-1] in _ASARRAY_WRAPPERS:
+                        wrapped = True
+                        break
+                cur = parents.get(cur)
+            if wrapped:
+                continue
+            findings.append(
+                Finding(
+                    rule="GL004",
+                    path=mod.rel,
+                    line=node.lineno,
+                    ident=f"{fn.name}:{node.id}",
+                    message=f"jitted {fn.name}() closes over weak-typed "
+                    f"float constant {node.id}; pin it with "
+                    f"jnp.asarray({node.id}, dtype=...) to avoid dtype "
+                    "drift across retraces",
+                )
+            )
+    return findings
+
+
+def check(project: Project) -> List[Finding]:
+    return _check_gl001(project) + _check_gl003(project) + _check_gl004(project)

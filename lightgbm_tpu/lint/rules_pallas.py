@@ -1,0 +1,336 @@
+"""Pallas-contract rules.
+
+GL002 — the PR-6 bug class: a kernel body that READS a ref listed as the
+input side of ``input_output_aliases`` in its enclosing ``pallas_call``.
+On hardware input and output alias the same HBM buffer, but interpret mode
+materializes them separately, so writes through the output ref are
+invisible to later input-ref reads (and batched grids re-read boundary
+tiles an earlier program already rewrote).  The analysis tracks REF
+ALIASING only — ``x = ref``, ``x = ref if c else other_ref``, and passing
+the ref itself to an in-package helper — not derived values: reading data
+that CAME from the ref is fine, re-reading the REF is the bug.  A read is
+a ``ref[...]`` subscript load or a ``ref.at[...]`` slice (the DMA-source
+idiom).
+
+GL005 — statically checkable ``pallas_call`` contract breaches:
+
+* VMEM block shapes whose lane (last) dim is not a multiple of 128, or
+  whose sublane (second-minor) dim is neither 1 nor a multiple of the
+  dtype tile height (f32/i32: 8, bf16/i16: 16, i8: 32 — the "(8, 128) ×
+  dtype" rule; out_specs use the out_shape dtype, in_specs conservatively
+  use 8).  Dims that are not integer literals or module-level int
+  constants are skipped, not guessed.
+* ``index_map`` lambda arity != grid rank, and index-map result length !=
+  block rank.
+* ``out_specs``/``out_shape`` list-length mismatch and per-slot block rank
+  vs ``ShapeDtypeStruct`` rank mismatch.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .callgraph import pallas_call_sites, positional_params
+from .core import Finding, Module, Project, call_kwargs, literal_dims
+
+_SUBLANE = {
+    "float32": 8, "int32": 8, "uint32": 8,
+    "bfloat16": 16, "float16": 16, "int16": 16, "uint16": 16,
+    "int8": 32, "uint8": 32, "float8_e4m3fn": 32, "float8_e5m2": 32,
+}
+
+
+# ------------------------------------------------------------------ GL002
+class _AliasReadWalker:
+    """Find reads of aliased refs in a kernel, following ref aliasing
+    through simple assignments and in-package helper calls."""
+
+    def __init__(self, project: Project, kernel_name: str):
+        self.project = project
+        self.kernel_name = kernel_name
+        self.findings: List[Finding] = []
+        self._seen: Set[Tuple[int, FrozenSet[str]]] = set()
+
+    def walk(self, mod_rel: str, fn: ast.FunctionDef,
+             aliased: FrozenSet[str], depth: int = 0) -> None:
+        key = (id(fn), aliased)
+        if key in self._seen or depth > 10:
+            return
+        self._seen.add(key)
+        mod = self.project.modules[mod_rel]
+        refs: Set[str] = set(aliased)
+        for _ in range(2):  # aliases may be formed before first use in loops
+            before = len(refs)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    continue
+                v = node.value
+                is_alias = (isinstance(v, ast.Name) and v.id in refs) or (
+                    isinstance(v, ast.IfExp)
+                    and any(
+                        isinstance(b, ast.Name) and b.id in refs
+                        for b in (v.body, v.orelse)
+                    )
+                )
+                if is_alias:
+                    refs.add(node.targets[0].id)
+            if len(refs) == before:
+                break
+
+        def ref_name(expr: ast.AST) -> Optional[str]:
+            if isinstance(expr, ast.Name) and expr.id in refs:
+                return expr.id
+            if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name
+            ) and expr.value.id in refs:
+                return expr.value.id
+            return None
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Subscript) and isinstance(
+                node.ctx, ast.Load
+            ):
+                name = ref_name(node.value)
+                if name is not None:
+                    self.findings.append(
+                        Finding(
+                            rule="GL002",
+                            path=mod.rel,
+                            line=node.lineno,
+                            ident=f"{self.kernel_name}:{fn.name}:{name}",
+                            message=f"kernel {self.kernel_name} reads "
+                            f"input-aliased ref '{name}' in {fn.name}(); "
+                            "reads must go through the output-aliased ref "
+                            "or they miss earlier writes (interpret mode, "
+                            "re-read boundary tiles)",
+                        )
+                    )
+            elif isinstance(node, ast.Call):
+                target = self.project.internal_callee(mod, mod_rel, node.func)
+                if target is None:
+                    continue
+                callee = self.project.function(*target)
+                if callee is None:
+                    continue
+                params = positional_params(callee)
+                flowing: Set[str] = set()
+                for i, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Starred):
+                        break
+                    if i < len(params) and isinstance(arg, ast.Name) \
+                            and arg.id in refs:
+                        flowing.add(params[i])
+                for kw in node.keywords:
+                    if kw.arg and isinstance(kw.value, ast.Name) \
+                            and kw.value.id in refs:
+                        flowing.add(kw.arg)
+                if flowing:
+                    self.walk(target[0], callee, frozenset(flowing), depth + 1)
+
+
+def _check_gl002(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, mod, call, kernel, _encl in pallas_call_sites(project):
+        aliases = call_kwargs(call).get("input_output_aliases")
+        if kernel is None or not isinstance(aliases, ast.Dict):
+            continue
+        in_indices = [
+            k.value
+            for k in aliases.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, int)
+        ]
+        krel, kfn = kernel
+        params = positional_params(kfn)
+        aliased = frozenset(
+            params[i] for i in in_indices if i < len(params)
+        )
+        if not aliased:
+            continue
+        walker = _AliasReadWalker(project, kfn.name)
+        walker.walk(krel, kfn, aliased)
+        findings.extend(walker.findings)
+    return findings
+
+
+# ------------------------------------------------------------------ GL005
+def _spec_calls(project: Project, mod: Module, expr: ast.AST) -> List[Optional[ast.Call]]:
+    """BlockSpec Call nodes from an in_specs/out_specs expression: a
+    literal list/tuple or a single spec.  Unresolvable elements are None.
+    Returns [] when the whole expression is not statically a spec list."""
+    elts = expr.elts if isinstance(expr, (ast.List, ast.Tuple)) else [expr]
+    out: List[Optional[ast.Call]] = []
+    for e in elts:
+        if isinstance(e, ast.Call):
+            d = project.dotted_callee(mod, e.func)
+            name = e.func.id if isinstance(e.func, ast.Name) else None
+            if (d is not None and d.endswith(".BlockSpec")) or name == "BlockSpec":
+                out.append(e)
+                continue
+        out.append(None)
+    return out
+
+
+def _memory_space(spec: ast.Call) -> Optional[str]:
+    ms = call_kwargs(spec).get("memory_space")
+    if ms is None:
+        return None
+    if isinstance(ms, ast.Attribute):
+        return ms.attr
+    if isinstance(ms, ast.Name):
+        return ms.id
+    return None
+
+
+def _dtype_name(project: Project, mod: Module, expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        entry = mod.imports.get(expr.id)
+        if entry is not None and entry[0] == "extobj":
+            return entry[2]
+        return expr.id
+    return None
+
+
+def _out_shape_calls(project: Project, mod: Module, expr: ast.AST) -> List[Optional[ast.Call]]:
+    elts = expr.elts if isinstance(expr, (ast.List, ast.Tuple)) else [expr]
+    out: List[Optional[ast.Call]] = []
+    for e in elts:
+        if isinstance(e, ast.Call):
+            d = project.dotted_callee(mod, e.func)
+            if d is not None and d.endswith(".ShapeDtypeStruct"):
+                out.append(e)
+                continue
+        out.append(None)
+    return out
+
+
+def _check_block_spec(
+    project: Project,
+    mod: Module,
+    spec: ast.Call,
+    slot: str,
+    encl: str,
+    grid_rank: Optional[int],
+    sublane_req: int,
+    shape_struct: Optional[ast.Call],
+    findings: List[Finding],
+) -> None:
+    def add(line: int, what: str, message: str) -> None:
+        findings.append(
+            Finding(
+                rule="GL005",
+                path=mod.rel,
+                line=line,
+                ident=f"{encl}:{slot}:{what}",
+                message=message,
+            )
+        )
+
+    block_shape = spec.args[0] if spec.args else None
+    index_map = spec.args[1] if len(spec.args) > 1 else call_kwargs(spec).get(
+        "index_map"
+    )
+    if _memory_space(spec) in ("SMEM", "ANY", "SEMAPHORE"):
+        return  # tiling constraints apply to VMEM blocks only
+    dims = literal_dims(block_shape, mod.consts) if block_shape is not None else None
+    if dims is not None:
+        if len(dims) >= 1 and dims[-1] is not None and dims[-1] % 128 != 0:
+            add(
+                block_shape.lineno, "lane",
+                f"{slot} block lane dim {dims[-1]} is not a multiple of "
+                "128 (VMEM tiling)",
+            )
+        if len(dims) >= 2 and dims[-2] is not None and dims[-2] != 1 \
+                and dims[-2] % sublane_req != 0:
+            add(
+                block_shape.lineno, "sublane",
+                f"{slot} block sublane dim {dims[-2]} is neither 1 nor a "
+                f"multiple of {sublane_req} (dtype tile height)",
+            )
+    if isinstance(index_map, ast.Lambda):
+        arity = len(index_map.args.args)
+        if grid_rank is not None and arity != grid_rank:
+            add(
+                index_map.lineno, "arity",
+                f"{slot} index_map takes {arity} args but the grid has "
+                f"{grid_rank} dims",
+            )
+        ret = index_map.body
+        if isinstance(ret, ast.Tuple) and dims is not None and \
+                len(ret.elts) != len(dims):
+            add(
+                index_map.lineno, "rank",
+                f"{slot} index_map returns {len(ret.elts)} coordinates for "
+                f"a rank-{len(dims)} block shape",
+            )
+    if shape_struct is not None and dims is not None:
+        sshape = shape_struct.args[0] if shape_struct.args else None
+        if isinstance(sshape, (ast.Tuple, ast.List)) and \
+                len(sshape.elts) != len(dims):
+            add(
+                block_shape.lineno, "out_rank",
+                f"{slot} block shape is rank {len(dims)} but its out_shape "
+                f"ShapeDtypeStruct is rank {len(sshape.elts)}",
+            )
+
+
+def _check_gl005(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel, mod, call, _kernel, encl in pallas_call_sites(project):
+        kwargs = call_kwargs(call)
+        grid = kwargs.get("grid")
+        grid_rank: Optional[int] = None
+        if isinstance(grid, (ast.Tuple, ast.List)):
+            grid_rank = len(grid.elts)
+        elif isinstance(grid, ast.Constant) and isinstance(grid.value, int):
+            grid_rank = 1
+        out_specs = kwargs.get("out_specs")
+        out_shape = kwargs.get("out_shape")
+        shape_calls: List[Optional[ast.Call]] = []
+        if out_shape is not None:
+            shape_calls = _out_shape_calls(project, mod, out_shape)
+        if out_specs is not None and out_shape is not None and \
+                isinstance(out_specs, (ast.List, ast.Tuple)) and \
+                isinstance(out_shape, (ast.List, ast.Tuple)) and \
+                len(out_specs.elts) != len(out_shape.elts):
+            findings.append(
+                Finding(
+                    rule="GL005",
+                    path=mod.rel,
+                    line=out_specs.lineno,
+                    ident=f"{encl}:out_specs:count",
+                    message=f"pallas_call in {encl}() declares "
+                    f"{len(out_specs.elts)} out_specs but "
+                    f"{len(out_shape.elts)} out_shape entries",
+                )
+            )
+        if out_specs is not None:
+            for i, spec in enumerate(_spec_calls(project, mod, out_specs)):
+                if spec is None:
+                    continue
+                struct = shape_calls[i] if i < len(shape_calls) else None
+                sublane = 8
+                if struct is not None and len(struct.args) > 1:
+                    dname = _dtype_name(project, mod, struct.args[1])
+                    sublane = _SUBLANE.get(dname or "", 8)
+                _check_block_spec(
+                    project, mod, spec, f"out_specs[{i}]", encl, grid_rank,
+                    sublane, struct, findings,
+                )
+        in_specs = kwargs.get("in_specs")
+        if in_specs is not None:
+            for i, spec in enumerate(_spec_calls(project, mod, in_specs)):
+                if spec is None:
+                    continue
+                _check_block_spec(
+                    project, mod, spec, f"in_specs[{i}]", encl, grid_rank,
+                    8, None, findings,
+                )
+    return findings
+
+
+def check(project: Project) -> List[Finding]:
+    return _check_gl002(project) + _check_gl005(project)

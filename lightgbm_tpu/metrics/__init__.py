@@ -357,7 +357,9 @@ def _mlogloss_device(score, label, weight):
     k = score.shape[0]
     onehot = jax.nn.one_hot(label, k, axis=0, dtype=logp.dtype)  # [K, N]
     p = jnp.sum(logp * onehot, axis=0)
-    loss = -jnp.maximum(p, jnp.log(_EPS))
+    # _EPS is a weak-typed Python float; pin the dtype so the traced
+    # constant cannot drift with promotion rules (graftlint GL004)
+    loss = -jnp.maximum(p, jnp.log(jnp.asarray(_EPS, p.dtype)))
     if weight is not None:
         loss = loss * weight
     return loss.sum()

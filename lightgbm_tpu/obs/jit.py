@@ -34,7 +34,12 @@ def note_compile(label: str = "jit") -> None:
 def compile_count() -> int:
     """Total traces/compiles this process (instrumented jits + the
     streaming predictor's AOT bucket executables)."""
-    return _count
+    # the read takes _lock like note_compile's read-modify-write: int loads
+    # are CPython-atomic, but pairing the read with the lock keeps the
+    # counter exact under free-threaded builds and guarantees a reader
+    # never observes _count and _by_label mid-update relative to each other
+    with _lock:
+        return _count
 
 
 def compile_counts_by_label() -> Dict[str, int]:

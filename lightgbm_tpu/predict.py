@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .obs.jit import instrumented_jit, note_compile
+from .obs.jit import instrumented_jit
 from .obs.registry import get_session
 from .tree import (
     K_CATEGORICAL_MASK,
@@ -589,7 +589,12 @@ class StreamingPredictor:
             # donate the chunk buffer: the walk never reuses it, and
             # donation lets XLA recycle the H2D staging allocation
             jit_kwargs["donate_argnums"] = (len(tables),)
-        fn = jax.jit(impl, **jit_kwargs)
+        # labeled per table variant so suspect re-walk ("real") compiles are
+        # separable in compile_counts_by_label(); the lower().compile() below
+        # traces exactly once, which instrumented_jit counts at trace time
+        fn = instrumented_jit(
+            impl, label=f"predict/stream/{variant}", **jit_kwargs
+        )
         avals = tuple(
             jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t
@@ -599,7 +604,6 @@ class StreamingPredictor:
         compiled = fn.lower(*avals).compile()
         _EXEC_CACHE[key] = compiled
         _COMPILE_COUNT += 1
-        note_compile("predict/stream")
         return compiled
 
     def warmup(

@@ -1,0 +1,516 @@
+"""graftlint (lightgbm_tpu.lint) — the static-analysis CI gate.
+
+Contracts under test:
+  * every rule (GL001..GL006) FIRES on a seeded positive fixture and stays
+    SILENT on the matching negative — the linter is pure ast, so fixtures
+    are throwaway source trees written to tmp_path and never imported;
+  * per-line ``# graftlint: disable[=CODES]`` suppression works and is
+    rule-scoped;
+  * the baseline round-trips: new findings fail the run, ``write_baseline``
+    absorbs them, entries that stop firing go STALE and fail the run (a
+    baseline may only shrink through review);
+  * mutation test: re-seeding the PR-3/PR-6 aliased-ref-read bug into a
+    copy of ops/pallas/partition.py is caught by GL002 through the real
+    kernel -> _partition_window -> read_aliased_tile call chain;
+  * the real tree is CLEAN against the committed lint_baseline.json and a
+    full run fits the 10 s budget (it is a hard gate in tools/run_tests.sh).
+"""
+
+import json
+import re
+import subprocess
+import sys
+import textwrap
+import time
+from pathlib import Path
+
+import pytest
+
+from lightgbm_tpu.lint import (
+    RULES,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = REPO / "lightgbm_tpu"
+
+
+def make_project(tmp_path, files, name="fixpkg"):
+    """Write a throwaway package tree and return its root."""
+    root = tmp_path / name
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return root
+
+
+def by_rule(result, rule):
+    return [f for f in result.findings if f.rule == rule]
+
+
+def idents(result, rule):
+    return {f.ident for f in by_rule(result, rule)}
+
+
+# ===================================================================== GL001
+def test_gl001_flags_every_bare_jit_reference(tmp_path):
+    """Call form, assignment form, and decorator form all fire; the ident
+    is the enclosing function, so the baseline key survives line churn."""
+    root = make_project(tmp_path, {
+        "app.py": """\
+            import jax
+
+            j = jax.pmap
+
+            def build(fn):
+                return jax.jit(fn)
+
+            @jax.jit
+            def decorated(x):
+                return x
+            """,
+    })
+    res = run_lint(root)
+    assert idents(res, "GL001") == {"<module>", "build", "decorated"}
+    assert not res.ok  # no baseline: every finding is new -> gate fails
+
+
+def test_gl001_silent_on_instrumented_jit_and_inside_wrapper_module(tmp_path):
+    root = make_project(tmp_path, {
+        "app.py": """\
+            from .obs.jit import instrumented_jit
+
+            @instrumented_jit
+            def f(x):
+                return x
+            """,
+        "obs/__init__.py": "",
+        "obs/jit.py": """\
+            import jax
+
+            def instrumented_jit(fun, **kw):
+                return jax.jit(fun, **kw)
+            """,
+    })
+    assert by_rule(run_lint(root), "GL001") == []
+
+
+# ===================================================================== GL002
+_GL002_KERNEL = """\
+    from jax.experimental import pallas as pl
+
+    def _kern(x_ref, o_ref):
+        o_ref[...] = {read} + 1.0
+
+    def launch(x):
+        return pl.pallas_call(
+            _kern,
+            out_shape=x,
+            input_output_aliases={{0: 0}},
+        )(x)
+    """
+
+
+def test_gl002_flags_direct_read_of_input_aliased_ref(tmp_path):
+    root = make_project(
+        tmp_path, {"k.py": _GL002_KERNEL.format(read="x_ref[...]")}
+    )
+    assert idents(run_lint(root), "GL002") == {"_kern:_kern:x_ref"}
+
+
+def test_gl002_silent_on_output_ref_and_derived_values(tmp_path):
+    """Reading the OUTPUT alias is the fix; subscripting a value that came
+    FROM the ref is not a ref read (value taint is GL003's business)."""
+    root = make_project(tmp_path, {
+        "k.py": """\
+            from jax.experimental import pallas as pl
+
+            def _kern(x_ref, o_ref):
+                v = o_ref[...]
+                o_ref[...] = v[0] + v[1]
+
+            def launch(x):
+                return pl.pallas_call(
+                    _kern,
+                    out_shape=x,
+                    input_output_aliases={0: 0},
+                )(x)
+            """,
+    })
+    assert by_rule(run_lint(root), "GL002") == []
+
+
+def test_gl002_follows_conditional_alias_and_helper_calls(tmp_path):
+    """The partition.py shape: the ref aliases through an IfExp into a
+    local name, and separately flows BY NAME into an in-package helper
+    whose read then fires."""
+    root = make_project(tmp_path, {
+        "k.py": """\
+            from jax.experimental import pallas as pl
+
+            def _read(src, o_ref):
+                return src[0]
+
+            def _kern(x_ref, o_ref, flag):
+                src = x_ref if flag else o_ref
+                tile = src[...]
+                o_ref[...] = tile + _read(x_ref, o_ref)
+
+            def launch(x, flag):
+                return pl.pallas_call(
+                    _kern,
+                    out_shape=x,
+                    input_output_aliases={0: 0},
+                )(x, flag)
+            """,
+    })
+    assert idents(run_lint(root), "GL002") == {
+        "_kern:_kern:src",  # IfExp alias read in the kernel body
+        "_kern:_read:src",  # exact-Name arg flow into the helper
+    }
+
+
+# ===================================================================== GL003
+def test_gl003_flags_host_sync_through_the_call_graph(tmp_path):
+    """float()/.item()/np.asarray/jax.device_get on tracer-flowing values,
+    including one hop into an in-package helper."""
+    root = make_project(tmp_path, {
+        "app.py": """\
+            import jax
+            import numpy as np
+
+            def _helper(v):
+                s = v + 1
+                return float(s)
+
+            @instrumented_jit
+            def entry(x):
+                y = x * 2
+                host = np.asarray(x)
+                pulled = jax.device_get(x)
+                return _helper(x) + y.item()
+            """,
+    })
+    assert idents(run_lint(root), "GL003") == {
+        "_helper:float:s",
+        "entry:numpy.asarray:x",
+        "entry:jax.device_get:",
+        "entry:.item:y",
+    }
+
+
+def test_gl003_silent_on_static_argnames_and_unreachable_code(tmp_path):
+    """static_argnames values never become tracers (the split_scan_pallas
+    idiom: float(l1) on a static hyper-parameter is fine), and host code
+    the call graph cannot reach from an entry is out of scope."""
+    root = make_project(tmp_path, {
+        "app.py": """\
+            import functools
+
+            @functools.partial(instrumented_jit, static_argnames=("n",))
+            def entry(x, n):
+                return x * int(n)
+
+            def cold_path(v):
+                return float(v)
+            """,
+    })
+    assert by_rule(run_lint(root), "GL003") == []
+
+
+# ===================================================================== GL004
+def test_gl004_weak_float_closure_vs_pinned_and_int(tmp_path):
+    root = make_project(tmp_path, {
+        "app.py": """\
+            import jax.numpy as jnp
+
+            EPS = 1e-6
+            SCALE = 2.5
+            N_TILES = 4
+
+            @instrumented_jit
+            def bad(x):
+                return x + EPS
+
+            @instrumented_jit
+            def good(x):
+                return x * jnp.asarray(SCALE, jnp.float32) + N_TILES
+
+            def unjitted(x):
+                return x + EPS
+            """,
+    })
+    assert idents(run_lint(root), "GL004") == {"bad:EPS"}
+
+
+# ===================================================================== GL005
+def test_gl005_block_and_contract_checks(tmp_path):
+    """One enclosing function per defect so each ident isolates one check:
+    lane alignment, dtype-aware sublane, index_map arity and rank,
+    out_specs/out_shape count and rank."""
+    root = make_project(tmp_path, {
+        "k.py": """\
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            LANES = 128
+
+            def bad_lane(x):
+                return pl.pallas_call(
+                    kern,
+                    grid=(4,),
+                    out_shape=jax.ShapeDtypeStruct((8, 64), jnp.float32),
+                    out_specs=pl.BlockSpec((8, 64), lambda i: (0, 0)),
+                )(x)
+
+            def bad_sublane_bf16(x):
+                return pl.pallas_call(
+                    kern,
+                    grid=(4,),
+                    out_shape=jax.ShapeDtypeStruct((64, LANES), jnp.bfloat16),
+                    out_specs=pl.BlockSpec((8, LANES), lambda i: (0, 0)),
+                )(x)
+
+            def bad_arity(x):
+                return pl.pallas_call(
+                    kern,
+                    grid=(2, 2),
+                    out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                    out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+                )(x)
+
+            def bad_rank(x):
+                return pl.pallas_call(
+                    kern,
+                    grid=(2,),
+                    out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                    out_specs=pl.BlockSpec((8, 128), lambda i: (0,)),
+                )(x)
+
+            def bad_count(x):
+                return pl.pallas_call(
+                    kern,
+                    grid=(2,),
+                    out_shape=[jax.ShapeDtypeStruct((8, 128), jnp.float32)],
+                    out_specs=[
+                        pl.BlockSpec((8, 128), lambda i: (0, 0)),
+                        pl.BlockSpec((8, 128), lambda i: (0, 0)),
+                    ],
+                )(x)
+
+            def bad_out_rank(x):
+                return pl.pallas_call(
+                    kern,
+                    grid=(2,),
+                    out_shape=jax.ShapeDtypeStruct((2, 8, 128), jnp.float32),
+                    out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+                )(x)
+            """,
+    })
+    assert idents(run_lint(root), "GL005") == {
+        "bad_lane:out_specs[0]:lane",
+        "bad_sublane_bf16:out_specs[0]:sublane",
+        "bad_arity:out_specs[0]:arity",
+        "bad_rank:out_specs[0]:rank",
+        "bad_count:out_specs:count",
+        "bad_out_rank:out_specs[0]:out_rank",
+    }
+
+
+def test_gl005_silent_on_aligned_smem_and_unresolvable_dims(tmp_path):
+    """Aligned VMEM blocks pass; 1-row blocks are allowed; SMEM specs are
+    exempt from tiling; dims the linter cannot resolve are skipped, never
+    guessed."""
+    root = make_project(tmp_path, {
+        "k.py": """\
+            import jax
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            LANES = 128
+
+            def clean(x, n):
+                return pl.pallas_call(
+                    kern,
+                    grid=(n, 2),
+                    in_specs=[
+                        pl.BlockSpec((1, 8, LANES), lambda i, j: (i, 0, 0)),
+                        pl.BlockSpec((n, LANES), lambda i, j: (0, j)),
+                        pl.BlockSpec(memory_space=pltpu.SMEM),
+                        pl.BlockSpec((1, LANES), lambda i, j: (0, j)),
+                    ],
+                    out_shape=jax.ShapeDtypeStruct((16, 128), jnp.bfloat16),
+                    out_specs=pl.BlockSpec((16, LANES), lambda i, j: (i, j)),
+                )(x)
+            """,
+    })
+    assert by_rule(run_lint(root), "GL005") == []
+
+
+# ===================================================================== GL006
+def test_gl006_orphan_config_field(tmp_path):
+    root = make_project(tmp_path, {
+        "config.py": """\
+            class Config:
+                used: int = 1
+                getattr_used: int = 2
+                orphan: int = 3
+                raw: dict = None
+            """,
+        "consumer.py": """\
+            def f(cfg, obj):
+                return cfg.used + getattr(obj, "getattr_used", 0)
+            """,
+    })
+    assert idents(run_lint(root), "GL006") == {"orphan"}
+
+
+# ================================================================ suppression
+@pytest.mark.parametrize(
+    "comment,fires",
+    [
+        ("# graftlint: disable=GL001", False),
+        ("# graftlint: disable=GL002,GL001", False),
+        ("# graftlint: disable", False),  # bare disable: all rules
+        ("# graftlint: disable=GL005", True),  # wrong code: still fires
+        ("", True),
+    ],
+)
+def test_suppression_comment_is_rule_scoped(tmp_path, comment, fires):
+    root = make_project(tmp_path, {
+        "app.py": f"""\
+            import jax
+
+            def build(fn):
+                return jax.jit(fn)  {comment}
+            """,
+    })
+    assert bool(by_rule(run_lint(root), "GL001")) is fires
+
+
+# =================================================================== baseline
+def test_baseline_round_trip_and_stale_detection(tmp_path):
+    files = {
+        "app.py": """\
+            import jax
+
+            def build(fn):
+                return jax.jit(fn)
+            """,
+    }
+    root = make_project(tmp_path, files)
+    bp = tmp_path / "baseline.json"
+
+    # 1) no baseline: the finding is NEW and the gate fails
+    first = run_lint(root)
+    assert not first.ok and len(first.new) == 1
+
+    # 2) absorb into the baseline: same tree is now clean
+    write_baseline(bp, first.findings)
+    entries = load_baseline(bp)
+    assert [e["ident"] for e in entries] == ["build"]
+    assert all("justification" in e for e in entries)
+    absorbed = run_lint(root, baseline=bp)
+    assert absorbed.ok and not absorbed.new and not absorbed.stale
+
+    # 3) fix the code: the baseline entry goes STALE and fails the run —
+    #    a baseline only shrinks through review, never silently
+    (root / "app.py").write_text("def build(fn):\n    return fn\n")
+    fixed = run_lint(root, baseline=bp)
+    assert not fixed.ok
+    assert not fixed.new
+    assert [e["ident"] for e in fixed.stale] == ["build"]
+
+
+def test_baseline_rejects_entries_without_justification(tmp_path):
+    bp = tmp_path / "b.json"
+    bp.write_text(json.dumps({
+        "version": 1,
+        "entries": [{"rule": "GL001", "path": "x.py", "ident": "f"}],
+    }))
+    with pytest.raises(SystemExit):
+        load_baseline(bp)
+
+
+# ============================================================== mutation test
+_PARTITION = PKG / "ops" / "pallas" / "partition.py"
+_ALIAS_LINE = "src = seg_in if read_via_input else seg_out"
+
+
+def _partition_copy(tmp_path, mutate):
+    src = _PARTITION.read_text()
+    assert _ALIAS_LINE in src  # the mutation target still exists
+    if mutate:
+        # strip the inline suppression, then re-seed the PR-3 bug: read the
+        # INPUT side of the alias unconditionally
+        src = re.sub(r"#\s*graftlint:[^\n]*", "", src)
+        src = src.replace(_ALIAS_LINE, "src = seg_in")
+    return make_project(tmp_path, {"ops/pallas/partition.py": src})
+
+
+def test_mutation_seeded_aliased_read_is_caught(tmp_path):
+    """Re-introducing the aliasing bug into a copy of the REAL partition
+    kernel fires GL002 through the _seg_partition_kernel ->
+    _partition_window -> read_aliased_tile chain."""
+    res = run_lint(_partition_copy(tmp_path, mutate=True))
+    assert "_seg_partition_kernel:read_aliased_tile:src" in idents(
+        res, "GL002"
+    )
+    assert not res.ok
+
+
+def test_mutation_control_pristine_copy_is_clean(tmp_path):
+    """The unmutated copy carries the reviewed inline suppression for the
+    test-only read_via_input knob and produces no GL002."""
+    res = run_lint(_partition_copy(tmp_path, mutate=False))
+    assert by_rule(res, "GL002") == []
+
+
+# ================================================================== the gate
+def test_real_tree_clean_against_committed_baseline():
+    """THE gate: the shipped package has zero unbaselined findings and zero
+    stale baseline entries, within the 10 s budget."""
+    t0 = time.monotonic()
+    res = run_lint(PKG, baseline=REPO / "lint_baseline.json")
+    elapsed = time.monotonic() - t0
+    assert res.ok, (
+        "new findings:\n"
+        + "\n".join(f.render() for f in res.new)
+        + "\nstale baseline entries:\n"
+        + "\n".join(str(e) for e in res.stale)
+    )
+    assert elapsed < 10.0, f"lint took {elapsed:.1f}s (budget: 10s)"
+
+
+def test_cli_exit_codes():
+    """``python -m lightgbm_tpu.lint`` is the CI entry point: exit 0
+    against the committed baseline, exit 1 when the baseline is empty (all
+    19 accepted exceptions become NEW findings)."""
+    ok = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.lint",
+         "--baseline", str(REPO / "lint_baseline.json")],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+
+    empty = REPO / "tests" / "golden"  # any dir; baseline file must not exist
+    bad = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu.lint",
+         "--baseline", str(empty / "no_such_baseline.json"), "--json"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert bad.returncode == 1
+    payload = json.loads(bad.stdout)
+    assert payload["new"], "expected the baselined findings to surface"
+
+
+def test_rule_table_is_complete():
+    """Every rule has a summary and an actionable autofix hint, and the six
+    shipped codes are exactly the documented set."""
+    assert set(RULES) == {f"GL00{i}" for i in range(1, 7)}
+    for code, (summary, hint) in RULES.items():
+        assert summary and hint, code

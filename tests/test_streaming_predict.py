@@ -161,6 +161,30 @@ def test_zero_recompiles_across_batch_sizes(binary_model):
     assert streaming_compile_count() == before
 
 
+def test_stream_compiles_are_labeled_in_telemetry(binary_model):
+    """The streaming executable cache jits through instrumented_jit with a
+    per-variant label, so suspect re-walk ("real"-space) compiles are
+    separable in compile_counts_by_label() — and repeat predicts at warm
+    buckets add ZERO labeled retraces (exact retrace accounting)."""
+    from lightgbm_tpu.obs.jit import compile_count, compile_counts_by_label
+
+    bst, X = binary_model
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    X = np.array(X, copy=True)
+    tree0 = loaded.models_[0]
+    X[11, int(tree0.split_feature[0])] = float(tree0.threshold[0])
+    out1 = loaded.predict(X, pred_chunk_rows=1024)
+    assert loaded.last_predict_stats["path"] == "stream_real"
+    assert compile_counts_by_label().get("predict/stream/real", 0) >= 1
+    # warm repeat: bit-identical output, zero new retraces under ANY label
+    before_labels = compile_counts_by_label()
+    before_total = compile_count()
+    out2 = loaded.predict(X, pred_chunk_rows=1024)
+    assert np.array_equal(out1, out2)
+    assert compile_counts_by_label() == before_labels
+    assert compile_count() == before_total
+
+
 def test_sklearn_route_zero_recompiles():
     """sklearn estimators ride the same bucket-padded path: once warm,
     predict/predict_proba across varying batch sizes never recompile."""

@@ -179,6 +179,41 @@ def test_instrumented_jit_counts_retraces_by_label():
     assert by_label["test/add1"] - before.get("test/add1", 0) == 2
 
 
+def test_compile_counter_is_exact_under_threads():
+    """compile_count()/compile_counts_by_label() take the same lock as
+    note_compile's read-modify-write, so concurrent noters never lose an
+    increment and readers never observe a torn count/label pair."""
+    import threading
+
+    from lightgbm_tpu.obs.jit import (
+        compile_count,
+        compile_counts_by_label,
+        note_compile,
+    )
+
+    n_threads, per_thread = 8, 250
+    before_total = compile_count()
+    before_label = compile_counts_by_label().get("test/threads", 0)
+    barrier = threading.Barrier(n_threads)
+
+    def noter():
+        barrier.wait()
+        for _ in range(per_thread):
+            note_compile("test/threads")
+            assert compile_count() >= 0  # interleave reads with writes
+
+    threads = [threading.Thread(target=noter) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert compile_count() - before_total == n_threads * per_thread
+    assert (
+        compile_counts_by_label()["test/threads"] - before_label
+        == n_threads * per_thread
+    )
+
+
 def test_predict_events_when_enabled():
     X, y = _data(n=500)
     booster = lgb.train(

@@ -13,6 +13,14 @@ export JAX_PLATFORMS=cpu
 export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 
 rc=0
+
+# graftlint gate: pure-ast static analysis (tracer safety + Pallas
+# contracts) diffed against the reviewed baseline.  Runs FIRST and is a
+# hard gate — a new finding or a stale baseline entry fails the suite
+# before any pytest chunk spends time compiling.
+echo "=== graftlint (python -m lightgbm_tpu.lint --baseline lint_baseline.json) ==="
+python -m lightgbm_tpu.lint --baseline lint_baseline.json || rc=$?
+
 chunks=(
   "tests/test_a* tests/test_b* tests/test_c*"
   "tests/test_d* tests/test_e* tests/test_f* tests/test_g* tests/test_h* tests/test_i* tests/test_l*"
