@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from ..config import Config
 from ..dataset import Dataset
 from ..metrics import Metric, create_metric
+from ..obs.collectives import collectives_snapshot, measured_summary
+from ..obs.device import sample_device_memory
 from ..obs.jit import compile_count as _obs_compile_count
 from ..obs.registry import get_session
 from ..objectives import ObjectiveFunction, create_objective
@@ -399,6 +401,8 @@ class Booster:
                 enabled=True,
                 sync_timing=cfg.obs_sync_timing,
                 sink_path=cfg.telemetry_out,
+                device_accounting=cfg.obs_device_accounting,
+                measure_collectives=cfg.obs_collectives,
             )
         self.objective = create_objective(cfg)
         md = train_set.metadata
@@ -942,6 +946,7 @@ class Booster:
                 self._degrade_fused(exc)
                 res = self._grow_one_inner(grad_k, hess_k, mask, feature_mask, rng)
                 ses.sync(res)
+            sample_device_memory("grow")
             return res
 
     def _degrade_fused(self, exc: Exception) -> None:
@@ -1300,6 +1305,11 @@ class Booster:
             grow_fused=grow_fused,
             monotone_penalty=cfg.monotone_penalty,
             use_feature_contri=self._feature_contri is not None,
+            # measured collectives only make sense with a mesh; static so the
+            # toggle retraces (obs/collectives module docstring)
+            measure_collectives=bool(
+                cfg.telemetry and cfg.obs_collectives and self._mesh is not None
+            ),
         )
 
     def _fit_linear_leaves(
@@ -1656,7 +1666,10 @@ class Booster:
             coll = psum_bytes_per_iteration(
                 per_tree,
                 int(self._bins.shape[1]),
-                int(np.asarray(self._num_bins).max(initial=1)),
+                # PADDED bin-axis size: the psum moves the [F, B, 3] padded
+                # histogram, so the measured cross-check only matches with
+                # the same B the trace actually uses
+                int(self._grower_params.max_bin),
                 leaf_batch=int(self.config.leaf_batch),
                 mesh_size=int(self._mesh.devices.size),
             )
@@ -1668,6 +1681,18 @@ class Booster:
                 "collective_ring_bytes_per_device",
                 coll["ring_bytes_per_device"],
             )
+        if self._mesh is not None and self._grower_params.measure_collectives:
+            snap = collectives_snapshot(reset=True)
+            if snap:
+                meas = measured_summary(snap, int(self._mesh.devices.size))
+                event["collective_measured"] = meas
+                ses.set_gauge("collective_measured_bytes", meas["bytes"])
+                ses.set_gauge(
+                    "collective_measured_psum_bytes", meas["psum_bytes"]
+                )
+                ses.set_gauge("collective_measured_wall_ms", meas["wall_ms"])
+                ses.inc("collective_measured_bytes_total", int(meas["bytes"]))
+        sample_device_memory("iteration")
         ses.inc("iterations")
         # deferred: the engine annotates eval metrics into this event before
         # the JSONL line is flushed (next record / flush_pending)
@@ -2052,7 +2077,11 @@ class Booster:
     # =============================================================== predict
     def telemetry(self) -> Dict[str, Any]:
         """Snapshot of the process-global telemetry session: per-iteration
-        events, counters/gauges, and the global jit retrace count."""
+        events, counters/gauges (including the ``cost/*`` / ``memory/*`` /
+        ``collective_measured*`` families — see README "Deep profiling"),
+        and jit retrace counts (global and by label)."""
+        from ..obs.jit import compile_counts_by_label
+
         ses = get_session()
         ses.flush_pending()
         return {
@@ -2061,6 +2090,7 @@ class Booster:
             "counters": dict(ses.counters),
             "gauges": dict(ses.gauges),
             "compile_count": _obs_compile_count(),
+            "compile_counts_by_label": compile_counts_by_label(),
         }
 
     def current_iteration(self) -> int:

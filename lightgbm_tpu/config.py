@@ -388,6 +388,15 @@ class Config:
     telemetry: bool = False
     telemetry_out: str = ""
     obs_sync_timing: bool = False
+    # deep device observability (needs telemetry=True):
+    # obs_device_accounting captures executable cost/memory analysis
+    # (cost/* / memory/* gauges; one extra lower per retraced jit label) and
+    # live HBM watermarks (no-op on backends without memory_stats);
+    # obs_collectives swaps the data-parallel grower's psums for timed
+    # byte-counted wrappers (collective_measured/* — cross-checked against
+    # the analytic parallel.psum_bytes_per_iteration model)
+    obs_device_accounting: bool = False
+    obs_collectives: bool = True
     profile_trace_dir: str = ""
     profile_iter_start: int = 0
     profile_iter_end: int = -1

@@ -17,6 +17,7 @@ from .boosting.gbdt import Booster
 from .callback import CallbackEnv, EarlyStopException, early_stopping, log_evaluation
 from .config import Config
 from .dataset import Dataset
+from .obs.aggregate import global_rollup
 from .obs.profiler import TraceWindow
 from .obs.registry import get_session
 from .utils.log import log_info
@@ -55,6 +56,8 @@ def train(
             enabled=True,
             sync_timing=cfg.obs_sync_timing,
             sink_path=cfg.telemetry_out,
+            device_accounting=cfg.obs_device_accounting,
+            measure_collectives=cfg.obs_collectives,
         )
     trace = (
         TraceWindow(
@@ -201,6 +204,18 @@ def train(
     finally:
         if trace is not None:
             trace.close()
+        if ses.enabled:
+            # multi-host rollup (GlobalSyncUp analog; identity on one
+            # process) and one train_summary event carrying the final
+            # counters/gauges for offline tools (telemetry_summary.py)
+            global_rollup(ses)
+            ses.record(
+                {
+                    "event": "train_summary",
+                    "counters": dict(ses.counters),
+                    "gauges": dict(ses.gauges),
+                }
+            )
         ses.flush_pending()
     booster.best_score = {}
     for item in evaluation_result_list or []:
@@ -210,7 +225,52 @@ def train(
         # per-phase wall summary (reference global_timer at shutdown,
         # utils/common.h:979)
         log_info(global_timer.summary())
+        if ses.enabled:
+            log_info(_deep_obs_summary(ses))
     return booster
+
+
+def _fmt_bytes(v: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024.0 or unit == "GiB":
+            return f"{v:.1f} {unit}" if unit != "B" else f"{v:.0f} B"
+        v /= 1024.0
+    return f"{v:.1f} GiB"
+
+
+def _deep_obs_summary(ses) -> str:
+    """End-of-train deep-observability block next to the GlobalTimer one:
+    peak HBM, analytic vs measured collective bytes, retraces by label."""
+    from .obs.jit import compile_counts_by_label
+
+    lines = ["deep observability:"]
+    peak = ses.gauges.get("memory/hbm_peak_bytes")
+    if peak is not None:
+        lines.append(f"  peak HBM (all local devices): {_fmt_bytes(peak)}")
+    else:
+        lines.append(
+            "  peak HBM: n/a (backend reports no memory_stats, or "
+            "obs_device_accounting off)"
+        )
+    iters = max(1, ses.counters.get("iterations", 1))
+    hist_b = ses.gauges.get("collective_hist_bytes")
+    cnt_b = ses.gauges.get("collective_count_bytes")
+    if hist_b is not None:
+        analytic = (hist_b + (cnt_b or 0.0)) * iters
+        lines.append(
+            f"  collective bytes (analytic model): {_fmt_bytes(analytic)}"
+        )
+    measured = ses.counters.get("collective_measured_bytes_total")
+    if measured is not None:
+        lines.append(f"  collective bytes (measured): {_fmt_bytes(measured)}")
+    by_label = compile_counts_by_label()
+    if by_label:
+        top = sorted(by_label.items(), key=lambda kv: -kv[1])
+        lines.append(
+            "  retraces by label: "
+            + ", ".join(f"{k}={v}" for k, v in top)
+        )
+    return "\n".join(lines)
 
 
 class CVBooster:

@@ -7,22 +7,47 @@ into:
   eval metrics) with an optional JSONL sink — ``registry``;
 * compile accounting — ``instrumented_jit`` counts actual retraces at every
   ``jax.jit`` call site, ``compile_count()`` is the global no-recompile
-  invariant — ``jit``;
+  invariant — and (``obs_device_accounting=True``) executable accounting:
+  ``cost_analysis()``/``memory_analysis()`` of each compiled artifact as
+  ``cost/*`` / ``memory/*`` gauges — ``jit``;
 * collective accounting — the data-parallel grower's psum bytes, modeled
-  analytically (``parallel.psum_bytes_per_iteration``) and recorded as
-  gauges;
+  analytically (``parallel.psum_bytes_per_iteration``) and MEASURED by
+  timed psum/pmax wrappers (``collective_measured/*``) — ``collectives``;
+* live HBM watermarks via ``device.memory_stats()`` at phase boundaries
+  (graceful no-op on backends without allocator stats) — ``device``;
+* per-host aggregation — GlobalSyncUp-style counter/gauge merge plus
+  straggler gauges for multi-host runs — ``aggregate``;
 * ``jax.profiler`` trace capture over an iteration window — ``profiler``.
 
 Enable with ``telemetry=True`` (params/Config), stream to a file with
 ``telemetry_out=<path.jsonl>``, make phase walls measure device time with
-``obs_sync_timing=True``.  See README "Observability".
+``obs_sync_timing=True``, capture executable cost/memory with
+``obs_device_accounting=True``.  See README "Observability".
 """
 
+from .aggregate import (  # noqa: F401
+    global_rollup,
+    host_snapshot,
+    merge_snapshots,
+)
+from .collectives import (  # noqa: F401
+    collectives_snapshot,
+    measured_summary,
+    timed_pmax,
+    timed_pmin,
+    timed_psum,
+)
+from .device import (  # noqa: F401
+    device_memory_supported,
+    sample_device_memory,
+)
 from .jit import (  # noqa: F401
     compile_count,
     compile_counts_by_label,
     instrumented_jit,
     note_compile,
+    note_executable,
+    record_executable,
 )
 from .profiler import TraceWindow  # noqa: F401
 from .registry import (  # noqa: F401
@@ -37,7 +62,19 @@ __all__ = [
     "session_disabled",
     "instrumented_jit",
     "note_compile",
+    "note_executable",
+    "record_executable",
     "compile_count",
     "compile_counts_by_label",
+    "collectives_snapshot",
+    "measured_summary",
+    "timed_psum",
+    "timed_pmax",
+    "timed_pmin",
+    "sample_device_memory",
+    "device_memory_supported",
+    "global_rollup",
+    "host_snapshot",
+    "merge_snapshots",
     "TraceWindow",
 ]

@@ -1,0 +1,126 @@
+"""Per-host telemetry aggregation (multi-host rollup + straggler gauges).
+
+Reference analog: ``Network::GlobalSyncUpByMin/Max/Mean`` (include/LightGBM/
+network.h:169-240) — every machine contributes a scalar, the allreduce hands
+back the min/max/mean.  Here the unit is the whole telemetry session: each
+host snapshots its counters/gauges/iteration walls, the snapshots are
+allgathered (64-bit-safe JSON-over-uint8 ride on
+``parallel.allgather_host_varlen``), and every host derives the identical
+merged view:
+
+* counters merge by SUM (exact — they are event counts/bytes);
+* gauges merge by min/max/mean (``agg/<name>/min|max|mean``);
+* per-host mean iteration walls become straggler gauges
+  (``straggler/iter_wall_ms_max|mean|skew`` — skew = max/mean, the
+  slowest-host multiplier the reference's sync-up would expose).
+
+Single-process runs roll up the local snapshot alone (identity merge), so
+the export schema is the same shape on a laptop and on a pod.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .registry import TelemetrySession, get_session
+
+
+def host_snapshot(ses: Optional[TelemetrySession] = None) -> Dict[str, Any]:
+    """This host's contribution to the rollup."""
+    ses = ses or get_session()
+    iter_walls = [
+        float(e.get("wall_ms", 0.0))
+        for e in ses.events
+        if e.get("event") == "iteration"
+    ]
+    import jax
+
+    return {
+        "process": int(jax.process_index()),
+        "counters": dict(ses.counters),
+        "gauges": dict(ses.gauges),
+        "iter_wall_ms": iter_walls,
+    }
+
+
+def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """GlobalSyncUp-style merge: counters sum; gauges min/max/mean;
+    straggler gauges from per-host mean iteration walls."""
+    counters: Dict[str, int] = {}
+    gauge_vals: Dict[str, List[float]] = {}
+    host_walls: List[float] = []
+    for s in snaps:
+        for name, v in (s.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + int(v)
+        for name, v in (s.get("gauges") or {}).items():
+            gauge_vals.setdefault(name, []).append(float(v))
+        walls = s.get("iter_wall_ms") or []
+        if walls:
+            host_walls.append(float(np.mean(walls)))
+    gauges: Dict[str, float] = {}
+    for name, vals in gauge_vals.items():
+        gauges[f"agg/{name}/min"] = float(min(vals))
+        gauges[f"agg/{name}/max"] = float(max(vals))
+        gauges[f"agg/{name}/mean"] = float(np.mean(vals))
+    straggler: Dict[str, float] = {}
+    if host_walls:
+        mx = float(max(host_walls))
+        mean = float(np.mean(host_walls))
+        straggler["straggler/iter_wall_ms_max"] = mx
+        straggler["straggler/iter_wall_ms_mean"] = mean
+        straggler["straggler/skew"] = mx / mean if mean > 0 else 1.0
+    return {
+        "hosts": len(snaps),
+        "counters": counters,
+        "gauges": gauges,
+        "straggler": straggler,
+    }
+
+
+def _allgather_snapshots(snap: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Exchange JSON snapshots across processes (identity when single)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return [snap]
+    # lazy import breaks the obs <-> parallel cycle (parallel imports
+    # obs.jit at module scope)
+    from ..parallel import allgather_host_varlen
+
+    payload = np.frombuffer(
+        json.dumps(snap, sort_keys=True).encode("utf-8"), dtype=np.uint8
+    )
+    gathered, counts = allgather_host_varlen(payload, return_counts=True)
+    snaps = []
+    off = 0
+    for c in counts:
+        c = int(c)
+        snaps.append(json.loads(bytes(gathered[off : off + c]).decode("utf-8")))
+        off += c
+    return snaps
+
+
+def global_rollup(ses: Optional[TelemetrySession] = None) -> Optional[Dict[str, Any]]:
+    """Merge every host's counters/gauges into this session's export.
+
+    Records one ``host_rollup`` event (JSONL sink included) and folds the
+    merged ``agg/*`` and ``straggler/*`` gauges into the session so
+    ``Booster.telemetry()`` carries the global view.  Never raises —
+    telemetry must not take a training run down at the finish line."""
+    ses = ses or get_session()
+    if not ses.enabled:
+        return None
+    try:
+        snaps = _allgather_snapshots(host_snapshot(ses))
+        merged = merge_snapshots(snaps)
+        for name, v in merged["gauges"].items():
+            ses.set_gauge(name, v)
+        for name, v in merged["straggler"].items():
+            ses.set_gauge(name, v)
+        ses.record({"event": "host_rollup", **merged})
+        return merged
+    except Exception:
+        return None

@@ -1,0 +1,148 @@
+"""Measured collectives: timed psum/pmax wrappers for the grower's sites.
+
+The analytic model (``parallel.psum_bytes_per_iteration``) predicts the
+bytes the data-parallel grower's psums move; this module MEASURES them.
+Each wrapped site stages two tiny host callbacks around the collective:
+
+* ``begin`` reads ``time.perf_counter_ns`` (packed into 2x uint32 — an f32
+  payload loses ns precision) after the operand is ready;
+* ``end`` fires once the collective's result is ready and accumulates
+  ``{calls, bytes, wall_ns}`` per site into a host-side accumulator.
+
+Ordering is by data dependency, not ``ordered=True``: the begin timestamp is
+folded into the operand (``x + 0``) and the end callback consumes both the
+timestamp and a probe of the result, so XLA cannot move either across the
+collective.  Payload bytes come from traced shapes — exact, no host sync.
+
+Per-device semantics: every mesh device executes the callbacks, so the
+accumulator holds ``mesh_size`` times the logical payload; the booster
+divides by the mesh size when it rolls a snapshot into per-iteration
+telemetry (``collective_measured/*``).
+
+``measure`` is a TRACE-TIME flag: it rides in ``GrowerParams`` (a static jit
+argument), so toggling it retraces instead of silently reusing a stale
+trace.  With ``measure=False`` the wrappers compile to the bare collective.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_LOCK = threading.Lock()
+_ACC: Dict[str, Dict[str, float]] = {}  # site -> calls / bytes / wall_ns
+
+_T0_SHAPE = jax.ShapeDtypeStruct((2,), jnp.uint32)
+_TE_SHAPE = jax.ShapeDtypeStruct((), jnp.uint32)
+
+
+def _begin_host(_probe) -> np.ndarray:
+    t = time.perf_counter_ns()
+    return np.array([t >> 32, t & 0xFFFFFFFF], np.uint32)
+
+
+def _end_host(site: str, nbytes: int, t0, _probe) -> np.ndarray:
+    t = time.perf_counter_ns()
+    t0 = np.asarray(t0, np.uint64)
+    start = (int(t0[0]) << 32) | int(t0[1])
+    with _LOCK:
+        acc = _ACC.setdefault(
+            site, {"calls": 0, "bytes": 0, "wall_ns": 0}
+        )
+        acc["calls"] += 1
+        acc["bytes"] += nbytes
+        acc["wall_ns"] += max(0, t - start)
+    return np.uint32(0)
+
+
+def collectives_snapshot(reset: bool = False) -> Dict[str, Dict[str, float]]:
+    """Copy of the per-site accumulator; ``reset=True`` also clears it."""
+    with _LOCK:
+        out = {k: dict(v) for k, v in _ACC.items()}
+        if reset:
+            _ACC.clear()
+    return out
+
+
+def _payload_bytes(leaves) -> int:
+    # no int()/float() on traced values: .size and .dtype.itemsize are
+    # static python ints even on tracers
+    total = 0
+    for l in leaves:
+        total += l.size * l.dtype.itemsize
+    return total
+
+
+def _timed(op, x, axis_name, site: str):
+    from jax.experimental import io_callback
+
+    leaves = jax.tree_util.tree_leaves(x)
+    nbytes = _payload_bytes(leaves)
+    # probe: 1-element slice of the first operand leaf, so `begin` cannot
+    # fire before the operand exists (timestamps bracket the collective)
+    probe = lax.reshape(leaves[0], (leaves[0].size,))[:1]
+    t0 = io_callback(_begin_host, _T0_SHAPE, probe)
+    zero_in = (t0[0] ^ t0[0]).astype(jnp.uint32)  # == 0, depends on t0
+    x = jax.tree_util.tree_map(
+        lambda l: l + zero_in.astype(l.dtype), x
+    )
+    out = op(x, axis_name)
+    out_leaves = jax.tree_util.tree_leaves(out)
+    out_probe = lax.reshape(out_leaves[0], (out_leaves[0].size,))[:1]
+    te = io_callback(
+        functools.partial(_end_host, site, nbytes), _TE_SHAPE, t0, out_probe
+    )
+    zero_out = (te ^ te).astype(jnp.uint32)
+    return jax.tree_util.tree_map(
+        lambda l: l + zero_out.astype(l.dtype), out
+    )
+
+
+def timed_psum(x, axis_name: Optional[str], *, site: str, measure: bool = False):
+    """``lax.psum`` that (when ``measure``) logs wall time and bytes."""
+    if not measure or axis_name is None:
+        return lax.psum(x, axis_name)
+    return _timed(lax.psum, x, axis_name, f"psum/{site}")
+
+
+def timed_pmax(x, axis_name: Optional[str], *, site: str, measure: bool = False):
+    """``lax.pmax`` with the same instrumentation as :func:`timed_psum`."""
+    if not measure or axis_name is None:
+        return lax.pmax(x, axis_name)
+    return _timed(lax.pmax, x, axis_name, f"pmax/{site}")
+
+
+def timed_pmin(x, axis_name: Optional[str], *, site: str, measure: bool = False):
+    """``lax.pmin`` with the same instrumentation as :func:`timed_psum`."""
+    if not measure or axis_name is None:
+        return lax.pmin(x, axis_name)
+    return _timed(lax.pmin, x, axis_name, f"pmin/{site}")
+
+
+def measured_summary(
+    snapshot: Dict[str, Dict[str, float]], mesh_size: int
+) -> Dict[str, float]:
+    """Collapse a per-site snapshot to LOGICAL totals (one device's view).
+
+    Every device runs the callbacks, so calls/bytes divide by the mesh
+    size; wall_ns is averaged the same way (mean across devices)."""
+    d = max(1, int(mesh_size))
+    bytes_total = sum(v["bytes"] for v in snapshot.values())
+    psum_bytes = sum(
+        v["bytes"] for k, v in snapshot.items() if k.startswith("psum/")
+    )
+    calls = sum(v["calls"] for v in snapshot.values())
+    wall_ns = sum(v["wall_ns"] for v in snapshot.values())
+    return {
+        "bytes": bytes_total / d,
+        "psum_bytes": psum_bytes / d,
+        "calls": calls / d,
+        "wall_ms": wall_ns / d / 1e6,
+    }

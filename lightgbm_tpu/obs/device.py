@@ -1,0 +1,66 @@
+"""Live device-memory watermarks via ``device.memory_stats()``.
+
+Sampled at phase boundaries (after grow, at iteration end, after a
+streaming-predict run) when ``obs_device_accounting`` is on.  TPU/GPU
+runtimes report allocator stats (``bytes_in_use`` / ``peak_bytes_in_use``);
+the CPU backend returns ``None`` — the first unsupported probe latches a
+process-global flag so every later call is a single boolean test (the
+documented graceful no-op; see README "Deep profiling").
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .registry import get_session
+
+_SUPPORTED: Optional[bool] = None  # None = not probed yet
+
+
+def sample_device_memory(tag: str = "") -> None:
+    """Record HBM in-use/peak gauges summed over local devices.
+
+    Gauges: ``memory/hbm_bytes_in_use`` (last sample),
+    ``memory/hbm_peak_bytes`` (max-merged watermark) and, with ``tag``,
+    ``memory/hbm_peak_bytes/<tag>`` for the phase-resolved watermark.
+    """
+    ses = get_session()
+    if not (ses.enabled and ses.device_accounting):
+        return
+    global _SUPPORTED
+    if _SUPPORTED is False:
+        return
+    import jax
+
+    in_use = 0
+    peak = 0
+    found = False
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        _SUPPORTED = False
+        return
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        found = True
+        used = int(stats.get("bytes_in_use", 0))
+        in_use += used
+        peak += int(stats.get("peak_bytes_in_use", used))
+    if not found:
+        _SUPPORTED = False
+        return
+    _SUPPORTED = True
+    ses.set_gauge("memory/hbm_bytes_in_use", float(in_use))
+    ses.set_gauge_max("memory/hbm_peak_bytes", float(peak))
+    if tag:
+        ses.set_gauge_max(f"memory/hbm_peak_bytes/{tag}", float(peak))
+
+
+def device_memory_supported() -> Optional[bool]:
+    """Tri-state: True/False once probed, None before the first sample."""
+    return _SUPPORTED

@@ -1,4 +1,5 @@
-"""Compile accounting: count actual XLA retraces across the whole library.
+"""Compile accounting: count actual XLA retraces across the whole library,
+and (opt-in) capture each compiled executable's cost/memory analysis.
 
 ``jax.jit`` only re-invokes the wrapped Python callable on a trace-cache
 miss, so wrapping the function with a counter increment counts retraces
@@ -8,6 +9,21 @@ per lower.  Every ``jax.jit`` call site in the library routes through
 additionally reports each compiled bucket via :func:`note_compile`, so
 ``compile_count()`` is the one process-global number a no-recompile test can
 assert on (generalizing ``predict.streaming_compile_count()``).
+
+Executable accounting (``obs_device_accounting=True``): when a call
+retraces, the wrapper re-lowers with the same concrete arguments and records
+``Compiled.cost_analysis()`` (FLOPs, bytes accessed) and
+``Compiled.memory_analysis()`` (temp/argument/output/generated-code bytes)
+as per-label ``cost/*`` / ``memory/*`` gauges.  The re-lower traces the
+function a second time, which is why this is opt-in; the duplicate trace is
+suppressed from the retrace counters so the no-recompile invariants stay
+exact.  A cache HIT on a label whose analyses are not yet known (it was
+traced before accounting was enabled — an earlier train in the same
+process) triggers the same one-time capture; after that, hits just replay
+the memoized gauge values into the current session, so a session started
+after the traces were made still sees the full cost/memory families.
+Backends whose executables expose neither analysis degrade to a silent
+no-op (absent gauge keys, never an error).
 """
 
 from __future__ import annotations
@@ -18,16 +34,25 @@ from typing import Any, Dict, Optional
 
 import jax
 
+from .registry import get_session
+
 _lock = threading.Lock()
 _count = 0
 _by_label: Dict[str, int] = {}
+# bumped on every counted trace: __call__ compares before/after to detect
+# "this call traced" without touching jax internals
+_epoch = 0
+_tls = threading.local()  # .suppress set during the accounting re-lower
 
 
 def note_compile(label: str = "jit") -> None:
     """Record one trace/compile under ``label``."""
-    global _count
+    global _count, _epoch
+    if getattr(_tls, "suppress", False):
+        return  # accounting re-lower: not a new logical trace
     with _lock:
         _count += 1
+        _epoch += 1
         _by_label[label] = _by_label.get(label, 0) + 1
 
 
@@ -48,8 +73,160 @@ def compile_counts_by_label() -> Dict[str, int]:
         return dict(_by_label)
 
 
+def _trace_epoch() -> int:
+    with _lock:
+        return _epoch
+
+
+# --------------------------------------------------- executable accounting
+_COST_KEYS = (("flops", "flops"), ("bytes accessed", "bytes_accessed"))
+_MEMORY_KEYS = (
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+)
+# analyses survive session resets: a label traced before this session (an
+# earlier train in the same process, a predictor ladder already warm) can
+# replay its recorded gauges into the fresh session without re-lowering
+_seen_executables: Dict[Any, Dict[str, float]] = {}  # (label, id(compiled))
+_label_analyses: Dict[str, Dict[str, float]] = {}  # label -> gauge values
+
+
+def _extract_analyses(label: str, compiled: Any) -> Dict[str, float]:
+    """Pull cost/memory analysis out of a ``Compiled`` as a gauge-name ->
+    value map.  Any backend that raises or returns nothing for an analysis
+    contributes no keys — graceful no-op."""
+    out: Dict[str, float] = {}
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        ca = None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if isinstance(ca, dict):
+        for src, dst in _COST_KEYS:
+            v = ca.get(src)
+            if isinstance(v, (int, float)) and v >= 0:
+                out[f"cost/{label}/{dst}"] = float(v)
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        ma = None
+    if ma is not None:
+        for src, dst in _MEMORY_KEYS:
+            v = getattr(ma, src, None)
+            if isinstance(v, (int, float)) and v >= 0:
+                out[f"memory/{label}/{dst}"] = float(v)
+    return out
+
+
+def record_executable(label: str, compiled: Any) -> None:
+    """Record a ``Compiled``'s cost/memory analysis as per-label gauges.
+
+    Gauges are max-merged: a label compiled at several shapes (ladder
+    buckets, retraces) reports its worst case.
+    """
+    ses = get_session()
+    vals = _extract_analyses(label, compiled)
+    prior = _label_analyses.setdefault(label, {})
+    for name, v in vals.items():
+        prior[name] = max(prior.get(name, 0.0), v)
+        ses.set_gauge_max(name, v)
+
+
+def note_executable(label: str, compiled: Any) -> None:
+    """Record an already-AOT-compiled executable (streaming predictor's
+    bucket ladder).  Analysis runs once per object; repeat cache hits only
+    replay the recorded gauges (so a fresh session still sees them)."""
+    ses = get_session()
+    if not (ses.enabled and ses.device_accounting):
+        return
+    key = (label, id(compiled))
+    vals = _seen_executables.get(key)
+    if vals is None:
+        vals = _extract_analyses(label, compiled)
+        _seen_executables[key] = vals
+        prior = _label_analyses.setdefault(label, {})
+        for name, v in vals.items():
+            prior[name] = max(prior.get(name, 0.0), v)
+    for name, v in vals.items():
+        ses.set_gauge_max(name, v)
+
+
+def _has_tracer(leaves) -> bool:
+    return any(isinstance(l, jax.core.Tracer) for l in leaves)
+
+
+class _InstrumentedJit:
+    """``jax.jit`` wrapper that counts retraces and (opt-in) captures the
+    compiled executable's cost/memory analysis on each trace."""
+
+    def __init__(self, fun, label: str, jit_kwargs: Dict[str, Any]) -> None:
+        self._label = label
+
+        @functools.wraps(fun)
+        def _traced(*args: Any, **kwargs: Any):
+            note_compile(label)
+            return fun(*args, **kwargs)
+
+        self._jit = jax.jit(_traced, **jit_kwargs)
+        # __wrapped__/__name__ flow through so jax's signature inspection
+        # (static_argnames resolution by callers) sees the original function
+        functools.update_wrapper(self, fun)
+
+    def __call__(self, *args: Any, **kwargs: Any):
+        ses = get_session()
+        if not (ses.enabled and ses.device_accounting):
+            return self._jit(*args, **kwargs)
+        before = _trace_epoch()
+        out = self._jit(*args, **kwargs)
+        if _trace_epoch() != before:
+            self._capture(args, kwargs)
+        else:
+            cached = _label_analyses.get(self._label)
+            if cached is None:
+                # cache hit on a trace made before accounting was enabled
+                # (e.g. an earlier train in this process): lower once to
+                # recover the artifact, then the label is cached for good
+                self._capture(args, kwargs)
+            else:
+                for name, v in cached.items():
+                    ses.set_gauge_max(name, v)
+        return out
+
+    def _capture(self, args, kwargs) -> None:
+        """Re-lower with the call's concrete args and record the compiled
+        artifact's analyses.  Never raises: accounting must not break
+        training.  Skipped under an outer trace (tracer args — e.g. a
+        nested jit inside shard_map), where lowering is not meaningful."""
+        try:
+            leaves = jax.tree_util.tree_leaves((args, kwargs))
+            if _has_tracer(leaves):
+                return
+            # memoize the attempt (even an empty result) so a backend whose
+            # executables expose no analyses is not re-lowered on every call
+            _label_analyses.setdefault(self._label, {})
+            _tls.suppress = True
+            try:
+                compiled = self._jit.lower(*args, **kwargs).compile()
+            finally:
+                _tls.suppress = False
+            record_executable(self._label, compiled)
+        except Exception:
+            pass
+
+    def lower(self, *args: Any, **kwargs: Any):
+        return self._jit.lower(*args, **kwargs)
+
+    def __getattr__(self, name: str):
+        # delegate everything else (clear_cache, eval_shape, ...) to the jit
+        return getattr(self._jit, name)
+
+
 def instrumented_jit(fun=None, *, label: Optional[str] = None, **jit_kwargs):
-    """Drop-in ``jax.jit`` that counts retraces.
+    """Drop-in ``jax.jit`` that counts retraces (and, with
+    ``obs_device_accounting``, captures executable cost/memory analysis).
 
     Usable like ``jax.jit``: direct call, decorator, or through
     ``functools.partial``-style keyword binding::
@@ -59,17 +236,8 @@ def instrumented_jit(fun=None, *, label: Optional[str] = None, **jit_kwargs):
         def g(x): ...
         @functools.partial(instrumented_jit, static_argnames=("n",))
         def h(x, n): ...
-
-    ``functools.wraps`` preserves ``__wrapped__`` so jax's signature
-    inspection (static_argnames resolution) sees the original function.
     """
     if fun is None:
         return functools.partial(instrumented_jit, label=label, **jit_kwargs)
     name = label or getattr(fun, "__name__", "jit")
-
-    @functools.wraps(fun)
-    def _traced(*args: Any, **kwargs: Any):
-        note_compile(name)
-        return fun(*args, **kwargs)
-
-    return jax.jit(_traced, **jit_kwargs)
+    return _InstrumentedJit(fun, name, jit_kwargs)

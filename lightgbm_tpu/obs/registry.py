@@ -67,6 +67,12 @@ class TelemetrySession:
     def __init__(self) -> None:
         self.enabled = False
         self.sync_timing = False
+        # deep-device observability knobs (obs_device_accounting /
+        # obs_collectives): executable cost/memory capture costs an extra
+        # trace per jit label, so it is explicit opt-in; measured
+        # collectives ride along whenever telemetry is on
+        self.device_accounting = False
+        self.measure_collectives = False
         self.counters: Dict[str, int] = {}
         self.gauges: Dict[str, float] = {}
         self.events: List[Dict[str, Any]] = []
@@ -82,11 +88,23 @@ class TelemetrySession:
         enabled: bool = True,
         sync_timing: bool = False,
         sink_path: str = "",
+        device_accounting: Optional[bool] = None,
+        measure_collectives: Optional[bool] = None,
     ) -> "TelemetrySession":
         """(Re)configure the session; opens the JSONL sink when given."""
         with self._lock:
             self.enabled = bool(enabled)
             self.sync_timing = bool(sync_timing) and self.enabled
+            if device_accounting is not None:
+                self.device_accounting = bool(device_accounting) and self.enabled
+            elif not self.enabled:
+                self.device_accounting = False
+            if measure_collectives is not None:
+                self.measure_collectives = (
+                    bool(measure_collectives) and self.enabled
+                )
+            elif not self.enabled:
+                self.measure_collectives = False
             if sink_path != self.sink_path or not enabled:
                 self._flush_pending_locked()
                 if self._sink is not None:
@@ -127,6 +145,16 @@ class TelemetrySession:
             return
         with self._lock:
             self.gauges[name] = value
+
+    def set_gauge_max(self, name: str, value: float) -> None:
+        """Monotone-max gauge (HBM watermarks, worst-case executable cost
+        across ladder buckets: re-recording never lowers the reading)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            prev = self.gauges.get(name)
+            if prev is None or value > prev:
+                self.gauges[name] = value
 
     def restore_counters(self, counters: Dict[str, int]) -> None:
         """Merge checkpointed counter values into the live session so a

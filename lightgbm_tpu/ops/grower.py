@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.collectives import timed_pmax, timed_pmin, timed_psum
 from ..obs.jit import instrumented_jit
 from .histogram import leaf_histogram
 from .split import CatParams, SplitCandidate, best_split, leaf_gain, leaf_output
@@ -147,6 +148,10 @@ class GrowerParams:
     monotone_penalty: float = 0.0
     # per-feature gain multipliers arrive via the feature_contri operand
     use_feature_contri: bool = False
+    # measured collectives (obs/collectives): swap every psum/pmax/pmin site
+    # for the timed/byte-counted wrapper.  Static on purpose — toggling it
+    # must retrace, never silently reuse a trace without the callbacks.
+    measure_collectives: bool = False
 
 
 def _hist_caps(n: int, full_range: bool = False) -> list:
@@ -437,7 +442,10 @@ def _candidate_for_leaf(
     )
     # 2) weighted gain (GlobalVoting: gain * leaf_count / mean_num_data) on
     # the local top-k only; pmax is the allgather-of-top-k + per-feature max
-    nsh = lax.psum(jnp.float32(1.0), p.axis_name)
+    nsh = timed_psum(
+        jnp.float32(1.0), p.axis_name, site="counts",
+        measure=p.measure_collectives,
+    )
     w = loc[2] * nsh / jnp.maximum(c, 1.0)
     # gains_f is the per-feature IMPROVEMENT (split.gain in GlobalVoting,
     # voting_parallel_tree_learner.cpp:166) — best_split subtracts its own
@@ -446,12 +454,16 @@ def _candidate_for_leaf(
     wg = jnp.where(jnp.isfinite(gains_f) & (loc[2] > 0), gains_f * w, -jnp.inf)
     kth = lax.top_k(wg, min(p.voting_top_k, f))[0][-1]
     masked = jnp.where(wg >= kth, wg, -jnp.inf)
-    glob = lax.pmax(masked, p.axis_name)
+    glob = timed_pmax(
+        masked, p.axis_name, site="elect", measure=p.measure_collectives
+    )
     # 3) elect top-2k features globally; every shard elects the SAME ids
     _, ids = lax.top_k(glob, min(2 * p.voting_top_k, f))
     # 4) aggregate ONLY the elected slices ([2k, B, 3] over ICI instead of
     # [F, B, 3]) and scan them with GLOBAL parent stats
-    sub = lax.psum(hist[ids], p.axis_name)
+    sub = timed_psum(
+        hist[ids], p.axis_name, site="hist", measure=p.measure_collectives
+    )
     cand = best_split(
         sub, g, h, c, num_bins[ids], nan_bins[ids], feature_mask[ids],
         monotone=monotone[ids] if monotone is not None else None,
@@ -741,17 +753,23 @@ def grow_tree(
             """All-reduce the best candidate across feature shards
             (reference SyncUpGlobalBestSplit, feature_parallel_tree_learner
             .cpp:74 — here a pmax + owner-selected psum broadcast)."""
-            gmax = lax.pmax(cand.gain, p.axis_name)
+            gmax = timed_pmax(
+                cand.gain, p.axis_name, site="elect",
+                measure=p.measure_collectives,
+            )
             idx = lax.axis_index(p.axis_name)
-            owner = lax.pmin(
+            owner = timed_pmin(
                 jnp.where(cand.gain >= gmax, idx, p.feature_shard),
-                p.axis_name,
+                p.axis_name, site="elect", measure=p.measure_collectives,
             )
             mine = (idx == owner) & jnp.isfinite(gmax)
 
             def bc(x):
                 xf = jnp.where(mine, x, jnp.zeros_like(x))
-                return lax.psum(xf, p.axis_name)
+                return timed_psum(
+                    xf, p.axis_name, site="elect",
+                    measure=p.measure_collectives,
+                )
 
             return SplitCandidate(
                 gain=gmax,
@@ -897,7 +915,10 @@ def grow_tree(
                 wide=seg_wide,
             )
             if hist_axis is not None:
-                hist = lax.psum(hist, hist_axis)
+                hist = timed_psum(
+                    hist, hist_axis, site="hist",
+                    measure=p.measure_collectives,
+                )
             return hist
 
         # single-launch fused grow step: partition + smaller-child election +
@@ -945,6 +966,7 @@ def grow_tree(
                     method=p.hist_method,
                     axis_name=hist_axis,
                     quant_scales=quant_scales,
+                    measure=p.measure_collectives,
                 )
 
             return branch
@@ -1041,6 +1063,7 @@ def grow_tree(
                     method=p.hist_method,
                     axis_name=hist_axis,
                     quant_scales=quant_scales,
+                    measure=p.measure_collectives,
                 )
 
             return branch
@@ -1089,17 +1112,24 @@ def grow_tree(
                 bins_loc, grad, hess, count_mask, B,
                 method=p.hist_method,
                 axis_name=hist_axis, quant_scales=quant_scales,
+                measure=p.measure_collectives,
             )
     totals = hist0[0].sum(axis=0)  # every row lands in exactly one bin of feature 0
     if use_voting:
-        totals = lax.psum(totals, p.axis_name)  # global root stats
+        totals = timed_psum(  # global root stats
+            totals, p.axis_name, site="counts",
+            measure=p.measure_collectives,
+        )
     if use_featpar:
         # every shard derives totals from a DIFFERENT local feature's bins:
         # the values agree only up to summation order, and downstream gains
         # must be bit-identical across shards (out_specs declare the tree
         # replicated) — broadcast shard 0's totals
         idx0 = lax.axis_index(p.axis_name) == 0
-        totals = lax.psum(jnp.where(idx0, totals, jnp.zeros_like(totals)), p.axis_name)
+        totals = timed_psum(
+            jnp.where(idx0, totals, jnp.zeros_like(totals)), p.axis_name,
+            site="counts", measure=p.measure_collectives,
+        )
     root_used = jnp.zeros((f,), bool)
     neg_inf_s = jnp.float32(-jnp.inf)
     pos_inf_s = jnp.float32(jnp.inf)
@@ -1230,7 +1260,10 @@ def grow_tree(
             if use_voting:
                 # voting keeps hist_buf LOCAL; a forced split needs the
                 # global row for this one feature (tiny psum)
-                hrow = lax.psum(hrow, p.axis_name)
+                hrow = timed_psum(
+                    hrow, p.axis_name, site="hist",
+                    measure=p.measure_collectives,
+                )
             nbv = nan_bins[f_feat]
             has_nb = nbv >= 0
             nan_s = jnp.where(has_nb, hrow[jnp.maximum(nbv, 0)], 0.0)
@@ -1370,9 +1403,10 @@ def grow_tree(
                     cis.astype(jnp.int32), cmask.astype(jnp.float32),
                 )
                 mine = lax.axis_index(p.axis_name) == owner
-                gl_vec = lax.psum(
+                gl_vec = timed_psum(
                     jnp.where(mine, glv.astype(jnp.float32), 0.0),
-                    p.axis_name,
+                    p.axis_name, site="partition",
+                    measure=p.measure_collectives,
                 )
             with jax.named_scope("partition"):
                 order, nleft, nright = sort_partition(
@@ -1392,8 +1426,12 @@ def grow_tree(
                 )
             if p.axis_name is not None:
                 # global smaller-child choice (see gather-mode comment)
-                left_smaller = lax.psum(nleft, p.axis_name) <= lax.psum(
-                    nright, p.axis_name
+                left_smaller = timed_psum(
+                    nleft, p.axis_name, site="counts",
+                    measure=p.measure_collectives,
+                ) <= timed_psum(
+                    nright, p.axis_name, site="counts",
+                    measure=p.measure_collectives,
                 )
             else:
                 left_smaller = nleft <= nright
@@ -1423,11 +1461,18 @@ def grow_tree(
             if p.axis_name is not None:
                 # global smaller-child choice + pmax'd capacity bucket so
                 # every shard histograms the SAME child (gather-mode comment)
-                nleft_g = lax.psum(nleft, p.axis_name)
-                nright_g = lax.psum(nright, p.axis_name)
+                nleft_g = timed_psum(
+                    nleft, p.axis_name, site="counts",
+                    measure=p.measure_collectives,
+                )
+                nright_g = timed_psum(
+                    nright, p.axis_name, site="counts",
+                    measure=p.measure_collectives,
+                )
                 left_smaller = nleft_g <= nright_g
-                tc = lax.pmax(
-                    jnp.where(left_smaller, nleft, nright), p.axis_name
+                tc = timed_pmax(
+                    jnp.where(left_smaller, nleft, nright), p.axis_name,
+                    site="counts", measure=p.measure_collectives,
                 )
             else:
                 left_smaller = nleft <= nright
@@ -1473,12 +1518,19 @@ def grow_tree(
                 # max over shards of the chosen child's LOCAL rows — which
                 # can exceed local_n/2 on imbalanced shards, hence the
                 # full_range ladder.
-                rows_l_g = lax.psum(rows_l, p.axis_name)
-                rows_r_g = lax.psum(rows_r, p.axis_name)
+                rows_l_g = timed_psum(
+                    rows_l, p.axis_name, site="counts",
+                    measure=p.measure_collectives,
+                )
+                rows_r_g = timed_psum(
+                    rows_r, p.axis_name, site="counts",
+                    measure=p.measure_collectives,
+                )
                 left_smaller = rows_l_g <= rows_r_g
                 target = jnp.where(left_smaller, l, nl)
-                tc = lax.pmax(
-                    jnp.where(left_smaller, rows_l, rows_r), p.axis_name
+                tc = timed_pmax(
+                    jnp.where(left_smaller, rows_l, rows_r), p.axis_name,
+                    site="counts", measure=p.measure_collectives,
                 )
             else:
                 left_smaller = rows_l <= rows_r
@@ -1510,6 +1562,7 @@ def grow_tree(
                     bins_loc, grad, hess, mask, B,
                     method=p.hist_method,
                     axis_name=hist_axis, quant_scales=quant_scales,
+                    measure=p.measure_collectives,
                 )
 
         def _set1(arr, idx, val):
@@ -2047,8 +2100,9 @@ def grow_tree(
                     wide=seg_wide,
                 )
             if p.axis_name is not None:
-                cnts_g = lax.psum(
-                    jnp.stack([nleft_k, nright_k], axis=1), p.axis_name
+                cnts_g = timed_psum(
+                    jnp.stack([nleft_k, nright_k], axis=1), p.axis_name,
+                    site="counts", measure=p.measure_collectives,
                 )
                 left_smaller_k = cnts_g[:, 0] <= cnts_g[:, 1]
             else:
@@ -2068,7 +2122,10 @@ def grow_tree(
                     wide=seg_wide,
                 )
             if hist_axis is not None:
-                sm_k = lax.psum(sm_k, hist_axis)
+                sm_k = timed_psum(
+                    sm_k, hist_axis, site="hist",
+                    measure=p.measure_collectives,
+                )
         elif use_ordered:
             begin_k = st.leaf_begin[l_k]
             cnt_k = jnp.where(active_k, st.leaf_nrows[l_k], 0)
@@ -2091,12 +2148,14 @@ def grow_tree(
                 nleft_k = jnp.stack(nleft_list)
             nright_k = cnt_k - nleft_k
             if p.axis_name is not None:
-                cnts_g = lax.psum(
-                    jnp.stack([nleft_k, nright_k], axis=1), p.axis_name
+                cnts_g = timed_psum(
+                    jnp.stack([nleft_k, nright_k], axis=1), p.axis_name,
+                    site="counts", measure=p.measure_collectives,
                 )
                 left_smaller_k = cnts_g[:, 0] <= cnts_g[:, 1]
-                tc_k = lax.pmax(
-                    jnp.where(left_smaller_k, nleft_k, nright_k), p.axis_name
+                tc_k = timed_pmax(
+                    jnp.where(left_smaller_k, nleft_k, nright_k), p.axis_name,
+                    site="counts", measure=p.measure_collectives,
                 )
             else:
                 left_smaller_k = nleft_k <= nright_k
@@ -2120,7 +2179,10 @@ def grow_tree(
                     )
                 sm_k = jnp.stack(sm_list)
             if hist_axis is not None:
-                sm_k = lax.psum(sm_k, hist_axis)
+                sm_k = timed_psum(
+                    sm_k, hist_axis, site="hist",
+                    measure=p.measure_collectives,
+                )
         else:
             # gather / full: row membership per member, leaf_id writes
             # deferred to the commit decision below
@@ -2152,13 +2214,15 @@ def grow_tree(
                     jnp.sum(in_leaf_k, axis=1).astype(jnp.int32) - rows_l_k
                 )
                 if p.axis_name is not None:
-                    cnts_g = lax.psum(
-                        jnp.stack([rows_l_k, rows_r_k], axis=1), p.axis_name
+                    cnts_g = timed_psum(
+                        jnp.stack([rows_l_k, rows_r_k], axis=1), p.axis_name,
+                        site="counts", measure=p.measure_collectives,
                     )
                     left_smaller_k = cnts_g[:, 0] <= cnts_g[:, 1]
-                    tc_k = lax.pmax(
+                    tc_k = timed_pmax(
                         jnp.where(left_smaller_k, rows_l_k, rows_r_k),
-                        p.axis_name,
+                        p.axis_name, site="counts",
+                        measure=p.measure_collectives,
                     )
                 else:
                     left_smaller_k = rows_l_k <= rows_r_k
@@ -2194,7 +2258,10 @@ def grow_tree(
                         )
                     )(mask_k)
             if hist_axis is not None:
-                sm_k = lax.psum(sm_k, hist_axis)
+                sm_k = timed_psum(
+                    sm_k, hist_axis, site="hist",
+                    measure=p.measure_collectives,
+                )
 
         with jax.named_scope("bookkeeping"):
             # ---- sibling histograms by subtraction, per pair

@@ -24,6 +24,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..obs.collectives import timed_psum
+
 
 def leaf_histogram_segment(
     bins: jnp.ndarray,  # [N, F] int32 bin indices
@@ -97,12 +99,15 @@ def leaf_histogram(
     method: str = "auto",
     axis_name: Optional[str] = None,
     quant_scales=None,  # (g_scale, h_scale) for the pallas_int8 methods
+    measure: bool = False,  # timed-psum instrumentation (obs/collectives)
 ) -> jnp.ndarray:
     """Dispatch histogram impl; psum across the data mesh axis if given.
 
     The psum is the TPU-native replacement for the reference's histogram
     ReduceScatter (src/treelearner/data_parallel_tree_learner.cpp:286, XLA
     collective over ICI instead of hand-rolled TCP recursive-halving).
+    ``measure`` (static, from ``GrowerParams.measure_collectives``) swaps
+    the bare psum for the timed/byte-counted wrapper.
     """
     if method == "auto":
         # Dispatch on the LOWERING platform, not the process-global default
@@ -129,7 +134,7 @@ def leaf_histogram(
                 default=functools.partial(leaf_histogram_segment, num_bins=num_bins),
             )
         if axis_name is not None:
-            hist = jax.lax.psum(hist, axis_name)
+            hist = timed_psum(hist, axis_name, site="hist", measure=measure)
         return hist
     if method == "pallas":
         from .pallas.histogram import histogram_pallas
@@ -161,5 +166,5 @@ def leaf_histogram(
     else:
         raise ValueError(f"unknown histogram method {method!r}")
     if axis_name is not None:
-        hist = jax.lax.psum(hist, axis_name)
+        hist = timed_psum(hist, axis_name, site="hist", measure=measure)
     return hist

@@ -68,6 +68,12 @@ def psum_bytes_per_iteration(
 
     ``ring_bytes_per_device`` scales the summed payload by the ring
     all-reduce factor ``2 * (D - 1) / D``.
+
+    The timed-psum wrappers (obs/collectives, ``obs_collectives=True``)
+    MEASURE the same traffic at runtime; tests/test_observability.py asserts
+    the measured psum bytes land within 10% of ``hist_bytes + count_bytes``
+    on an 8-device dryrun, and tools/perf_gate.py freezes both sides in the
+    committed perf contract.
     """
     f, b, k = int(n_features), int(num_bins), max(1, int(leaf_batch))
     splits = max(0, int(n_splits))

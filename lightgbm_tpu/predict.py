@@ -30,7 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .obs.jit import instrumented_jit
+from .obs.device import sample_device_memory
+from .obs.jit import instrumented_jit, note_executable
 from .obs.registry import get_session
 from .tree import (
     K_CATEGORICAL_MASK,
@@ -560,6 +561,9 @@ class StreamingPredictor:
         )
         hit = _EXEC_CACHE.get(key)
         if hit is not None:
+            # device_accounting may have turned on after the miss that
+            # compiled this bucket; note_executable dedups per object
+            note_executable(f"predict/stream/{variant}", hit)
             return hit
         impl = {
             ("packed", "value"): _packed_bins_pertree_impl,
@@ -604,6 +608,7 @@ class StreamingPredictor:
         compiled = fn.lower(*avals).compile()
         _EXEC_CACHE[key] = compiled
         _COMPILE_COUNT += 1
+        note_executable(f"predict/stream/{variant}", compiled)
         return compiled
 
     def warmup(
@@ -819,6 +824,7 @@ class StreamingPredictor:
         stats["host_ms"] += (time.perf_counter() - t_h) * 1e3
         stats["compiles"] = _COMPILE_COUNT - compiles_before
         self.last_stats = stats
+        sample_device_memory("predict")
         if ses.enabled:
             ses.inc("predict_chunks", stats["chunks"])
             ses.record({

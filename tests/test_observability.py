@@ -1,5 +1,8 @@
 """Logging redirect + per-phase timer (reference: utils/log.h:90 callback
-redirect / python register_logger basic.py:160; global_timer common.h:979)."""
+redirect / python register_logger basic.py:160; global_timer common.h:979),
+plus deep device observability: per-host aggregation (GlobalSyncUp analog,
+network.h:169-240), straggler gauges, and the measured-vs-analytic
+collective-byte cross-check on the 8-virtual-device mesh."""
 
 import numpy as np
 import pytest
@@ -7,6 +10,7 @@ import pytest
 jax = pytest.importorskip("jax")
 
 import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.obs.registry import get_session  # noqa: E402
 
 
 class _Capture:
@@ -55,6 +59,162 @@ def test_unregister_logger_restores_stdout(capsys):
     log_info("back to stdout")
     assert "back to stdout" in capsys.readouterr().out
     assert cap.infos == []
+
+
+# ----------------------------------------------------- per-host aggregation
+def test_merge_snapshots_counters_sum_gauges_minmaxmean():
+    """The GlobalSyncUp-style merge is EXACT: counters sum, gauges
+    min/max/mean, straggler gauges from per-host mean iteration walls."""
+    from lightgbm_tpu.obs.aggregate import merge_snapshots
+
+    snaps = [
+        {
+            "process": 0,
+            "counters": {"iterations": 5, "splits": 30},
+            "gauges": {"bagging_rows": 100.0},
+            "iter_wall_ms": [10.0, 10.0],
+        },
+        {
+            "process": 1,
+            "counters": {"iterations": 5, "degradations": 1},
+            "gauges": {"bagging_rows": 200.0},
+            "iter_wall_ms": [30.0, 30.0],
+        },
+        {
+            "process": 2,
+            "counters": {"iterations": 5},
+            "gauges": {"bagging_rows": 150.0},
+            "iter_wall_ms": [20.0, 20.0],
+        },
+    ]
+    merged = merge_snapshots(snaps)
+    assert merged["hosts"] == 3
+    # counters: exact SUM
+    assert merged["counters"] == {
+        "iterations": 15,
+        "splits": 30,
+        "degradations": 1,
+    }
+    # gauges: min / max / mean
+    assert merged["gauges"]["agg/bagging_rows/min"] == 100.0
+    assert merged["gauges"]["agg/bagging_rows/max"] == 200.0
+    assert merged["gauges"]["agg/bagging_rows/mean"] == pytest.approx(150.0)
+    # straggler: max / mean of per-host mean walls, skew = max/mean
+    s = merged["straggler"]
+    assert s["straggler/iter_wall_ms_max"] == 30.0
+    assert s["straggler/iter_wall_ms_mean"] == pytest.approx(20.0)
+    assert s["straggler/skew"] == pytest.approx(1.5)
+
+
+def test_global_rollup_single_process_folds_gauges():
+    ses = get_session().configure(enabled=True)
+    ses.reset()
+    try:
+        ses.inc("iterations", 3)
+        ses.set_gauge("bagging_rows", 123.0)
+        for wall in (11.0, 12.0, 13.0):
+            ses.record({"event": "iteration", "wall_ms": wall})
+        from lightgbm_tpu.obs.aggregate import global_rollup
+
+        merged = global_rollup(ses)
+        assert merged is not None and merged["hosts"] == 1
+        # single host: min == max == mean == the local value
+        for stat in ("min", "max", "mean"):
+            assert ses.gauges[f"agg/bagging_rows/{stat}"] == 123.0
+        assert ses.gauges["straggler/iter_wall_ms_max"] == pytest.approx(12.0)
+        assert ses.gauges["straggler/skew"] == pytest.approx(1.0)
+        assert any(e["event"] == "host_rollup" for e in ses.events)
+    finally:
+        ses.configure(enabled=False)
+        ses.reset()
+
+
+# --------------------------------------- measured collectives (8-device mesh)
+def test_measured_psum_bytes_match_analytic_8dev(cpu_mesh_devices):
+    """tree_learner=data dryrun on the 8-virtual-device mesh: the timed-psum
+    wrappers' measured byte count lands within 10% of the analytic
+    psum_bytes_per_iteration model (ISSUE 9 acceptance), and the per-host
+    rollup + straggler gauges ride on the same run."""
+    ses = get_session()
+    ses.configure(enabled=False)
+    ses.reset()
+    rng = np.random.default_rng(3)
+    X = rng.random((512, 10)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.random(512)).astype(np.float32)
+    params = {
+        "objective": "regression",
+        "num_leaves": 7,
+        "verbosity": -1,
+        "tree_learner": "data",
+        "telemetry": True,
+    }
+    try:
+        booster = lgb.train(params, lgb.Dataset(X, y, params=params), 3)
+        if booster._mesh is None:
+            pytest.skip("data-parallel mesh not formed")
+        tel = booster.telemetry()
+        iters = [e for e in tel["events"] if e["event"] == "iteration"]
+        assert all("collective_measured" in e for e in iters), (
+            "measured-collective snapshots missing from iteration events"
+        )
+        analytic = sum(
+            e["collective"]["hist_bytes"] + e["collective"]["count_bytes"]
+            for e in iters
+        )
+        measured = sum(
+            e["collective_measured"]["psum_bytes"] for e in iters
+        )
+        assert measured == pytest.approx(analytic, rel=0.10)
+        # wall time is measured (soft signal, but must be present + sane)
+        assert all(
+            e["collective_measured"]["wall_ms"] >= 0 for e in iters
+        )
+        assert tel["gauges"]["collective_measured_psum_bytes"] > 0
+        assert tel["counters"]["collective_measured_bytes_total"] > 0
+        # per-host rollup ran at end-of-train: counters merged exactly
+        # (single process: agg == local), straggler gauges present
+        rollups = [e for e in tel["events"] if e["event"] == "host_rollup"]
+        assert len(rollups) == 1 and rollups[0]["hosts"] == 1
+        assert (
+            rollups[0]["counters"]["iterations"]
+            == tel["counters"]["iterations"]
+        )
+        assert tel["gauges"]["straggler/skew"] >= 1.0
+        assert tel["gauges"]["straggler/iter_wall_ms_max"] > 0
+    finally:
+        ses.configure(enabled=False)
+        ses.reset()
+
+
+def test_obs_collectives_off_keeps_bare_psum(cpu_mesh_devices):
+    """obs_collectives=false compiles the bare psum: no measured events."""
+    ses = get_session()
+    ses.configure(enabled=False)
+    ses.reset()
+    rng = np.random.default_rng(4)
+    X = rng.random((512, 6)).astype(np.float32)
+    y = X[:, 0].astype(np.float32)
+    params = {
+        "objective": "regression",
+        "num_leaves": 7,
+        "verbosity": -1,
+        "tree_learner": "data",
+        "telemetry": True,
+        "obs_collectives": False,
+    }
+    try:
+        booster = lgb.train(params, lgb.Dataset(X, y, params=params), 2)
+        if booster._mesh is None:
+            pytest.skip("data-parallel mesh not formed")
+        iters = [
+            e
+            for e in booster.telemetry()["events"]
+            if e["event"] == "iteration"
+        ]
+        assert iters and all("collective_measured" not in e for e in iters)
+    finally:
+        ses.configure(enabled=False)
+        ses.reset()
 
 
 def test_global_timer_records_phases(capsys):

@@ -1,0 +1,218 @@
+"""Perf-contract gate (tools/perf_gate.py): the deterministic-telemetry diff
+that tools/run_tests.sh runs as a hard CI gate.
+
+The gate's check logic is exercised via --replay-style metric dicts (no jax,
+no scenario runs): an injected retrace/collective regression must FAIL the
+gate, while wall-time drift only WARNS — the hard/soft split that keeps the
+gate deterministic."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+_GATE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools",
+    "perf_gate.py",
+)
+
+
+@pytest.fixture(scope="module")
+def gate():
+    spec = importlib.util.spec_from_file_location("perf_gate", _GATE_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+BASE_METRICS = {
+    "retrace/serial/grow_tree": 1.0,
+    "retrace/serial/predict/stream/packed": 1.0,
+    "retrace/data_parallel/parallel/sharded_grow": 1.0,
+    "collective/analytic_bytes": 161448.0,
+    "collective/measured_psum_bytes": 161424.0,
+    "cost/grow_tree/flops": 181986.0,
+    "memory/grow_tree/temp_bytes": 76640.0,
+    "wall/serial_train_s": 4.1,
+}
+
+
+def test_policy_hard_soft_split(gate):
+    assert gate.policy_for("retrace/serial/grow_tree") == (True, 0.0, 0.0)
+    assert gate.policy_for("collective/analytic_bytes") == (True, 0.0, 0.0)
+    hard, tol_rel, _ = gate.policy_for("cost/grow_tree/flops")
+    assert hard and tol_rel > 0
+    assert gate.policy_for("wall/serial_train_s")[0] is False
+
+
+def test_identical_metrics_pass(gate):
+    contract = gate.build_contract(BASE_METRICS, None, "init")
+    failures, warnings = gate.check(dict(BASE_METRICS), contract)
+    assert failures == 0 and warnings == 0
+
+
+def test_injected_retrace_regression_fails(gate, capsys):
+    """A retrace storm (one extra trace of a hot label) must fail HARD."""
+    contract = gate.build_contract(BASE_METRICS, None, "init")
+    bad = dict(BASE_METRICS)
+    bad["retrace/serial/grow_tree"] = 2.0  # regression: retraces per call
+    failures, _ = gate.check(bad, contract)
+    assert failures == 1
+    assert "retrace/serial/grow_tree" in capsys.readouterr().out
+
+
+def test_injected_collective_regression_fails(gate):
+    """Analytic psum bytes growing (someone widened a collective) fails."""
+    contract = gate.build_contract(BASE_METRICS, None, "init")
+    bad = dict(BASE_METRICS)
+    bad["collective/analytic_bytes"] *= 2
+    failures, _ = gate.check(bad, contract)
+    assert failures == 1
+
+
+def test_cost_tolerance_band(gate):
+    """cost/* metrics tolerate small XLA-version wobble but fail on jumps."""
+    contract = gate.build_contract(BASE_METRICS, None, "init")
+    drift = dict(BASE_METRICS)
+    drift["cost/grow_tree/flops"] *= 1.05  # inside the 10% band
+    assert gate.check(drift, contract)[0] == 0
+    jump = dict(BASE_METRICS)
+    jump["cost/grow_tree/flops"] *= 1.5
+    assert gate.check(jump, contract)[0] == 1
+
+
+def test_wall_time_drift_warns_only(gate, capsys):
+    contract = gate.build_contract(BASE_METRICS, None, "init")
+    slow = dict(BASE_METRICS)
+    # far outside even the generous soft band (tol_abs 50 + 50% rel)
+    slow["wall/serial_train_s"] *= 100
+    failures, warnings = gate.check(slow, contract)
+    assert failures == 0 and warnings == 1
+    assert "WARN" in capsys.readouterr().out
+
+
+def test_missing_hard_metric_fails_missing_soft_passes(gate):
+    contract = gate.build_contract(BASE_METRICS, None, "init")
+    partial = {
+        k: v for k, v in BASE_METRICS.items() if k != "cost/grow_tree/flops"
+    }
+    assert gate.check(partial, contract)[0] == 1
+    no_wall = {
+        k: v for k, v in BASE_METRICS.items() if k != "wall/serial_train_s"
+    }
+    assert gate.check(no_wall, contract)[0] == 0
+
+
+def test_new_metric_warns_until_frozen(gate):
+    contract = gate.build_contract(BASE_METRICS, None, "init")
+    extra = dict(BASE_METRICS)
+    extra["retrace/serial/new_label"] = 1.0
+    failures, warnings = gate.check(extra, contract)
+    assert failures == 0 and warnings == 1
+
+
+def test_main_replay_roundtrip(gate, tmp_path):
+    """End-to-end CLI flow on a replay dump: --update creates the contract,
+    a clean re-check passes, an injected regression exits non-zero."""
+    metrics_path = str(tmp_path / "metrics.json")
+    contract_path = str(tmp_path / "contract.json")
+    with open(metrics_path, "w") as fp:
+        json.dump(BASE_METRICS, fp)
+    assert (
+        gate.main(
+            ["--replay", metrics_path, "--contract", contract_path, "--update"]
+        )
+        == 0
+    )
+    assert os.path.exists(contract_path)
+    assert (
+        gate.main(["--replay", metrics_path, "--contract", contract_path])
+        == 0
+    )
+    bad = dict(BASE_METRICS)
+    bad["collective/measured_psum_bytes"] *= 3
+    bad_path = str(tmp_path / "bad.json")
+    with open(bad_path, "w") as fp:
+        json.dump(bad, fp)
+    assert (
+        gate.main(["--replay", bad_path, "--contract", contract_path]) == 1
+    )
+
+
+def test_update_requires_justification_on_change(gate, tmp_path):
+    metrics_path = str(tmp_path / "metrics.json")
+    contract_path = str(tmp_path / "contract.json")
+    with open(metrics_path, "w") as fp:
+        json.dump(BASE_METRICS, fp)
+    gate.main(
+        ["--replay", metrics_path, "--contract", contract_path, "--update"]
+    )
+    changed = dict(BASE_METRICS)
+    changed["cost/grow_tree/flops"] *= 2
+    changed_path = str(tmp_path / "changed.json")
+    with open(changed_path, "w") as fp:
+        json.dump(changed, fp)
+    # changed metrics without --justify: refused (exit 2), contract intact
+    assert (
+        gate.main(
+            ["--replay", changed_path, "--contract", contract_path, "--update"]
+        )
+        == 2
+    )
+    before = json.load(open(contract_path))
+    assert (
+        before["metrics"]["cost/grow_tree/flops"]["value"]
+        == BASE_METRICS["cost/grow_tree/flops"]
+    )
+    # with --justify the accepted drift lands with its audit line
+    assert (
+        gate.main(
+            [
+                "--replay",
+                changed_path,
+                "--contract",
+                contract_path,
+                "--update",
+                "--justify",
+                "grower rewrite doubled fused FLOPs intentionally",
+            ]
+        )
+        == 0
+    )
+    after = json.load(open(contract_path))
+    entry = after["metrics"]["cost/grow_tree/flops"]
+    assert entry["value"] == changed["cost/grow_tree/flops"]
+    assert "intentionally" in entry["justification"]
+
+
+def test_missing_contract_is_an_error(gate, tmp_path):
+    metrics_path = str(tmp_path / "metrics.json")
+    with open(metrics_path, "w") as fp:
+        json.dump(BASE_METRICS, fp)
+    rc = gate.main(
+        [
+            "--replay",
+            metrics_path,
+            "--contract",
+            str(tmp_path / "nope.json"),
+        ]
+    )
+    assert rc == 2
+
+
+def test_committed_contract_exists_and_is_wellformed(gate):
+    """tools/perf_contract.json is committed and every metric entry has the
+    gate's schema (run_tests.sh depends on it)."""
+    contract = gate.load_contract(gate.DEFAULT_CONTRACT)
+    assert contract is not None, "tools/perf_contract.json missing"
+    assert contract["version"] == 1
+    metrics = contract["metrics"]
+    assert metrics, "empty contract"
+    for name, entry in metrics.items():
+        assert {"value", "hard", "tol_rel", "tol_abs"} <= set(entry)
+        assert entry["justification"]
+    # the contract covers every hard family the gate collects
+    prefixes = {n.split("/")[0] for n in metrics}
+    assert {"retrace", "collective", "cost", "memory"} <= prefixes

@@ -67,10 +67,16 @@ def test_iteration_event_schema_and_jsonl(tmp_path):
     assert {"gradients", "sample", "grow"} <= all_phases
     assert tel["counters"]["iterations"] == 5
     assert tel["compile_count"] > 0
-    # one JSONL line per iteration, eval metrics annotated into the line
+    # one JSONL line per iteration, eval metrics annotated into the line;
+    # train() appends the end-of-train host_rollup + train_summary records
     lines = [json.loads(l) for l in open(sink)]
-    assert [l["event"] for l in lines] == ["iteration"] * 5
+    kinds = [l["event"] for l in lines]
+    assert kinds[:5] == ["iteration"] * 5
+    assert kinds[5:] == ["host_rollup", "train_summary"]
     assert any("eval" in l and "t/l2" in l["eval"] for l in lines)
+    summary = lines[-1]
+    assert summary["counters"]["iterations"] == 5
+    assert isinstance(summary["gauges"], dict)
 
 
 def test_telemetry_callback_collects_history():
@@ -276,6 +282,80 @@ def test_collective_gauges_under_data_parallel():
     assert coll["hist_bytes"] > 0 and coll["steps"] > 0
     assert tel["gauges"]["collective_hist_bytes"] == coll["hist_bytes"]
     assert tel["gauges"]["collective_ring_bytes_per_device"] >= 0
+
+
+# --------------------------------------------- executable accounting (cost/*)
+def test_cost_memory_gauges_train_and_predict(tmp_path):
+    """obs_device_accounting captures executable cost/memory analysis for
+    BOTH the training grower and the streaming predictor, and the families
+    round-trip through the JSONL sink's train_summary record."""
+    X, y = _data(n=500)
+    sink = str(tmp_path / "events.jsonl")
+    params = {
+        "objective": "regression",
+        "num_leaves": 7,
+        "verbosity": -1,
+        "telemetry": True,
+        "telemetry_out": sink,
+        "obs_device_accounting": True,
+    }
+    booster = lgb.train(params, lgb.Dataset(X, y), 3)
+    booster.predict(X)
+    gauges = booster.telemetry()["gauges"]
+    # train: the grower's jit label carries FLOPs and the full memory family
+    assert gauges["cost/grow_tree/flops"] > 0
+    assert gauges["cost/grow_tree/bytes_accessed"] > 0
+    assert gauges["memory/grow_tree/temp_bytes"] > 0
+    assert gauges["memory/grow_tree/argument_bytes"] > 0
+    assert gauges["memory/grow_tree/output_bytes"] > 0
+    # streaming predict: per-variant label (packed/stacked/real)
+    pred_cost = [
+        k for k in gauges if k.startswith("cost/predict/stream/")
+    ]
+    assert pred_cost, f"no predict cost gauges in {sorted(gauges)}"
+    assert all(gauges[k] >= 0 for k in pred_cost)
+    # JSONL round-trip: the train_summary line carries the gauge families
+    lines = [json.loads(l) for l in open(sink)]
+    summary = [l for l in lines if l["event"] == "train_summary"][-1]
+    assert summary["gauges"]["cost/grow_tree/flops"] == pytest.approx(
+        gauges["cost/grow_tree/flops"]
+    )
+    assert "memory/grow_tree/temp_bytes" in summary["gauges"]
+
+
+def test_device_accounting_off_means_no_cost_gauges():
+    X, y = _data()
+    booster = lgb.train(
+        {
+            "objective": "regression",
+            "num_leaves": 7,
+            "verbosity": -1,
+            "telemetry": True,
+        },
+        lgb.Dataset(X, y),
+        2,
+    )
+    gauges = booster.telemetry()["gauges"]
+    assert not [k for k in gauges if k.startswith(("cost/", "memory/"))]
+
+
+def test_device_memory_graceful_noop_on_unsupported_backend():
+    """CPU devices report no memory_stats: sampling must silently no-op
+    (latching the unsupported probe) instead of erroring or emitting
+    garbage gauges."""
+    from lightgbm_tpu.obs import device as obs_device
+
+    ses = get_session().configure(enabled=True, device_accounting=True)
+    obs_device.sample_device_memory("test")
+    supported = obs_device.device_memory_supported()
+    has_stats = any(
+        d.memory_stats() for d in jax.local_devices()
+    )
+    assert supported is has_stats or (supported is None)
+    if not has_stats:
+        assert not [
+            k for k in ses.gauges if k.startswith("memory/hbm_")
+        ]
 
 
 # -------------------------------------------------------------- profiler glue

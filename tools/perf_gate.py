@@ -1,0 +1,344 @@
+"""Deterministic perf-contract gate.
+
+Collects the DETERMINISTIC slice of the telemetry surface from three fixed
+scenarios (serial train + streaming predict with executable accounting; an
+8-virtual-device ``tree_learner=data`` dryrun with measured collectives) and
+diffs it against the committed contract ``tools/perf_contract.json``:
+
+* ``retrace/*``          jit trace counts by label        — HARD, tolerance 0
+* ``collective/analytic_*`` modeled psum bytes            — HARD, tolerance 0
+* ``collective/measured_*`` timed-wrapper psum bytes      — HARD, small rel tol
+* ``cost/*``             executable FLOPs / bytes accessed — HARD, rel tol
+* ``memory/*``           executable temp/output bytes      — HARD, rel tol
+* ``wall/*``             scenario wall times               — SOFT, warn only
+
+A failing hard metric means a real perf-shape regression (a retrace storm, a
+collective that grew, an executable whose footprint jumped) — not noise: all
+hard metrics are shape/trace-derived, so reruns on one machine agree exactly
+(within the stated tolerance for XLA-version wobble on cost/memory).
+
+Usage:
+    python tools/perf_gate.py                      # collect + check
+    python tools/perf_gate.py --update --justify "why each change is OK"
+    python tools/perf_gate.py --out metrics.json   # also dump collected
+    python tools/perf_gate.py --replay metrics.json  # check a prior dump
+                                                     # (no jax needed)
+
+``--update`` rewrites the contract; every metric whose value changed (or is
+new) records the ``--justify`` line, so the contract file carries the audit
+trail of accepted drifts.  Wired as a hard gate in tools/run_tests.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_CONTRACT = os.path.join(REPO_ROOT, "tools", "perf_contract.json")
+
+# metric-kind policy: (hard, tol_rel, tol_abs) chosen by name prefix.  Order
+# matters: first match wins.
+_POLICIES: Tuple[Tuple[str, Tuple[bool, float, float]], ...] = (
+    ("retrace/", (True, 0.0, 0.0)),
+    ("collective/analytic_", (True, 0.0, 0.0)),
+    # measured bytes are shape-exact per call; the small slack absorbs an
+    # extra scalar psum if a trace-level refactor adds/removes one
+    ("collective/measured_", (True, 0.05, 64.0)),
+    ("cost/", (True, 0.10, 0.0)),
+    ("memory/", (True, 0.25, 0.0)),
+    ("wall/", (False, 0.5, 50.0)),
+)
+
+
+def policy_for(name: str) -> Tuple[bool, float, float]:
+    for prefix, pol in _POLICIES:
+        if name.startswith(prefix):
+            return pol
+    return (True, 0.0, 0.0)
+
+
+# ---------------------------------------------------------------- scenarios
+def _env_for_collect() -> None:
+    """Pin the jax environment BEFORE the first import: CPU platform, an
+    8-device virtual mesh (same flags as tests/conftest.py), persistent
+    compile cache (compile caching never changes trace counts)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def collect() -> Dict[str, float]:
+    """Run the fixed scenarios and return the metric map."""
+    import time
+
+    _env_for_collect()
+    import numpy as np
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    if REPO_ROOT not in sys.path:  # `python tools/perf_gate.py` from anywhere
+        sys.path.insert(0, REPO_ROOT)
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs.jit import compile_counts_by_label
+    from lightgbm_tpu.obs.registry import get_session
+
+    metrics: Dict[str, float] = {}
+    rng = np.random.RandomState(7)
+    X = rng.rand(512, 10).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.rand(512)).astype(np.float32)
+
+    base = {
+        "objective": "regression",
+        "num_leaves": 7,
+        "max_bin": 63,
+        "min_data_in_leaf": 5,
+        "learning_rate": 0.2,
+        "verbosity": -1,
+        "telemetry": True,
+        "deterministic": True,
+        "seed": 11,
+    }
+
+    # -- scenario 1: serial train + streaming predict, device accounting on
+    ses = get_session()
+    ses.reset()
+    labels_before = compile_counts_by_label()
+    t0 = time.perf_counter()
+    booster = lgb.train(
+        {**base, "obs_device_accounting": True},
+        lgb.Dataset(X, label=y, params=base),
+        num_boost_round=3,
+    )
+    booster.predict(X)
+    metrics["wall/serial_train_s"] = round(time.perf_counter() - t0, 3)
+    labels_after = compile_counts_by_label()
+    for label, count in sorted(labels_after.items()):
+        delta = count - labels_before.get(label, 0)
+        if delta:
+            metrics[f"retrace/serial/{label}"] = float(delta)
+    tel = booster.telemetry()
+    for name, value in sorted(tel["gauges"].items()):
+        # executable accounting: FLOPs + temp footprint per jit label (the
+        # other cost/memory keys ride in telemetry but would double the
+        # contract surface without adding signal)
+        if name.startswith("cost/") and name.endswith("/flops"):
+            metrics[name] = float(value)
+        if name.startswith("memory/") and name.endswith("/temp_bytes"):
+            metrics[name] = float(value)
+
+    # -- scenario 2: 8-device data-parallel dryrun, measured collectives
+    ndev = len(jax.devices("cpu"))
+    if ndev >= 8:
+        ses.reset()
+        labels_before = compile_counts_by_label()
+        t0 = time.perf_counter()
+        lgb.train(
+            {**base, "tree_learner": "data"},
+            lgb.Dataset(X, label=y, params=base),
+            num_boost_round=3,
+        )
+        metrics["wall/data_parallel_train_s"] = round(
+            time.perf_counter() - t0, 3
+        )
+        labels_after = compile_counts_by_label()
+        for label, count in sorted(labels_after.items()):
+            delta = count - labels_before.get(label, 0)
+            if delta:
+                metrics[f"retrace/data_parallel/{label}"] = float(delta)
+        iters = [
+            e for e in ses.events if e.get("event") == "iteration"
+        ]
+        analytic = sum(
+            float(e["collective"]["hist_bytes"])
+            + float(e["collective"]["count_bytes"])
+            for e in iters
+            if "collective" in e
+        )
+        measured = sum(
+            float(e["collective_measured"]["psum_bytes"])
+            for e in iters
+            if "collective_measured" in e
+        )
+        if analytic:
+            metrics["collective/analytic_bytes"] = analytic
+        if measured:
+            metrics["collective/measured_psum_bytes"] = round(measured, 1)
+    else:  # pragma: no cover - CI always has the virtual mesh
+        print(
+            f"perf_gate: only {ndev} cpu devices; skipping the "
+            "data-parallel scenario",
+            file=sys.stderr,
+        )
+    ses.reset()
+    return metrics
+
+
+# ------------------------------------------------------------ contract I/O
+def load_contract(path: str) -> Optional[Dict[str, Any]]:
+    if not os.path.exists(path):
+        return None
+    with open(path) as fp:
+        return json.load(fp)
+
+
+def build_contract(
+    metrics: Dict[str, float],
+    prior: Optional[Dict[str, Any]],
+    justify: str,
+) -> Dict[str, Any]:
+    """New contract from collected metrics; changed/new metrics carry the
+    justification line, unchanged ones keep their prior one."""
+    out: Dict[str, Any] = {"version": 1, "metrics": {}}
+    prior_metrics = (prior or {}).get("metrics", {})
+    for name, value in sorted(metrics.items()):
+        hard, tol_rel, tol_abs = policy_for(name)
+        entry: Dict[str, Any] = {
+            "value": value,
+            "hard": hard,
+            "tol_rel": tol_rel,
+            "tol_abs": tol_abs,
+        }
+        old = prior_metrics.get(name)
+        if old is not None and float(old.get("value", math.nan)) == value:
+            if old.get("justification"):
+                entry["justification"] = old["justification"]
+        else:
+            entry["justification"] = justify
+        out["metrics"][name] = entry
+    return out
+
+
+def check(
+    metrics: Dict[str, float], contract: Dict[str, Any]
+) -> Tuple[int, int]:
+    """Diff metrics against the contract; prints findings.  Returns
+    (hard_failures, warnings)."""
+    failures = warnings = 0
+    cmetrics = contract.get("metrics", {})
+    for name, entry in sorted(cmetrics.items()):
+        expect = float(entry["value"])
+        hard = bool(entry.get("hard", policy_for(name)[0]))
+        tol_rel = float(entry.get("tol_rel", 0.0))
+        tol_abs = float(entry.get("tol_abs", 0.0))
+        got = metrics.get(name)
+        if got is None:
+            if name.startswith("wall/") or not hard:
+                continue
+            print(f"FAIL {name}: expected {expect}, metric missing")
+            failures += 1
+            continue
+        tol = tol_abs + tol_rel * abs(expect)
+        if abs(got - expect) <= tol:
+            continue
+        line = (
+            f"{name}: expected {expect} ±{tol:g}, got {got} "
+            f"(drift {got - expect:+g})"
+        )
+        if hard:
+            print(f"FAIL {line}")
+            failures += 1
+        else:
+            print(f"WARN {line}")
+            warnings += 1
+    for name in sorted(set(metrics) - set(cmetrics)):
+        if policy_for(name)[0]:
+            print(
+                f"WARN {name}: not in contract (value {metrics[name]}); "
+                "run --update to freeze it"
+            )
+            warnings += 1
+    return failures, warnings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="deterministic perf-contract gate"
+    )
+    ap.add_argument("--contract", default=DEFAULT_CONTRACT)
+    ap.add_argument(
+        "--update",
+        action="store_true",
+        help="rewrite the contract from collected metrics",
+    )
+    ap.add_argument(
+        "--justify",
+        default="",
+        help="justification recorded on every changed metric (--update)",
+    )
+    ap.add_argument(
+        "--out", default="", help="also dump collected metrics to this path"
+    )
+    ap.add_argument(
+        "--replay",
+        default="",
+        help="check a prior metrics dump instead of running the scenarios",
+    )
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        with open(args.replay) as fp:
+            metrics = {k: float(v) for k, v in json.load(fp).items()}
+    else:
+        metrics = collect()
+    if args.out:
+        with open(args.out, "w") as fp:
+            json.dump(metrics, fp, indent=2, sort_keys=True)
+            fp.write("\n")
+
+    contract = load_contract(args.contract)
+    if args.update:
+        if contract is not None and not args.justify:
+            changed = [
+                n
+                for n, e in contract.get("metrics", {}).items()
+                if metrics.get(n) is not None
+                and float(e["value"]) != metrics[n]
+            ] + [n for n in metrics if n not in contract.get("metrics", {})]
+            if changed:
+                print(
+                    "perf_gate: --update with changed metrics needs "
+                    f"--justify (changed: {', '.join(sorted(changed)[:8])})",
+                    file=sys.stderr,
+                )
+                return 2
+        new = build_contract(
+            metrics, contract, args.justify or "initial contract"
+        )
+        with open(args.contract, "w") as fp:
+            json.dump(new, fp, indent=2, sort_keys=True)
+            fp.write("\n")
+        print(
+            f"perf_gate: wrote {args.contract} "
+            f"({len(new['metrics'])} metrics)"
+        )
+        return 0
+
+    if contract is None:
+        print(
+            f"perf_gate: no contract at {args.contract}; run with --update "
+            "to create it",
+            file=sys.stderr,
+        )
+        return 2
+    failures, warnings = check(metrics, contract)
+    print(
+        f"perf_gate: {len(contract.get('metrics', {}))} contract metrics, "
+        f"{failures} hard failure(s), {warnings} warning(s)"
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

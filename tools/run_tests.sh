@@ -55,6 +55,14 @@ lgb.train(
 PYEOF
 rm -f "$tel_out"
 
+# perf-contract gate: collect the deterministic telemetry slice (retraces
+# by label, analytic+measured collective bytes, executable FLOPs/temp HBM)
+# and diff it against the committed contract.  HARD gate — any drift in a
+# hard metric fails the suite; wall times only warn.  Accepted changes are
+# committed via  python tools/perf_gate.py --update --justify "<why>".
+echo "=== perf-contract gate (tools/perf_gate.py vs tools/perf_contract.json) ==="
+python tools/perf_gate.py || rc=$?
+
 # fused grow-step smoke: run the Pallas kernel itself (interpret mode,
 # JAX_PLATFORMS=cpu) through a 3-iteration train and require structural
 # parity with the XLA oracle.  A fresh process matters: grow_step._INTERPRET

@@ -4,10 +4,12 @@ Usage:
     python tools/telemetry_summary.py events.jsonl [more.jsonl ...]
     python -m lightgbm_tpu ... telemetry=true telemetry_out=events.jsonl
 
-Prints one human block per file: iteration count, wall/phase means, compile
-deltas, collective-byte totals, plus predict-event rollups when present.
-Exits non-zero on empty or unparseable input so CI smoke checks can gate on
-it (tools/run_tests.sh runs a 3-iteration train through this).
+Prints one human block per file: iteration count, wall/phase means with
+p50/p99 percentiles, compile deltas, collective-byte totals (analytic and
+measured), cost/memory gauge columns from the train_summary event, plus
+predict-event rollups when present.  Exits non-zero on empty or unparseable
+input so CI smoke checks can gate on it (tools/run_tests.sh runs a
+3-iteration train through this).
 """
 
 from __future__ import annotations
@@ -16,6 +18,15 @@ import json
 import sys
 from collections import defaultdict
 from typing import Any, Dict, List
+
+
+def _percentile(vals: List[float], q: float) -> float:
+    """Nearest-rank percentile (no numpy dependency for offline use)."""
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[idx]
 
 
 def load_events(path: str) -> List[Dict[str, Any]]:
@@ -39,16 +50,27 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     out: Dict[str, Any] = {"events": len(events)}
     if iters:
         phase_tot: Dict[str, float] = defaultdict(float)
+        phase_vals: Dict[str, List[float]] = defaultdict(list)
         for e in iters:
             for k, v in (e.get("phases") or {}).items():
                 phase_tot[k] += float(v)
+                phase_vals[k].append(float(v))
         n = len(iters)
         out["iterations"] = n
-        out["wall_ms_mean"] = round(
-            sum(float(e.get("wall_ms", 0.0)) for e in iters) / n, 2
-        )
+        walls = [float(e.get("wall_ms", 0.0)) for e in iters]
+        out["wall_ms_mean"] = round(sum(walls) / n, 2)
+        out["wall_ms_p50"] = round(_percentile(walls, 50), 2)
+        out["wall_ms_p99"] = round(_percentile(walls, 99), 2)
         out["phases_ms_mean"] = {
             k: round(v / n, 2) for k, v in sorted(phase_tot.items())
+        }
+        out["phases_ms_p50"] = {
+            k: round(_percentile(v, 50), 2)
+            for k, v in sorted(phase_vals.items())
+        }
+        out["phases_ms_p99"] = {
+            k: round(_percentile(v, 99), 2)
+            for k, v in sorted(phase_vals.items())
         }
         out["compiles_total"] = sum(
             int(e.get("compiles_delta", 0)) for e in iters
@@ -63,9 +85,39 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
                 k: round(sum(float(c[k]) for c in colls))
                 for k in ("hist_bytes", "count_bytes", "ring_bytes_per_device")
             }
+        meas = [
+            e["collective_measured"]
+            for e in iters
+            if "collective_measured" in e
+        ]
+        if meas:
+            out["collective_measured_total"] = {
+                k: round(sum(float(m.get(k, 0.0)) for m in meas), 2)
+                for k in ("bytes", "psum_bytes", "calls", "wall_ms")
+            }
         evals = [e["eval"] for e in iters if "eval" in e]
         if evals:
             out["final_eval"] = evals[-1]
+    summaries = [e for e in events if e.get("event") == "train_summary"]
+    if summaries:
+        gauges = summaries[-1].get("gauges") or {}
+        cost = {
+            k: v
+            for k, v in sorted(gauges.items())
+            if k.startswith(("cost/", "memory/"))
+        }
+        if cost:
+            out["cost_memory_gauges"] = cost
+        straggler = {
+            k: round(float(v), 3)
+            for k, v in sorted(gauges.items())
+            if k.startswith("straggler/")
+        }
+        if straggler:
+            out["straggler"] = straggler
+    rollups = [e for e in events if e.get("event") == "host_rollup"]
+    if rollups:
+        out["hosts"] = rollups[-1].get("hosts")
     if preds:
         out["predict_runs"] = len(preds)
         out["predict_rows"] = sum(int(e.get("rows", 0)) for e in preds)
