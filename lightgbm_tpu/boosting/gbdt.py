@@ -912,6 +912,7 @@ class Booster:
             cfg.lambda_l1,
             cfg.lambda_l2,
             cfg.max_delta_step,
+            measure=self._grower_params.measure_collectives,
         )
         return ta._replace(leaf_value=lv)
 

@@ -1,11 +1,15 @@
-"""graftlint — tracer-safety & Pallas-contract static analysis.
+"""graftlint — tracer-safety, Pallas-contract & SPMD static analysis.
 
 Purpose-built for this JAX/Pallas codebase: the rule set encodes the bug
 classes previous PRs paid for at runtime (interpret-mode aliased-ref
 reads, bare-jit retrace-accounting holes, accept-and-ignore config
-params) so they become build-time errors instead.  Run it as
+params) plus the silent multi-host SPMD classes the mesh refactor risks
+(one-sided collectives, mismatched axis names, host-divergent gates) so
+they become build-time errors instead.  Run it as
 
     python -m lightgbm_tpu.lint [--baseline lint_baseline.json] [paths...]
+    python -m lightgbm_tpu.lint --changed-only   # dev-loop fast mode
+    python -m lightgbm_tpu.lint --json           # incl. per-rule timings
 
 or through the pytest gate (tests/test_lint.py) and the hard CI gate at
 the top of tools/run_tests.sh.  Rules:
@@ -18,7 +22,22 @@ GL004  weak-typed float constant closed over by a jitted function
 GL005  ``pallas_call`` contract: block tiling, index_map arity,
        out_shape/out_specs consistency
 GL006  Config field declared in config.py but never read
+GL007  collective congruence: raw ``jax.lax`` collective outside
+       obs/collectives.py, or a psum/pmax/pmin/all_gather reached on
+       only one branch of a non-trace-static ``if`` / ``lax.cond``
+GL008  axis-name consistency: mixed axis-name sources in one jitted
+       region, or a collective reachable with ``axis_name=None``
+GL009  retrace hazards: scalar-annotated jit params outside
+       ``static_argnames``, callbacks without ``ordered=True``
+GL010  host-divergent value (process_index / time / os.environ /
+       unseeded RNG) gating a branch that executes a collective
 =====  ==============================================================
+
+GL007–GL010 share one SPMD index (``callgraph.SpmdIndex``): a
+path-sensitive walk of every function scope under "all replicas execute
+this together" semantics, with guards derived from the axis-name family
+or a jit entry's ``static_argnames`` treated as trace-static (replica-
+uniform by the static-argument contract).
 
 Per-line suppression: ``# graftlint: disable=GL001`` (comma-separated
 codes, or bare ``disable`` for all).  Intentional exceptions live in

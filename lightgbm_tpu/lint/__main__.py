@@ -20,6 +20,32 @@ PKG_ROOT = Path(__file__).resolve().parents[1]  # the lightgbm_tpu package
 REPO_ROOT = PKG_ROOT.parent
 
 
+def _git_changed_files():
+    """Repo-root-relative paths git sees as modified (vs HEAD) or
+    untracked; None when git is unavailable or this is not a checkout."""
+    import subprocess
+
+    out = []
+    for cmd in (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                cmd,
+                cwd=REPO_ROOT,
+                capture_output=True,
+                text=True,
+                timeout=10,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.extend(l.strip() for l in proc.stdout.splitlines() if l.strip())
+    return sorted(set(out))
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m lightgbm_tpu.lint",
@@ -51,6 +77,15 @@ def main(argv=None) -> int:
         "--json", action="store_true", help="machine-readable output"
     )
     parser.add_argument(
+        "--changed-only",
+        action="store_true",
+        help="dev-loop fast mode: report only findings in files git sees "
+        "as changed (staged, unstaged, or untracked); the whole package "
+        "is still analyzed so the call graph stays complete, and stale "
+        "detection is restricted to the same files — CI keeps the "
+        "full-tree gate",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print the rule table"
     )
     args = parser.parse_args(argv)
@@ -65,9 +100,34 @@ def main(argv=None) -> int:
         cand = REPO_ROOT / "lint_baseline.json"
         baseline = cand if cand.exists() else None
 
+    only_paths = list(args.paths)
+    if args.changed_only:
+        changed = _git_changed_files()
+        if changed is None:
+            print(
+                "graftlint: --changed-only needs a git checkout; "
+                "falling back to the full tree",
+                file=sys.stderr,
+            )
+        else:
+            pkg_prefix = PKG_ROOT.name + "/"
+            changed = [
+                c for c in changed
+                if c.endswith(".py") and c.startswith(pkg_prefix)
+            ]
+            if not changed:
+                print(
+                    "graftlint: no changed python files under "
+                    f"{pkg_prefix} — nothing to report"
+                )
+                return 0
+            only_paths.extend(changed)
+
     t0 = time.monotonic()
-    result = run_lint(PKG_ROOT, baseline=baseline, only_paths=args.paths)
+    c0 = time.process_time()
+    result = run_lint(PKG_ROOT, baseline=baseline, only_paths=only_paths)
     elapsed = time.monotonic() - t0
+    cpu = time.process_time() - c0
 
     if args.write_baseline is not None:
         write_baseline(args.write_baseline, result.findings)
@@ -85,6 +145,11 @@ def main(argv=None) -> int:
                     "baselined": len(result.findings) - len(result.new),
                     "stale": result.stale,
                     "elapsed_s": round(elapsed, 3),
+                    "cpu_s": round(cpu, 3),
+                    "rule_timings_s": {
+                        code: round(t, 4)
+                        for code, t in sorted(result.timings.items())
+                    },
                 },
                 indent=2,
             )

@@ -16,14 +16,30 @@ Taint model (deliberately simple, biased against false positives):
   targets; a call result is tainted iff any argument is tainted;
 * taint flows into in-package callees positionally/by keyword, computed to
   a fixpoint over (function, tainted-param-set) pairs — the "conservative
-  intra-package call graph" of GL003.  ``*args``/``**kwargs`` forwarding
-  and aliasing through containers are NOT modeled: an un-modeled flow can
-  only lose taint, i.e. miss a finding, never invent one.
+  intra-package call graph" of GL003;
+* ``*args``/``**kwargs`` forwarding is modeled coarsely: a tainted splat
+  taints every remaining positional slot (plus the callee's ``*args``), a
+  tainted ``**mapping`` taints every keyword-bindable parameter (plus the
+  callee's ``**kwargs``) — over-approximate at the forwarding site, which
+  is the right bias for GL003/GL010 taint.  Aliasing through containers is
+  still NOT modeled: an un-modeled flow can only lose taint, i.e. miss a
+  finding, never invent one.
+
+The SPMD layer (:class:`SpmdIndex`, rules_spmd.py) adds a path-sensitive
+abstract walk under "all replicas execute this together" semantics: every
+function scope is analyzed with the stack of guards dominating each
+``psum``/``pmax``/``pmin``/``all_gather`` site (including guards inherited
+from a nested function's definition site and ``if not guard: return``
+early-return dominators), an *axis-derived* name family that marks guards
+as trace-static, and depth-bounded collective summaries of branches and
+callees for congruence checks.
 """
 
 from __future__ import annotations
 
 import ast
+import dataclasses
+from collections import Counter
 from typing import Callable, Dict, FrozenSet, List, Optional, Set, Tuple
 
 from .core import Module, Project, call_kwargs, const_names, names_in
@@ -90,7 +106,9 @@ def jit_entries(
                 if target is None:
                     continue
                 fn = project.function(*target)
-                if fn is None:
+                if fn is None or fn.name in _JIT_NAMES:
+                    # the instrumented_jit wrapper forwards itself through
+                    # functools.partial — the wrapper is not an entry
                     continue
                 names = const_names(
                     call_kwargs(node).get("static_argnames", ast.Tuple(elts=[]))
@@ -163,10 +181,17 @@ class TaintWalker:
         project: Project,
         visit: Callable[[str, ast.FunctionDef, Set[str], ast.AST], None],
         max_depth: int = 12,
+        taint_attr_bases: bool = True,
     ):
         self.project = project
         self.visit = visit
         self.max_depth = max_depth
+        # ``obj.field = tainted`` taints ``obj`` itself when True — the
+        # right bias for GL003 (a tracer stored on self stays a tracer).
+        # GL010 turns it off: host-setup code stores dozens of unrelated
+        # attributes on self/config, and one divergent store must not mark
+        # every later ``self.x`` gate as divergent.
+        self.taint_attr_bases = taint_attr_bases
         self._seen: Set[Tuple[int, FrozenSet[str]]] = set()
 
     def walk(
@@ -199,6 +224,10 @@ class TaintWalker:
                     continue
                 if set(names_in(value)) & tainted:
                     for t in targets:
+                        if not self.taint_attr_bases and not isinstance(
+                            t, (ast.Name, ast.Tuple, ast.List, ast.Starred)
+                        ):
+                            continue
                         for n in ast.walk(t):
                             if isinstance(n, ast.Name):
                                 tainted.add(n.id)
@@ -224,14 +253,708 @@ class TaintWalker:
         if fn is None:
             return
         params = positional_params(fn)
+        kwonly = {a.arg for a in fn.args.kwonlyargs}
         flowing: Set[str] = set()
-        for i, arg in enumerate(call.args):
+        pos = 0
+        for arg in call.args:
             if isinstance(arg, ast.Starred):
-                break
-            if i < len(params) and set(names_in(arg)) & tainted:
-                flowing.add(params[i])
+                # *seq forwarding: the splat's length is unknown, so a
+                # tainted splat may land in ANY remaining positional slot
+                # (and the callee's own *args); either way positional
+                # matching cannot continue past it
+                if set(names_in(arg.value)) & tainted:
+                    flowing.update(params[pos:])
+                    if fn.args.vararg:
+                        flowing.add(fn.args.vararg.arg)
+                pos = len(params)
+                continue
+            if set(names_in(arg)) & tainted:
+                if pos < len(params):
+                    flowing.add(params[pos])
+                elif fn.args.vararg:
+                    flowing.add(fn.args.vararg.arg)  # positional overflow
+            pos += 1
         for kw in call.keywords:
-            if kw.arg and set(names_in(kw.value)) & tainted:
-                flowing.add(kw.arg)
+            if kw.arg is None:
+                # **mapping forwarding: a tainted mapping may bind any
+                # keyword-addressable parameter (and the callee's **kwargs)
+                if set(names_in(kw.value)) & tainted:
+                    flowing.update(params)
+                    flowing.update(kwonly)
+                    if fn.args.kwarg:
+                        flowing.add(fn.args.kwarg.arg)
+                continue
+            if set(names_in(kw.value)) & tainted:
+                if kw.arg in params or kw.arg in kwonly:
+                    flowing.add(kw.arg)
+                elif fn.args.kwarg:
+                    flowing.add(fn.args.kwarg.arg)
         if flowing:
             self.walk(target[0], fn, frozenset(flowing), depth + 1)
+
+
+# ----------------------------------------------------------------- SPMD model
+# Collectives the SPMD rules reason about: the raw jax.lax spellings plus
+# the obs/collectives timed wrappers (the sanctioned sites).  Host-level
+# gathers only participate in GL010 divergence checks (include_host=True).
+_COLLECTIVE_KINDS = {"psum", "pmax", "pmin", "all_gather"}
+_TIMED_TO_KIND = {
+    "timed_psum": "psum",
+    "timed_pmax": "pmax",
+    "timed_pmin": "pmin",
+}
+_HOST_GATHERS = {
+    "process_allgather",
+    "allgather_host_varlen",
+    "allgather_host_exact",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardInfo:
+    """One conditional dominating a site.  ``axis=True`` means the test
+    mentions the axis-name family — such tests are trace-static (the axis
+    name rides in static jit args), so every replica agrees on them."""
+
+    test_src: str
+    axis: bool
+
+
+@dataclasses.dataclass
+class CollectiveSite:
+    kind: str  # psum | pmax | pmin | all_gather
+    raw: bool  # spelled jax.lax.*, not an obs/collectives timed wrapper
+    node: ast.Call
+    axis_expr: Optional[ast.AST]
+    axis_key: Tuple  # ("param", name) | ("literal", v) | ("none",) | ("unknown",)
+    guards: Tuple[GuardInfo, ...]  # outermost-first, incl. def-site inherited
+
+    @property
+    def axis_guarded(self) -> bool:
+        return any(g.axis for g in self.guards)
+
+
+@dataclasses.dataclass
+class CondSite:
+    """A ``lax.cond``/``lax.switch`` call — runtime branching on a traced
+    predicate, where one-sided collectives deadlock for real."""
+
+    node: ast.Call
+    is_switch: bool
+    guards: Tuple[GuardInfo, ...]
+
+
+@dataclasses.dataclass
+class CallbackSite:
+    node: ast.Call
+    name: str  # io_callback | pure_callback
+    ordered: bool
+
+
+@dataclasses.dataclass
+class IfSite:
+    """A Python-level ``if`` recorded for congruence checking.  When the
+    body return-terminates with no ``orelse``, ``sibling`` holds the
+    continuation statements (the code dominated by ``not test``)."""
+
+    node: ast.If
+    guards: Tuple[GuardInfo, ...]
+    sibling: Optional[List[ast.stmt]]
+
+
+@dataclasses.dataclass
+class SpmdScope:
+    """One function (or module) body analyzed as an SPMD scope."""
+
+    rel: str  # module path relative to the package root
+    mod: Module
+    node: Optional[ast.AST]  # FunctionDef | AsyncFunctionDef | None (module)
+    qualname: str
+    parent: Optional["SpmdScope"]
+    guards_at_def: Tuple[GuardInfo, ...] = ()
+    axis_derived: Set[str] = dataclasses.field(default_factory=set)
+    # names derived from a jit entry's static_argnames (replica-uniform by
+    # the static-argument contract) — guards over them are trace-static
+    static_derived: Set[str] = dataclasses.field(default_factory=set)
+    children: Dict[str, "SpmdScope"] = dataclasses.field(default_factory=dict)
+    sites: List[CollectiveSite] = dataclasses.field(default_factory=list)
+    conds: List[CondSite] = dataclasses.field(default_factory=list)
+    callbacks: List[CallbackSite] = dataclasses.field(default_factory=list)
+    ifs: List[IfSite] = dataclasses.field(default_factory=list)
+
+
+def _walk_no_defs(node: ast.AST):
+    """ast.walk that does not descend into nested function/class bodies
+    (lambdas ARE descended — their body executes in the enclosing trace)."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            stack.append(child)
+
+
+def _test_src(test: ast.AST, limit: int = 60) -> str:
+    """Stable textual key for a guard/if test (no line numbers)."""
+    try:
+        src = ast.unparse(test)
+    except Exception:  # pragma: no cover - unparse handles all exprs
+        src = type(test).__name__
+    src = " ".join(src.split())
+    return src[:limit]
+
+
+class SpmdIndex:
+    """Path-sensitive SPMD model of every function scope in the package.
+
+    Built once per :class:`Project` and shared by the GL007–GL010 rules:
+
+    * every collective site with its dominating guard stack (Python ``if``
+      guards, ``while`` guards, ``if not X: return`` early-return
+      dominators, and guards inherited from a nested def's definition
+      site) and a normalized axis-name source key;
+    * the *axis-derived* name family per scope: names whose value is
+      computed from the axis name (``use_featpar = ... p.axis_name ...``,
+      ``hist_axis = None if ... else p.axis_name``, ``voting_active(p, f)``
+      whose body reads axis_name).  Guards over this family are
+      trace-static, hence replica-uniform;
+    * ``lax.cond``/``lax.switch`` sites and ``io_callback``/
+      ``pure_callback`` sites;
+    * depth-bounded collective summaries of statement blocks and callees
+      (multisets of ``(kind, axis_key)``), with axis-argument
+      specialization so ``leaf_histogram(..., axis_name=None)`` correctly
+      contributes no collectives.
+    """
+
+    def __init__(self, project: Project):
+        self.project = project
+        self.scopes: List[SpmdScope] = []
+        self.by_func: Dict[int, SpmdScope] = {}
+        self.site_by_node: Dict[int, CollectiveSite] = {}
+        self._fn_axis_cache: Dict[int, bool] = {}
+        self._summary_cache: Dict[Tuple, Counter] = {}
+        self._static_params: Dict[int, FrozenSet[str]] = {}
+        for _rel, _mod, fn, statics in jit_entries(project):
+            self._static_params[id(fn)] = statics
+        for rel, mod in project.modules.items():
+            root = SpmdScope(
+                rel=rel, mod=mod, node=None, qualname="<module>", parent=None
+            )
+            self.scopes.append(root)
+            self._build(root, mod.tree.body)
+
+    # ------------------------------------------------------------- building
+    def _build(self, scope: SpmdScope, body: List[ast.stmt]) -> None:
+        self._compute_axis_derived(scope, body)
+        if scope.node is not None:
+            self.by_func[id(scope.node)] = scope
+        self._walk_block(scope, body, ())
+
+    def _compute_axis_derived(
+        self, scope: SpmdScope, body: List[ast.stmt]
+    ) -> None:
+        derived = set(scope.parent.axis_derived) if scope.parent else set()
+        fn = scope.node
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+                if a.arg == "axis_name":
+                    derived.add(a.arg)
+        scope.axis_derived = derived
+        statics = set(
+            scope.parent.static_derived if scope.parent else set()
+        )
+        if fn is not None:
+            statics |= set(self._static_params.get(id(fn), ()))
+        scope.static_derived = statics
+        for _ in range(2):  # two passes: assignments can forward-reference
+            before = len(derived) + len(statics)
+            for st in body:
+                for node in _walk_no_defs(st):
+                    value = None
+                    targets: List[ast.AST] = []
+                    if isinstance(node, ast.Assign):
+                        targets, value = node.targets, node.value
+                    elif isinstance(node, ast.AnnAssign):
+                        targets, value = [node.target], node.value
+                    if value is None:
+                        continue
+                    axis_hit = self._mentions_axis(scope, value)
+                    static_hit = statics and (
+                        set(names_in(value)) & statics
+                    )
+                    if not axis_hit and not static_hit:
+                        continue
+                    for t in targets:
+                        for n in ast.walk(t):
+                            if isinstance(n, ast.Name):
+                                if axis_hit:
+                                    derived.add(n.id)
+                                if static_hit:
+                                    statics.add(n.id)
+            if len(derived) + len(statics) == before:
+                break
+
+    def _fn_mentions_axis(self, fn: ast.FunctionDef) -> bool:
+        cached = self._fn_axis_cache.get(id(fn))
+        if cached is not None:
+            return cached
+        hit = False
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Attribute) and n.attr == "axis_name":
+                hit = True
+                break
+            if isinstance(n, ast.Name) and n.id == "axis_name":
+                hit = True
+                break
+            if isinstance(n, ast.arg) and n.arg == "axis_name":
+                hit = True
+                break
+        self._fn_axis_cache[id(fn)] = hit
+        return hit
+
+    def _mentions_axis(self, scope: SpmdScope, expr: ast.AST) -> bool:
+        """Does this expression depend on the axis-name family?  Direct
+        ``.axis_name`` access, an axis-derived name, or a call into an
+        in-package function whose body reads the axis name."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) and n.attr == "axis_name":
+                return True
+            if isinstance(n, ast.Name) and (
+                n.id == "axis_name" or n.id in scope.axis_derived
+            ):
+                return True
+            if isinstance(n, ast.Call):
+                target = self.project.internal_callee(
+                    scope.mod, scope.rel, n.func
+                )
+                if target is not None:
+                    fn = self.project.function(*target)
+                    if fn is not None and self._fn_mentions_axis(fn):
+                        return True
+        return False
+
+    def trace_static_test(self, scope: SpmdScope, test: ast.AST) -> bool:
+        """Is this test replica-uniform by construction?  True when it
+        depends on the axis-name family or on names derived from a jit
+        entry's static_argnames — both ride in static jit arguments, so
+        every replica traces the same side of the branch."""
+        if self._mentions_axis(scope, test):
+            return True
+        return bool(set(names_in(test)) & scope.static_derived)
+
+    def _walk_block(
+        self,
+        scope: SpmdScope,
+        stmts: List[ast.stmt],
+        guards: Tuple[GuardInfo, ...],
+    ) -> None:
+        extra: Tuple[GuardInfo, ...] = ()
+        for idx, st in enumerate(stmts):
+            g = guards + extra
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                base = "" if scope.node is None else scope.qualname + "."
+                child = SpmdScope(
+                    rel=scope.rel,
+                    mod=scope.mod,
+                    node=st,
+                    qualname=base + st.name,
+                    parent=scope,
+                    guards_at_def=scope.guards_at_def + g,
+                )
+                scope.children[st.name] = child
+                self.scopes.append(child)
+                for deco in st.decorator_list:  # evaluate in enclosing scope
+                    self._scan_expr(scope, deco, g)
+                self._build(child, st.body)
+                continue
+            if isinstance(st, ast.ClassDef):
+                self._walk_block(scope, st.body, g)
+                continue
+            if isinstance(st, ast.If):
+                self._scan_expr(scope, st.test, g)
+                gi = GuardInfo(
+                    _test_src(st.test), self._mentions_axis(scope, st.test)
+                )
+                self._walk_block(scope, st.body, g + (gi,))
+                sibling: Optional[List[ast.stmt]] = None
+                if st.orelse:
+                    self._walk_block(scope, st.orelse, g + (gi,))
+                elif st.body and isinstance(st.body[-1], ast.Return):
+                    # early-return guard: the rest of this block runs only
+                    # when the test is false (same trace-staticness)
+                    extra = extra + (gi,)
+                    sibling = stmts[idx + 1 :]
+                scope.ifs.append(IfSite(node=st, guards=g, sibling=sibling))
+                continue
+            if isinstance(st, ast.While):
+                self._scan_expr(scope, st.test, g)
+                gi = GuardInfo(
+                    _test_src(st.test), self._mentions_axis(scope, st.test)
+                )
+                self._walk_block(scope, st.body, g + (gi,))
+                self._walk_block(scope, st.orelse, g)
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                self._scan_expr(scope, st.iter, g)
+                self._walk_block(scope, st.body, g)
+                self._walk_block(scope, st.orelse, g)
+                continue
+            if isinstance(st, ast.Try):
+                self._walk_block(scope, st.body, g)
+                for h in st.handlers:
+                    self._walk_block(scope, h.body, g)
+                self._walk_block(scope, st.orelse, g)
+                self._walk_block(scope, st.finalbody, g)
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    self._scan_expr(scope, item.context_expr, g)
+                self._walk_block(scope, st.body, g)
+                continue
+            self._scan_expr(scope, st, g)
+
+    def _scan_expr(
+        self, scope: SpmdScope, node: ast.AST, guards: Tuple[GuardInfo, ...]
+    ) -> None:
+        for n in _walk_no_defs(node):
+            if isinstance(n, ast.Call):
+                self._classify_call(scope, n, guards)
+
+    def _callee_name(self, func: ast.AST) -> Optional[str]:
+        if isinstance(func, ast.Name):
+            return func.id
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        return None
+
+    def _classify_call(
+        self, scope: SpmdScope, node: ast.Call, guards: Tuple[GuardInfo, ...]
+    ) -> None:
+        dotted = self.project.dotted_callee(scope.mod, node.func)
+        name = self._callee_name(node.func)
+        kind: Optional[str] = None
+        raw = False
+        if dotted is not None and dotted.startswith("jax.lax."):
+            last = dotted.split(".")[-1]
+            if last in _COLLECTIVE_KINDS:
+                kind, raw = last, True
+            elif last in ("cond", "switch"):
+                scope.conds.append(
+                    CondSite(
+                        node=node, is_switch=(last == "switch"), guards=guards
+                    )
+                )
+        if kind is None and name in _TIMED_TO_KIND:
+            kind, raw = _TIMED_TO_KIND[name], False
+        if kind is not None:
+            axis_expr: Optional[ast.AST]
+            if len(node.args) > 1:
+                axis_expr = node.args[1]
+            else:
+                axis_expr = call_kwargs(node).get("axis_name")
+            site = CollectiveSite(
+                kind=kind,
+                raw=raw,
+                node=node,
+                axis_expr=axis_expr,
+                axis_key=self.axis_key(scope, axis_expr),
+                guards=scope.guards_at_def + guards,
+            )
+            scope.sites.append(site)
+            self.site_by_node[id(node)] = site
+            return
+        if name in ("io_callback", "pure_callback") or (
+            dotted is not None
+            and dotted.endswith((".io_callback", ".pure_callback"))
+        ):
+            kw = call_kwargs(node).get("ordered")
+            ordered = isinstance(kw, ast.Constant) and kw.value is True
+            cname = "io_callback"
+            if (name or "").endswith("pure_callback") or (
+                dotted or ""
+            ).endswith("pure_callback"):
+                cname = "pure_callback"
+            scope.callbacks.append(
+                CallbackSite(node=node, name=cname, ordered=ordered)
+            )
+
+    # --------------------------------------------------------- axis sources
+    def axis_key(self, scope: SpmdScope, expr: Optional[ast.AST]) -> Tuple:
+        """Normalize an axis-name argument to its SOURCE:  the parameter
+        plumbing (``("param", "axis_name")`` — GrowerParams.axis_name, an
+        axis_name parameter, or a name derived from them), a string
+        literal (module-level constants resolve), literal None, or
+        unknown."""
+        if expr is None:
+            return ("unknown",)
+        if isinstance(expr, ast.Constant):
+            if expr.value is None:
+                return ("none",)
+            if isinstance(expr.value, str):
+                return ("literal", expr.value)
+            return ("unknown",)
+        if isinstance(expr, ast.Attribute) and expr.attr == "axis_name":
+            return ("param", "axis_name")
+        if isinstance(expr, ast.Name):
+            if expr.id == "axis_name" or expr.id in scope.axis_derived:
+                return ("param", "axis_name")
+            lit = scope.mod.str_consts.get(expr.id)
+            if lit is not None:
+                return ("literal", lit)
+        return ("unknown",)
+
+    def axis_possibly_none(
+        self, scope: SpmdScope, expr: Optional[ast.AST]
+    ) -> bool:
+        """Can this axis-name source be None on some call?  Attribute
+        access (GrowerParams.axis_name is Optional by design) and
+        axis-derived locals (``hist_axis = None if ... else p.axis_name``)
+        count as possibly-None; a parameter only when its annotation is
+        Optional or its default is None.  Unresolvable sources are NOT
+        guessed (the linter is biased to miss)."""
+        if isinstance(expr, ast.Attribute) and expr.attr == "axis_name":
+            return True
+        if not isinstance(expr, ast.Name):
+            return False
+        # a parameter of an enclosing function scope?
+        cur: Optional[SpmdScope] = scope
+        while cur is not None:
+            fn = cur.node
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = _param_info(fn, expr.id)
+                if info is not None:
+                    ann, default = info
+                    if default is not None and isinstance(
+                        default, ast.Constant
+                    ) and default.value is None:
+                        return True
+                    return _is_optional_annotation(ann)
+            cur = cur.parent
+        # a derived local (hist_axis-style) may carry None by construction
+        return expr.id in scope.axis_derived
+
+    # ------------------------------------------------------------ summaries
+    def _resolve_call_scope(
+        self, scope: SpmdScope, node: ast.Call
+    ) -> Optional[SpmdScope]:
+        """The SpmdScope a call lands in: an in-package module function, or
+        a nested def visible up the lexical scope chain."""
+        target = self.project.internal_callee(scope.mod, scope.rel, node.func)
+        if target is not None:
+            fn = self.project.function(*target)
+            if fn is not None:
+                return self.by_func.get(id(fn))
+        if isinstance(node.func, ast.Name):
+            cur: Optional[SpmdScope] = scope
+            while cur is not None:
+                child = cur.children.get(node.func.id)
+                if child is not None:
+                    return child
+                cur = cur.parent
+        return None
+
+    def _call_axis_key(
+        self, scope: SpmdScope, node: ast.Call, callee: SpmdScope
+    ) -> Optional[Tuple]:
+        """The axis-name key the CALLER passes into ``callee`` for its
+        ``axis_name`` parameter; None when the callee has no such
+        parameter (no specialization)."""
+        fn = callee.node
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+        params = positional_params(fn)
+        kwonly = [a.arg for a in fn.args.kwonlyargs]
+        if "axis_name" not in params and "axis_name" not in kwonly:
+            return None
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                return self.axis_key(scope, kw.value)
+        if "axis_name" in params:
+            i = params.index("axis_name")
+            if i < len(node.args) and not any(
+                isinstance(a, ast.Starred) for a in node.args[: i + 1]
+            ):
+                return self.axis_key(scope, node.args[i])
+        info = _param_info(fn, "axis_name")
+        if info is not None and isinstance(info[1], ast.Constant) and (
+            info[1].value is None
+        ):
+            return ("none",)
+        return None
+
+    def block_summary(
+        self,
+        scope: SpmdScope,
+        stmts,
+        depth: int = 3,
+        include_host: bool = False,
+        _stack: Tuple[int, ...] = (),
+    ) -> Counter:
+        """Multiset of ``(kind, axis_key)`` collectives a statement block
+        (or expression list) executes, inlining in-package callees to
+        ``depth`` with axis-argument specialization."""
+        c: Counter = Counter()
+        for st in stmts:
+            for node in _walk_no_defs(st):
+                if not isinstance(node, ast.Call):
+                    continue
+                site = self.site_by_node.get(id(node))
+                if site is not None:
+                    c[(site.kind, site.axis_key)] += 1
+                    continue
+                if include_host:
+                    name = self._callee_name(node.func)
+                    dotted = self.project.dotted_callee(scope.mod, node.func)
+                    if name in _HOST_GATHERS or (
+                        dotted is not None
+                        and dotted.endswith(".process_allgather")
+                    ):
+                        c[("host_gather", ("host",))] += 1
+                        continue
+                if depth <= 0:
+                    continue
+                callee = self._resolve_call_scope(scope, node)
+                if callee is None or id(callee) in _stack:
+                    continue
+                c += self.scope_summary(
+                    callee,
+                    depth - 1,
+                    include_host,
+                    axis_arg_key=self._call_axis_key(scope, node, callee),
+                    _stack=_stack + (id(callee),),
+                )
+        return c
+
+    def scope_summary(
+        self,
+        scope: SpmdScope,
+        depth: int = 2,
+        include_host: bool = False,
+        axis_arg_key: Optional[Tuple] = None,
+        _stack: Tuple[int, ...] = (),
+    ) -> Counter:
+        """Collective summary of a whole function scope, specialized on the
+        axis argument the caller passes: a site whose axis source is the
+        callee's parameter family takes the caller's key, and an
+        axis-guarded site vanishes when the caller passes axis_name=None
+        (the guard is statically false on that call)."""
+        key = (id(scope), depth, include_host, axis_arg_key)
+        cached = self._summary_cache.get(key)
+        if cached is not None:
+            return cached
+        c: Counter = Counter()
+        for site in scope.sites:
+            k = site.axis_key
+            if axis_arg_key is not None and k == ("param", "axis_name"):
+                if axis_arg_key == ("none",):
+                    if site.axis_guarded:
+                        continue
+                    k = ("none",)
+                else:
+                    k = axis_arg_key
+            c[(site.kind, k)] += 1
+        body = scope.node.body if scope.node is not None else []
+        for st in body:
+            for node in _walk_no_defs(st):
+                if not isinstance(node, ast.Call):
+                    continue
+                if id(node) in self.site_by_node:
+                    continue  # counted above via scope.sites
+                if include_host:
+                    name = self._callee_name(node.func)
+                    dotted = self.project.dotted_callee(scope.mod, node.func)
+                    if name in _HOST_GATHERS or (
+                        dotted is not None
+                        and dotted.endswith(".process_allgather")
+                    ):
+                        c[("host_gather", ("host",))] += 1
+                        continue
+                if depth <= 0:
+                    continue
+                callee = self._resolve_call_scope(scope, node)
+                if callee is None or id(callee) in _stack:
+                    continue
+                c += self.scope_summary(
+                    callee,
+                    depth - 1,
+                    include_host,
+                    axis_arg_key=self._call_axis_key(scope, node, callee),
+                    _stack=_stack + (id(callee),),
+                )
+        self._summary_cache[key] = c
+        return c
+
+    def expr_summary(
+        self,
+        scope: SpmdScope,
+        expr: ast.AST,
+        depth: int = 3,
+        include_host: bool = False,
+    ) -> Optional[Counter]:
+        """Collective summary of a branch callable expression (lax.cond /
+        lax.switch branch): a lambda, a resolvable function name, or a
+        functools.partial over one.  None when unresolvable — congruence
+        checks then SKIP the site rather than guess."""
+        if isinstance(expr, ast.Lambda):
+            return self.block_summary(
+                scope, [ast.Expr(value=expr.body)], depth, include_host
+            )
+        if isinstance(expr, ast.Call):
+            dotted = self.project.dotted_callee(scope.mod, expr.func)
+            if dotted == "functools.partial" and expr.args:
+                return self.expr_summary(
+                    scope, expr.args[0], depth, include_host
+                )
+            return None
+        callee = self._resolve_call_scope(
+            scope, ast.Call(func=expr, args=[], keywords=[])
+        )
+        if callee is not None:
+            return self.scope_summary(callee, depth, include_host)
+        return None
+
+
+def _param_info(
+    fn: ast.FunctionDef, name: str
+) -> Optional[Tuple[Optional[ast.AST], Optional[ast.AST]]]:
+    """(annotation, default) for a named parameter, or None if absent."""
+    pos = fn.args.posonlyargs + fn.args.args
+    defaults = [None] * (len(pos) - len(fn.args.defaults)) + list(
+        fn.args.defaults
+    )
+    for a, d in zip(pos, defaults):
+        if a.arg == name:
+            return (a.annotation, d)
+    for a, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+        if a.arg == name:
+            return (a.annotation, d)
+    return None
+
+
+def _is_optional_annotation(ann: Optional[ast.AST]) -> bool:
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Subscript) and isinstance(ann.value, ast.Name):
+        if ann.value.id == "Optional":
+            return True
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        # PEP 604 `str | None`
+        for side in (ann.left, ann.right):
+            if isinstance(side, ast.Constant) and side.value is None:
+                return True
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return "Optional" in ann.value or "None" in ann.value
+    return False
+
+
+def spmd_index(project: Project) -> SpmdIndex:
+    """Build (or reuse) the SPMD index for a project — rules share one."""
+    idx = getattr(project, "_spmd_index", None)
+    if idx is None or idx.project is not project:
+        idx = SpmdIndex(project)
+        project._spmd_index = idx
+    return idx

@@ -69,6 +69,36 @@ RULES: Dict[str, Tuple[str, str]] = {
         "wire a consumer or add a baseline entry documenting why the TPU "
         "build deliberately ignores it",
     ),
+    "GL007": (
+        "collective not congruent across replicas (raw jax.lax collective, "
+        "or a psum/pmax/pmin/all_gather reached on only one branch)",
+        "route raw collectives through obs.collectives.timed_* (the every-"
+        "site-is-measured invariant), and make every lax.cond / divergent "
+        "if branch execute the SAME collective sequence — a replica that "
+        "skips a collective deadlocks the ones that entered it",
+    ),
+    "GL008": (
+        "axis_name inconsistency: mixed axis-name sources in one jitted "
+        "region, or a collective reachable where the axis name can be None",
+        "use ONE axis-name source per jitted region (the GrowerParams."
+        "axis_name plumbing, not ad-hoc literals) and dominate every "
+        "collective with an `axis_name is not None` guard",
+    ),
+    "GL009": (
+        "retrace hazard: non-static Python scalar/tuple flowing into a jit "
+        "entry, or an io_callback/pure_callback without ordered=True",
+        "declare Python scalars in static_argnames (or pin them with "
+        "jnp.asarray) so they stop retracing per value, and pass "
+        "ordered=True to callbacks unless ordering is enforced by an "
+        "explicit data dependency",
+    ),
+    "GL010": (
+        "host-divergent value (process_index / time / os.environ / "
+        "unseeded RNG) gates a branch containing a collective",
+        "hoist the collective out of the divergent branch, or derive the "
+        "gate from replicated data (psummed stats, static config) so every "
+        "process takes the same path",
+    ),
 }
 
 _SUPPRESS_RE = re.compile(
@@ -109,6 +139,8 @@ class Module:
         self.imports: Dict[str, Tuple] = {}
         # module-level NAME = <int/float literal>
         self.consts: Dict[str, float] = {}
+        # module-level NAME = "<str literal>" (axis-name source resolution)
+        self.str_consts: Dict[str, str] = {}
         # module-level function defs by name
         self.functions: Dict[str, ast.FunctionDef] = {}
         for node in self.tree.body:
@@ -118,10 +150,13 @@ class Module:
                 t = node.targets[0]
                 if isinstance(t, ast.Name) and isinstance(
                     node.value, ast.Constant
-                ) and isinstance(node.value.value, (int, float)) and not (
-                    isinstance(node.value.value, bool)
                 ):
-                    self.consts[t.id] = node.value.value
+                    if isinstance(node.value.value, (int, float)) and not (
+                        isinstance(node.value.value, bool)
+                    ):
+                        self.consts[t.id] = node.value.value
+                    elif isinstance(node.value.value, str):
+                        self.str_consts[t.id] = node.value.value
 
     def suppressed(self, line: int, rule: str) -> bool:
         if not (1 <= line <= len(self.lines)):
@@ -332,6 +367,8 @@ class LintResult:
     findings: List[Finding]  # everything that fired (unsuppressed)
     new: List[Finding]  # not covered by the baseline
     stale: List[Dict]  # baseline entries that no longer fire
+    timings: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # per-rule wall seconds, keyed by rule code (GL001..), for --json
 
     @property
     def ok(self) -> bool:
@@ -348,13 +385,24 @@ def run_lint(
     ``only_paths``: optional path-prefix filters (relative to the repo
     root, e.g. ``lightgbm_tpu/ops``) applied to REPORTING only — the whole
     package is always analyzed so the GL003 call graph stays complete.
+    Baseline STALE detection is restricted to the same prefixes, so a
+    filtered run (``--changed-only``, explicit paths) never misreads
+    untouched entries as stale.
     """
-    from . import rules_config, rules_jit, rules_pallas
+    import time
+
+    from . import rules_config, rules_jit, rules_pallas, rules_spmd
 
     project = Project(root)
     findings: List[Finding] = []
-    for rule_mod in (rules_jit, rules_pallas, rules_config):
-        findings.extend(rule_mod.check(project))
+    timings: Dict[str, float] = {}
+    for rule_mod in (rules_jit, rules_pallas, rules_config, rules_spmd):
+        for code, check in rule_mod.RULE_CHECKS.items():
+            t0 = time.monotonic()
+            findings.extend(check(project))
+            timings[code] = timings.get(code, 0.0) + (
+                time.monotonic() - t0
+            )
     # suppressions, dedup, stable order
     seen = set()
     kept: List[Finding] = []
@@ -368,12 +416,14 @@ def run_lint(
             continue
         seen.add(f.key())
         kept.append(f)
+
+    def in_scope(path: str) -> bool:
+        return not only_paths or any(
+            path.startswith(p.rstrip("/")) for p in only_paths
+        )
+
     if only_paths:
-        kept = [
-            f
-            for f in kept
-            if any(f.path.startswith(p.rstrip("/")) for p in only_paths)
-        ]
+        kept = [f for f in kept if in_scope(f.path)]
     entries = load_baseline(baseline)
     covered = {(e["rule"], e["path"], e["ident"]) for e in entries}
     fired = {f.key() for f in kept}
@@ -381,6 +431,7 @@ def run_lint(
     stale = [
         e
         for e in entries
-        if (e["rule"], e["path"], e["ident"]) not in fired
+        if in_scope(e["path"])
+        and (e["rule"], e["path"], e["ident"]) not in fired
     ]
-    return LintResult(findings=kept, new=new, stale=stale)
+    return LintResult(findings=kept, new=new, stale=stale, timings=timings)

@@ -71,3 +71,7 @@ def check(project: Project) -> List[Finding]:
         for name, line in fields
         if name not in consumed
     ]
+
+
+# rule code -> per-rule check callable (run_lint times each one)
+RULE_CHECKS = {"GL006": check}

@@ -213,5 +213,13 @@ def _check_gl004(project: Project) -> List[Finding]:
     return findings
 
 
+# rule code -> per-rule check callable (run_lint times each one)
+RULE_CHECKS = {
+    "GL001": _check_gl001,
+    "GL003": _check_gl003,
+    "GL004": _check_gl004,
+}
+
+
 def check(project: Project) -> List[Finding]:
     return _check_gl001(project) + _check_gl003(project) + _check_gl004(project)

@@ -332,5 +332,12 @@ def _check_gl005(project: Project) -> List[Finding]:
     return findings
 
 
+# rule code -> per-rule check callable (run_lint times each one)
+RULE_CHECKS = {
+    "GL002": _check_gl002,
+    "GL005": _check_gl005,
+}
+
+
 def check(project: Project) -> List[Finding]:
     return _check_gl002(project) + _check_gl005(project)

@@ -1,0 +1,519 @@
+"""GL007–GL010: SPMD collective-congruence, axis-name, retrace-hazard and
+host-divergence rules.
+
+All four rules share one :class:`~.callgraph.SpmdIndex` build (cached on the
+project), evaluating every function scope under "all replicas execute this
+together" semantics:
+
+* **GL007** — a ``psum``/``pmax``/``pmin``/``all_gather`` must be spelled as
+  an ``obs/collectives`` timed wrapper (the every-site-is-measured
+  invariant) and must be congruent across branches: a Python ``if`` whose
+  test is NOT derived from the axis-name family may not make one side
+  execute collectives the other side skips, and every resolvable
+  ``lax.cond``/``lax.switch`` branch must execute the same collective
+  multiset.  Axis-derived guards are exempt: the axis name rides in static
+  jit arguments, so every replica traces the same side.
+* **GL008** — one axis-name source per jitted region (literal vs the
+  ``GrowerParams.axis_name`` plumbing), and no collective whose axis source
+  can be ``None`` without an ``axis_name is not None``-style dominator.
+* **GL009** — scalar-annotated jit-entry parameters must be declared in
+  ``static_argnames`` (or pinned with an ``asarray``-family wrapper), and
+  ``io_callback``/``pure_callback`` sites must pass ``ordered=True`` unless
+  ordering is enforced by an explicit data dependency (baseline-justified).
+* **GL010** — a value derived from ``process_index``, ``time.*``,
+  ``os.environ``, or unseeded RNG may not gate a branch that executes a
+  collective (including host gathers): hosts that disagree on the gate
+  deadlock the ones that entered.
+
+The bias mirrors the rest of graftlint: unresolvable constructs (variable
+``lax.switch`` branch lists, out-of-package callees) are skipped, never
+guessed — a miss is recoverable, a noisy gate is not.
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import Counter
+from typing import List, Optional, Set
+
+from .callgraph import (
+    SpmdScope,
+    TaintWalker,
+    _test_src,
+    jit_entries,
+    spmd_index,
+)
+from .core import Finding, Module, Project, names_in
+from .rules_jit import _ASARRAY_WRAPPERS
+
+# the one module allowed to spell raw jax.lax collectives: the timed
+# wrappers themselves (and their axis-name handling is the sanctioned one)
+_OBS_COLLECTIVES = "obs/collectives.py"
+
+
+def _sanctioned(scope: SpmdScope) -> bool:
+    return scope.rel.replace("\\", "/").endswith(_OBS_COLLECTIVES)
+
+
+def _summary_str(c: Counter) -> str:
+    if not c:
+        return "no collectives"
+    parts = []
+    for (kind, key), n in sorted(c.items(), key=lambda kv: str(kv[0])):
+        parts.append(f"{n}x {kind}[{_axis_key_str(key)}]")
+    return ", ".join(parts)
+
+
+def _axis_key_str(key) -> str:
+    if key == ("param", "axis_name"):
+        return "params.axis_name"
+    if key and key[0] == "literal":
+        return f'literal "{key[1]}"'
+    if key == ("none",):
+        return "None"
+    if key == ("host",):
+        return "host"
+    return "?"
+
+
+# ------------------------------------------------------------------ GL007
+def _check_gl007(project: Project) -> List[Finding]:
+    idx = spmd_index(project)
+    findings: List[Finding] = []
+    for scope in idx.scopes:
+        if _sanctioned(scope):
+            continue
+        # (a) raw jax.lax collectives outside obs/collectives.py
+        raw_seen: Counter = Counter()
+        for site in scope.sites:
+            if not site.raw:
+                continue
+            raw_seen[site.kind] += 1
+            findings.append(
+                Finding(
+                    rule="GL007",
+                    path=scope.mod.rel,
+                    line=site.node.lineno,
+                    ident=(
+                        f"{scope.qualname}:raw-{site.kind}:"
+                        f"{raw_seen[site.kind]}"
+                    ),
+                    message=(
+                        f"raw jax.lax.{site.kind} in {scope.qualname}; "
+                        "route it through obs.collectives.timed_"
+                        f"{site.kind if site.kind != 'all_gather' else 'psum'}"
+                        "(..., site=...) so measured-collective accounting "
+                        "and the perf contract cover this site"
+                    ),
+                )
+            )
+        # (b) one-sided collectives across a non-axis-derived Python if
+        for ifsite in scope.ifs:
+            test = ifsite.node.test
+            if idx.trace_static_test(scope, test):
+                continue
+            body = idx.block_summary(scope, ifsite.node.body)
+            other_stmts = (
+                ifsite.node.orelse
+                if ifsite.node.orelse
+                else (ifsite.sibling or [])
+            )
+            other = idx.block_summary(scope, other_stmts)
+            if bool(body) == bool(other):
+                continue
+            entered, skipped = ("taken", "fall-through")
+            summary = body if body else other
+            findings.append(
+                Finding(
+                    rule="GL007",
+                    path=scope.mod.rel,
+                    line=ifsite.node.lineno,
+                    ident=f"{scope.qualname}:if:{_test_src(test)}",
+                    message=(
+                        f"one-sided collective in {scope.qualname}: the "
+                        f"{entered if body else skipped} branch of "
+                        f"`if {_test_src(test)}` executes "
+                        f"{_summary_str(summary)} the other side skips, "
+                        "and the test is not derived from the axis-name "
+                        "family — replicas that disagree deadlock"
+                    ),
+                )
+            )
+        # (c) lax.cond / lax.switch branch congruence
+        ncond = 0
+        for cond in scope.conds:
+            branches: Optional[List[ast.AST]]
+            if cond.is_switch:
+                seq = (
+                    cond.node.args[1] if len(cond.node.args) > 1 else None
+                )
+                if isinstance(seq, (ast.List, ast.Tuple)):
+                    branches = list(seq.elts)
+                else:
+                    branches = None  # variable branch list: skip, don't guess
+            else:
+                branches = (
+                    [cond.node.args[1], cond.node.args[2]]
+                    if len(cond.node.args) >= 3
+                    else None
+                )
+            if not branches:
+                continue
+            summaries = [idx.expr_summary(scope, b) for b in branches]
+            if any(s is None for s in summaries):
+                continue
+            ncond += 1
+            if all(s == summaries[0] for s in summaries[1:]):
+                continue
+            op = "lax.switch" if cond.is_switch else "lax.cond"
+            detail = " vs ".join(_summary_str(s) for s in summaries)
+            findings.append(
+                Finding(
+                    rule="GL007",
+                    path=scope.mod.rel,
+                    line=cond.node.lineno,
+                    ident=f"{scope.qualname}:cond:{ncond}",
+                    message=(
+                        f"{op} in {scope.qualname} has incongruent "
+                        f"collective branches ({detail}); the predicate is "
+                        "traced, so one replica can enter a branch whose "
+                        "collective the others never post"
+                    ),
+                )
+            )
+    return findings
+
+
+# ------------------------------------------------------------------ GL008
+def _check_gl008(project: Project) -> List[Finding]:
+    idx = spmd_index(project)
+    findings: List[Finding] = []
+    # (a) mixed axis-name sources inside one jitted region
+    seen_entries: Set[int] = set()
+    for rel, mod, fn, _statics in jit_entries(project):
+        if id(fn) in seen_entries:
+            continue
+        seen_entries.add(id(fn))
+        scope = idx.by_func.get(id(fn))
+        if scope is None or _sanctioned(scope):
+            continue
+        summary = idx.scope_summary(scope, depth=8)
+        keys = {k for (_kind, k) in summary if k[0] in ("literal", "param")}
+        if len(keys) <= 1:
+            continue
+        findings.append(
+            Finding(
+                rule="GL008",
+                path=mod.rel,
+                line=fn.lineno,
+                ident=f"{fn.name}:axis-sources",
+                message=(
+                    f"jitted {fn.name}() reaches collectives with MIXED "
+                    "axis-name sources ("
+                    + ", ".join(sorted(_axis_key_str(k) for k in keys))
+                    + "); paired reduction sites with different axis names "
+                    "sum over different meshes — wrong numbers, no crash"
+                ),
+            )
+        )
+    # (b) collective reachable where the axis name can be None
+    for scope in idx.scopes:
+        if _sanctioned(scope):
+            continue
+        nsite = 0
+        for site in scope.sites:
+            if site.axis_key != ("param", "axis_name"):
+                continue
+            if site.axis_guarded:
+                continue
+            if not idx.axis_possibly_none(scope, site.axis_expr):
+                continue
+            nsite += 1
+            findings.append(
+                Finding(
+                    rule="GL008",
+                    path=scope.mod.rel,
+                    line=site.node.lineno,
+                    ident=f"{scope.qualname}:none-{site.kind}:{nsite}",
+                    message=(
+                        f"{site.kind} in {scope.qualname} is reachable "
+                        "with axis_name=None (Optional source, no "
+                        "`axis_name is not None` dominator on this path); "
+                        "dominate the site with an axis guard"
+                    ),
+                )
+            )
+    return findings
+
+
+# ------------------------------------------------------------------ GL009
+_SCALARS = {"int", "float", "bool", "str"}
+
+
+def _scalar_annotation(ann: Optional[ast.AST]) -> bool:
+    """Python-scalar annotations that mark a retrace-per-value hazard when
+    the parameter is not static.  Bare ``Tuple``/``tuple`` is NOT scalar —
+    an unparameterized tuple can (and in this tree does) hold arrays."""
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Name):
+        return ann.id in _SCALARS
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.strip() in _SCALARS
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        return _scalar_annotation(ann.left) or _scalar_annotation(ann.right)
+    if isinstance(ann, ast.Subscript):
+        base = ann.value
+        bname = (
+            base.id
+            if isinstance(base, ast.Name)
+            else base.attr if isinstance(base, ast.Attribute) else None
+        )
+        if bname == "Optional":
+            return _scalar_annotation(ann.slice)
+        if bname in ("Tuple", "tuple"):
+            sl = ann.slice
+            if isinstance(sl, ast.Tuple):
+                return any(_scalar_annotation(e) for e in sl.elts)
+            return _scalar_annotation(sl)
+    return False
+
+
+def _check_gl009(project: Project) -> List[Finding]:
+    idx = spmd_index(project)
+    findings: List[Finding] = []
+    # (a) scalar-annotated jit-entry params outside static_argnames
+    seen_entries: Set[int] = set()
+    for rel, mod, fn, statics in jit_entries(project):
+        if id(fn) in seen_entries:
+            continue
+        seen_entries.add(id(fn))
+        pinned: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = project.dotted_callee(mod, node.func)
+            if dotted is None or dotted.split(".")[-1] not in (
+                _ASARRAY_WRAPPERS
+            ):
+                continue
+            for arg in node.args:
+                pinned.update(names_in(arg))
+        args = fn.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            if a.arg in statics or a.arg in pinned:
+                continue
+            if not _scalar_annotation(a.annotation):
+                continue
+            findings.append(
+                Finding(
+                    rule="GL009",
+                    path=mod.rel,
+                    line=a.lineno,
+                    ident=f"{fn.name}:{a.arg}",
+                    message=(
+                        f"jit entry {fn.name}() takes Python scalar "
+                        f"`{a.arg}` ({ast.unparse(a.annotation)}) without "
+                        "declaring it in static_argnames or pinning it "
+                        "with jnp.asarray — every new value retraces"
+                    ),
+                )
+            )
+    # (b) io_callback / pure_callback without ordered=True
+    for scope in idx.scopes:
+        ncb = 0
+        for cb in scope.callbacks:
+            if cb.ordered:
+                continue
+            ncb += 1
+            findings.append(
+                Finding(
+                    rule="GL009",
+                    path=scope.mod.rel,
+                    line=cb.node.lineno,
+                    ident=f"{scope.qualname}:{cb.name}:{ncb}",
+                    message=(
+                        f"{cb.name} in {scope.qualname} without "
+                        "ordered=True; XLA may reorder it across the "
+                        "region it is meant to bracket — pass "
+                        "ordered=True, or enforce ordering with an "
+                        "explicit data dependency and baseline this site"
+                    ),
+                )
+            )
+    return findings
+
+
+# ------------------------------------------------------------------ GL010
+def _is_source_call(project: Project, mod: Module, node: ast.Call) -> bool:
+    dotted = project.dotted_callee(mod, node.func)
+    if dotted is None:
+        return False
+    if dotted.endswith(".process_index") or dotted == "process_index":
+        return True
+    if dotted == "os.getenv" or dotted.startswith("os.environ"):
+        return True
+    if dotted.startswith("time."):
+        return True
+    if dotted.startswith("random."):
+        return True
+    if dotted.startswith("numpy.random."):
+        last = dotted.split(".")[-1]
+        if last in ("default_rng", "RandomState") and (
+            node.args or node.keywords
+        ):
+            return False  # explicitly seeded: replica-uniform
+        return True
+    return False
+
+
+def _expr_has_source(project: Project, mod: Module, expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call) and _is_source_call(project, mod, n):
+            return True
+        if (
+            isinstance(n, ast.Attribute)
+            and n.attr == "environ"
+            and isinstance(n.value, ast.Name)
+            and n.value.id == "os"
+        ):
+            return True
+    return False
+
+
+def _fn_has_source(project: Project, mod: Module, fn: ast.AST) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Call) and _is_source_call(project, mod, n):
+            return True
+        if (
+            isinstance(n, ast.Attribute)
+            and n.attr == "environ"
+            and isinstance(n.value, ast.Name)
+            and n.value.id == "os"
+        ):
+            return True
+    return False
+
+
+def _divergent_seeds(
+    project: Project, mod: Module, fn: ast.FunctionDef
+) -> Set[str]:
+    """Names assigned (anywhere in ``fn``) from a host-divergent source
+    expression.  The TaintWalker's own assignment fixpoint takes it from
+    here — these are just the roots."""
+    seeds: Set[str] = set()
+    for node in ast.walk(fn):
+        value = None
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets, value = [node.target], node.value
+        if value is None or not _expr_has_source(project, mod, value):
+            continue
+        for t in targets:
+            # plain-name targets only: `self.x = time.time()` must not
+            # mark every later `self.y` gate divergent
+            if not isinstance(t, (ast.Name, ast.Tuple, ast.List)):
+                continue
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    seeds.add(n.id)
+    return seeds
+
+
+def _check_gl010(project: Project) -> List[Finding]:
+    idx = spmd_index(project)
+    findings: List[Finding] = []
+
+    def visit(
+        mod_rel: str, fn: ast.FunctionDef, tainted: Set[str], node: ast.AST
+    ) -> None:
+        mod = project.modules[mod_rel]
+        scope = idx.by_func.get(id(fn))
+        if scope is None:
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            test = node.test
+            if not (
+                set(names_in(test)) & tainted
+                or _expr_has_source(project, mod, test)
+            ):
+                return
+            branch = list(node.body) + list(node.orelse)
+            if not idx.block_summary(scope, branch, include_host=True):
+                return
+            findings.append(
+                Finding(
+                    rule="GL010",
+                    path=mod.rel,
+                    line=node.lineno,
+                    ident=f"{fn.name}:{_test_src(test)}",
+                    message=(
+                        f"host-divergent gate `{_test_src(test)}` in "
+                        f"{fn.name}() guards a branch that executes a "
+                        "collective; hosts that disagree on the gate "
+                        "deadlock the ones that entered — hoist the "
+                        "collective or derive the gate from replicated "
+                        "data"
+                    ),
+                )
+            )
+            return
+        if isinstance(node, ast.Call) and node.args:
+            dotted = project.dotted_callee(mod, node.func)
+            if dotted not in ("jax.lax.cond", "jax.lax.switch"):
+                return
+            pred = node.args[0]
+            if not (
+                set(names_in(pred)) & tainted
+                or _expr_has_source(project, mod, pred)
+            ):
+                return
+            has_collective = False
+            for b in node.args[1:3]:
+                s = idx.expr_summary(scope, b, include_host=True)
+                if s:
+                    has_collective = True
+            if not has_collective:
+                return
+            findings.append(
+                Finding(
+                    rule="GL010",
+                    path=mod.rel,
+                    line=node.lineno,
+                    ident=f"{fn.name}:{_test_src(pred)}",
+                    message=(
+                        f"host-divergent predicate `{_test_src(pred)}` "
+                        f"feeds a {dotted} whose branches execute "
+                        f"collectives in {fn.name}() — replicas that "
+                        "disagree deadlock"
+                    ),
+                )
+            )
+
+    for rel, mod in project.modules.items():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _fn_has_source(project, mod, node):
+                continue
+            seeds = _divergent_seeds(project, mod, node)
+            walker = TaintWalker(project, visit, taint_attr_bases=False)
+            walker.walk(rel, node, frozenset(seeds))
+    return findings
+
+
+RULE_CHECKS = {
+    "GL007": _check_gl007,
+    "GL008": _check_gl008,
+    "GL009": _check_gl009,
+    "GL010": _check_gl010,
+}
+
+
+def check(project: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in RULE_CHECKS.values():
+        out.extend(fn(project))
+    return out

@@ -22,6 +22,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.collectives import timed_psum
 from ..obs.jit import instrumented_jit
 
 from .split import leaf_output
@@ -80,6 +81,7 @@ def quantize_gradients(
         "lambda_l2",
         "max_delta_step",
         "axis_name",
+        "measure",
     ),
 )
 def renew_leaf_values(
@@ -93,15 +95,18 @@ def renew_leaf_values(
     lambda_l2: float,
     max_delta_step: float,
     axis_name: Optional[str] = None,
+    measure: bool = False,
 ) -> jnp.ndarray:
     """Per-leaf outputs from true gradient sums
     (RenewIntGradTreeOutput, gradient_discretizer.cpp:209; the data-parallel
-    branch GlobalSums the per-leaf stats — here a psum when axis_name)."""
+    branch GlobalSums the per-leaf stats — here a psum when axis_name,
+    routed through the timed wrapper so ``collective_measured/*`` and the
+    perf contract see the quantized-training path)."""
     sum_g = jax.ops.segment_sum(grad * mask, leaf_id, num_segments=num_leaves)
     sum_h = jax.ops.segment_sum(hess * mask, leaf_id, num_segments=num_leaves)
     if axis_name is not None:
-        sum_g = jax.lax.psum(sum_g, axis_name)
-        sum_h = jax.lax.psum(sum_h, axis_name)
+        sum_g = timed_psum(sum_g, axis_name, site="quant", measure=measure)
+        sum_h = timed_psum(sum_h, axis_name, site="quant", measure=measure)
     out = leaf_output(sum_g, sum_h, lambda_l1, lambda_l2, max_delta_step)
     active = jnp.arange(num_leaves) < num_leaves_used
     return jnp.where(active & (num_leaves_used > 1), out, 0.0).astype(

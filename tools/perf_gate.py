@@ -175,6 +175,32 @@ def collect() -> Dict[str, float]:
             metrics["collective/analytic_bytes"] = analytic
         if measured:
             metrics["collective/measured_psum_bytes"] = round(measured, 1)
+
+        # -- scenario 3: quantized data-parallel train — pins the
+        # quantized-training path (int grid + RenewIntGradTreeOutput) into
+        # the retrace contract now that its leaf-stat psums route through
+        # the timed wrappers (GL007's every-site-is-measured invariant)
+        ses.reset()
+        labels_before = compile_counts_by_label()
+        t0 = time.perf_counter()
+        lgb.train(
+            {
+                **base,
+                "tree_learner": "data",
+                "use_quantized_grad": True,
+                "quant_train_renew_leaf": True,
+            },
+            lgb.Dataset(X, label=y, params=base),
+            num_boost_round=3,
+        )
+        metrics["wall/quant_data_parallel_train_s"] = round(
+            time.perf_counter() - t0, 3
+        )
+        labels_after = compile_counts_by_label()
+        for label, count in sorted(labels_after.items()):
+            delta = count - labels_before.get(label, 0)
+            if delta:
+                metrics[f"retrace/quant_data_parallel/{label}"] = float(delta)
     else:  # pragma: no cover - CI always has the virtual mesh
         print(
             f"perf_gate: only {ndev} cpu devices; skipping the "

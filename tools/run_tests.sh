@@ -14,10 +14,12 @@ export XLA_FLAGS="${XLA_FLAGS:---xla_force_host_platform_device_count=8}"
 
 rc=0
 
-# graftlint gate: pure-ast static analysis (tracer safety + Pallas
-# contracts) diffed against the reviewed baseline.  Runs FIRST and is a
-# hard gate — a new finding or a stale baseline entry fails the suite
-# before any pytest chunk spends time compiling.
+# graftlint gate: pure-ast static analysis (tracer safety, Pallas
+# contracts, SPMD collective congruence GL007-GL010) diffed against the
+# reviewed baseline.  Runs FIRST over the FULL tree and is a hard gate —
+# a new finding or a stale baseline entry fails the suite before any
+# pytest chunk spends time compiling.  (--changed-only is for the dev
+# loop only; CI always takes the full-tree run.)
 echo "=== graftlint (python -m lightgbm_tpu.lint --baseline lint_baseline.json) ==="
 python -m lightgbm_tpu.lint --baseline lint_baseline.json || rc=$?
 
