@@ -1311,6 +1311,10 @@ class Booster:
             measure_collectives=bool(
                 cfg.telemetry and cfg.obs_collectives and self._mesh is not None
             ),
+            # histogram engine v2: int8-by-default accumulation on the seg
+            # TPU path ('auto'/'int8'), near-tie f32 re-accumulate tolerance
+            hist_acc=cfg.hist_acc,
+            near_tie_tol=cfg.hist_near_tie_tol,
         )
 
     def _fit_linear_leaves(

@@ -342,6 +342,18 @@ class Config:
     # Off TPU the fused dispatcher lowers to the same XLA composition as the
     # two-launch path, so tree structures are byte-identical either way.
     grow_fused: str = "auto"
+    # TPU extension: histogram accumulator (histogram engine v2).  'auto'
+    # engages 2-digit int8 MXU accumulation by default on the single-host
+    # seg TPU path — true f32 gradients are scaled onto the int8 grid once
+    # per iteration and near-tie split decisions are re-accumulated in f32
+    # before the structure commit (hist_near_tie_tol); 'bf16' keeps the
+    # 3-term bf16 split accumulator everywhere; 'int8' forces the int8 path
+    # where eligible (same gating as 'auto' today).  Off TPU both resolve
+    # to the exact f32 reference — golden parity is unaffected.
+    hist_acc: str = "auto"
+    # relative gain gap below which the int8 winner counts as a near tie
+    # and its histogram is redone with direct f32 accumulation
+    hist_near_tie_tol: float = 1e-3
     early_stopping_round: int = 0
     early_stopping_min_delta: float = 0.0
     first_metric_only: bool = False
@@ -585,6 +597,10 @@ class Config:
             raise ValueError("leaf_batch must be >= 1")
         if self.grow_fused not in ("auto", "on", "off"):
             raise ValueError("grow_fused must be one of 'auto', 'on', 'off'")
+        if self.hist_acc not in ("auto", "int8", "bf16"):
+            raise ValueError("hist_acc must be one of 'auto', 'int8', 'bf16'")
+        if self.hist_near_tie_tol < 0.0:
+            raise ValueError("hist_near_tie_tol must be >= 0")
         if not (0.0 <= self.leaf_batch_min_commit_rate <= 1.0):
             raise ValueError("leaf_batch_min_commit_rate must be in [0, 1]")
         if self.checkpoint_interval < 0:

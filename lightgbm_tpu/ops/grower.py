@@ -152,6 +152,17 @@ class GrowerParams:
     # for the timed/byte-counted wrapper.  Static on purpose — toggling it
     # must retrace, never silently reuse a trace without the callbacks.
     measure_collectives: bool = False
+    # histogram accumulator (histogram engine v2): "auto" engages the
+    # 2-digit int8 MXU accumulation by DEFAULT on the seg TPU path (true
+    # f32 grads quantized once per iteration, seg.QMAX grid) with an f32
+    # re-accumulate pass for near-tie split decisions; "bf16" keeps the
+    # 3-term bf16 split everywhere; "int8" is "auto" without the opt-out.
+    hist_acc: str = "auto"
+    # relative gain gap below which the int8-default winner is considered
+    # a near tie and its histogram is re-accumulated in f32 before the
+    # structure decision (int8 grid step ~6e-5 relative; 1e-3 covers the
+    # worst-case gain-domain amplification under gradient cancellation)
+    near_tie_tol: float = 1e-3
 
 
 def _hist_caps(n: int, full_range: bool = False) -> list:
@@ -352,7 +363,7 @@ def _candidate_for_leaf(
     hist, g, h, c, num_bins, nan_bins, feature_mask, p: GrowerParams,
     monotone=None, lb=None, ub=None, parent_output=0.0, is_cat=None,
     cegb_penalty=None, rand_bins=None, adv=None, bundle_end=None,
-    depth=None, feature_contri=None,
+    depth=None, feature_contri=None, with_margin=False,
 ):
     """Best split for one leaf.  ``hist`` is the GLOBAL (psummed) histogram
     normally; under voting-parallel it is the LOCAL histogram and only the
@@ -395,6 +406,7 @@ def _candidate_for_leaf(
                 min_gain_to_split=p.min_gain_to_split,
                 feature_contri=feature_contri,
                 interpret=not on_tpu,
+                with_margin=with_margin,
             )
     use_mono_pen = monotone is not None and p.monotone_penalty > 0.0
     common = dict(
@@ -423,8 +435,13 @@ def _candidate_for_leaf(
             adv_bounds=adv,
             bundle_end=bundle_end,
             feature_contri=feature_contri,
+            with_margin=with_margin,
             **common,
         )
+    if with_margin:
+        # int8-default never engages under axis_name (grower gate), so the
+        # voting path never needs the near-tie margin
+        raise ValueError("with_margin is not supported on the voting path")
     # ---- PV-Tree election.  1) local per-feature best gains from the LOCAL
     # histogram (local parent stats derive from it: feature 0's bins cover
     # every local row)
@@ -818,15 +835,18 @@ def grow_tree(
                 )
 
     def cand_for_leaf(hist, g, h, c, fm, lb=None, ub=None, pout=0.0,
-                      rand=None, cpen=None, adv=None, depth=None):
+                      rand=None, cpen=None, adv=None, depth=None,
+                      with_margin=False):
         with jax.named_scope("split_scan"):
             return _cand_for_leaf_impl(
                 hist, g, h, c, fm, lb=lb, ub=ub, pout=pout,
                 rand=rand, cpen=cpen, adv=adv, depth=depth,
+                with_margin=with_margin,
             )
 
     def _cand_for_leaf_impl(hist, g, h, c, fm, lb=None, ub=None, pout=0.0,
-                            rand=None, cpen=None, adv=None, depth=None):
+                            rand=None, cpen=None, adv=None, depth=None,
+                            with_margin=False):
         """Leaf candidate with the distributed-mode plumbing: per-feature
         operand slicing + winner all-reduce under feature-parallel; voting
         election happens inside _candidate_for_leaf."""
@@ -836,7 +856,12 @@ def grow_tree(
                 monotone=mono_arr, lb=lb, ub=ub, parent_output=pout,
                 is_cat=is_cat_arr, cegb_penalty=cpen, rand_bins=rand,
                 adv=adv, bundle_end=bundle_end, depth=depth,
-                feature_contri=fc_arr,
+                feature_contri=fc_arr, with_margin=with_margin,
+            )
+        if with_margin:
+            # int8-default requires axis_name None, which excludes featpar
+            raise ValueError(
+                "with_margin is not supported under feature-parallel"
             )
         cand = _candidate_for_leaf(
             hist, g, h, c, _fslice(num_bins), _fslice(nan_bins),
@@ -903,16 +928,65 @@ def grow_tree(
             if (p.hist_method.startswith("pallas_int8") and quant_scales is not None)
             else None
         )
+        # histogram engine v2: int8 2-digit accumulation is the DEFAULT on
+        # the single-host seg TPU path — the true f32 grads are scaled onto
+        # the QMAX grid once per iteration and every histogram launch runs
+        # int8 x int8 -> i32 on the MXU; near-tie split decisions trigger an
+        # f32 re-accumulate before the structure commit (with_margin below).
+        # Excluded: explicit bf16 opt-out, quantized training (already on an
+        # exact integer grid), any axis_name (distributed reduction semantics
+        # and psum byte volumes stay untouched), monotone constraints (the
+        # refine re-scan would need the full constraint plumbing).
+        from .pallas import seg as _seg_mod
 
-        def _seg_hist(seg_arr, start, cnt_rows):
+        use_int8_acc = (
+            use_seg
+            and seg_qs is None
+            and p.hist_acc != "bf16"
+            and p.axis_name is None
+            and mono_arr is None
+            and (jax.default_backend() == "tpu" or _seg_mod._INTERPRET)
+        )
+        if use_int8_acc:
+            from .quantize import hist_acc_scales
+
+            seg_qs = hist_acc_scales(grad, hess, count_mask)
+
+        # live-plane skip: feature-plane groups with no usable feature under
+        # the TREE-level deterministic mask (feature_fraction bytree / EFB
+        # pruning) skip their one-hot build + matmul entirely.  Derived from
+        # feature_mask ONLY — hist_buf rows are reused by descendants
+        # (sibling subtraction, later parent reads) whose per-node bynode /
+        # interaction masks differ, and those are subsets of feature_mask,
+        # so masking at the tree level is the safe superset.  Group 0 stays
+        # live (feature 0's plane carries the window totals); forced splits
+        # may target masked-out features, so they disable the skip.
+        seg_live = None
+        if use_seg and not (p.n_forced > 0 and forced is not None):
+            from .pallas.seg import hist_bpad, hist_group, hist_ngroups
+
+            _gb = hist_group(f_seg, hist_bpad(B))
+            _ng = hist_ngroups(f_seg, hist_bpad(B))
+            if _ng > 1:
+                fm_pad = jnp.pad(
+                    _fslice(feature_mask).astype(bool),
+                    (0, _ng * _gb - f_seg),
+                )
+                seg_live = (
+                    fm_pad.reshape(_ng, _gb).any(axis=1)
+                    .at[0].set(True).astype(jnp.int32)
+                )
+
+        def _seg_hist(seg_arr, start, cnt_rows, qs=seg_qs):
             hist = seg_hist(
                 seg_arr,
                 jnp.stack([start, cnt_rows]).astype(jnp.int32),
                 f=f_seg,
                 num_bins=B,
                 n_pad=n_pad_seg,
-                quant_scales=seg_qs,
+                quant_scales=qs,
                 wide=seg_wide,
+                live=seg_live,
             )
             if hist_axis is not None:
                 hist = timed_psum(
@@ -933,6 +1007,7 @@ def grow_tree(
         )
     else:
         use_fused_grow = False
+        use_int8_acc = False
     if use_ordered or use_gather:
         caps = sorted(
             _hist_caps(
@@ -1133,9 +1208,7 @@ def grow_tree(
     root_used = jnp.zeros((f,), bool)
     neg_inf_s = jnp.float32(-jnp.inf)
     pos_inf_s = jnp.float32(jnp.inf)
-    cand0 = cand_for_leaf(
-        hist0, totals[0], totals[1], totals[2],
-        node_feature_mask(0, root_used),
+    _root_kwargs = dict(
         lb=neg_inf_s if use_mono else None,
         ub=pos_inf_s if use_mono else None,
         pout=leaf_output(totals[0], totals[1], p.lambda_l1, p.lambda_l2, p.max_delta_step),
@@ -1143,6 +1216,31 @@ def grow_tree(
         rand=node_rand_bins(0),
         depth=jnp.asarray(0, jnp.int32) if use_mono_pen else None,
     )
+    cand0 = cand_for_leaf(
+        hist0, totals[0], totals[1], totals[2],
+        node_feature_mask(0, root_used),
+        with_margin=use_int8_acc,
+        **_root_kwargs,
+    )
+    if use_int8_acc:
+        # near-tie f32 re-accumulate (histogram engine v2): when the root
+        # winner's relative gain gap is inside near_tie_tol, redo the
+        # window's histogram with direct f32 accumulation and re-scan
+        # before the structure commit.  hist_buf keeps the INT8 histogram
+        # (sibling subtraction must stay on one accumulation grid); the
+        # refined copy exists only for this decision.
+        cand0, margin0 = cand0
+        near0 = margin0 < p.near_tie_tol
+        hist0_f = _seg_hist(
+            seg0, jnp.int32(0),
+            jnp.where(near0, n, 0).astype(jnp.int32), qs=None,
+        )
+        cand0 = cand_for_leaf(
+            jnp.where(near0, hist0_f, hist0),
+            totals[0], totals[1], totals[2],
+            node_feature_mask(0, root_used),
+            **_root_kwargs,
+        )
 
     neg_inf = jnp.full((L,), -jnp.inf, dtype=jnp.float32)
     cand = SplitCandidate(
@@ -1366,6 +1464,7 @@ def grow_tree(
                     n_pad=n_pad_seg,
                     quant_scales=seg_qs,
                     wide=seg_wide,
+                    live=seg_live,
                 )
             nleft = nl1[0]
             nright = nr1[0]
@@ -1848,7 +1947,7 @@ def grow_tree(
             opt2 += [depth2]
         cpen = _cegb_pen(cegb_used_new)
 
-        def _child_cand(hist, g_, h_, c_, fm, po, *rest):
+        def _child_cand(hist, g_, h_, c_, fm, po, *rest, wm=False):
             lbv = ubv = rbv = advv = dv = None
             i = 0
             if use_mono:
@@ -1865,10 +1964,29 @@ def grow_tree(
             return cand_for_leaf(
                 hist, g_, h_, c_, fm,
                 lb=lbv, ub=ubv, pout=po, cpen=cpen, rand=rbv, adv=advv,
-                depth=dv,
+                depth=dv, with_margin=wm,
             )
 
         with jax.named_scope("candidate_refresh"):
+            if use_int8_acc:
+                # near-tie f32 re-accumulate for the two refreshed children:
+                # both child windows are re-histogrammed DIRECTLY (no
+                # subtraction — the refine must not inherit the int8 grid
+                # error it exists to remove), with cnt=0 for children whose
+                # margin clears the tolerance (zero loop trips in-kernel)
+                cand2, margins2 = jax.vmap(
+                    functools.partial(_child_cand, wm=True)
+                )(hist2, g2, h2, c2, fm2, po2, *opt2)
+                near2 = margins2 < p.near_tie_tol  # [2]
+                start2 = jnp.stack([begin_l, begin_l + nleft])
+                cnt2 = jnp.where(near2, jnp.stack([nleft, nright]), 0)
+                hist_rf = seg_hist_batch(
+                    order,
+                    jnp.stack([start2, cnt2], axis=1).astype(jnp.int32),
+                    f=f_seg, num_bins=B, n_pad=n_pad_seg,
+                    quant_scales=None, wide=seg_wide, live=seg_live,
+                )
+                hist2 = jnp.where(near2[:, None, None, None], hist_rf, hist2)
             cand2 = jax.vmap(_child_cand)(hist2, g2, h2, c2, fm2, po2, *opt2)
         cand_l = SplitCandidate(*[a[0] for a in cand2])
         cand_r = SplitCandidate(*[a[1] for a in cand2])
@@ -2079,6 +2197,7 @@ def grow_tree(
                     n_pad=n_pad_seg,
                     quant_scales=seg_qs,
                     wide=seg_wide,
+                    live=seg_live,
                 )
             left_smaller_k = nleft_k <= nright_k
         elif use_seg:
@@ -2120,6 +2239,7 @@ def grow_tree(
                     n_pad=n_pad_seg,
                     quant_scales=seg_qs,
                     wide=seg_wide,
+                    live=seg_live,
                 )
             if hist_axis is not None:
                 sm_k = timed_psum(
@@ -2320,7 +2440,7 @@ def grow_tree(
         if use_mono_pen:
             opt2 += [jnp.concatenate([d_new_k, d_new_k])]
 
-        def _child_cand_b(hist, g_, h_, c_, fm, po, *rest):
+        def _child_cand_b(hist, g_, h_, c_, fm, po, *rest, wm=False):
             lbv = ubv = rbv = dv = None
             i = 0
             if use_mono:
@@ -2333,10 +2453,28 @@ def grow_tree(
                 dv = rest[i]
             return cand_for_leaf(
                 hist, g_, h_, c_, fm,
-                lb=lbv, ub=ubv, pout=po, rand=rbv, depth=dv,
+                lb=lbv, ub=ubv, pout=po, rand=rbv, depth=dv, with_margin=wm,
             )
 
         with jax.named_scope("candidate_refresh"):
+            if use_int8_acc:
+                # near-tie f32 re-accumulate over the 2K refreshed children
+                # (one extra plane-tiled launch; cnt=0 rows cost nothing)
+                cand2, margins2 = jax.vmap(
+                    functools.partial(_child_cand_b, wm=True)
+                )(hist2, g2, h2, c2, fm2, po2, *opt2)
+                near2 = margins2 < p.near_tie_tol  # [2K]
+                start2 = jnp.concatenate([begin_k, begin_k + nleft_k])
+                cnt2 = jnp.where(
+                    near2, jnp.concatenate([nleft_k, nright_k]), 0
+                )
+                hist_rf = seg_hist_batch(
+                    order,
+                    jnp.stack([start2, cnt2], axis=1).astype(jnp.int32),
+                    f=f_seg, num_bins=B, n_pad=n_pad_seg,
+                    quant_scales=None, wide=seg_wide, live=seg_live,
+                )
+                hist2 = jnp.where(near2[:, None, None, None], hist_rf, hist2)
             cand2 = jax.vmap(_child_cand_b)(hist2, g2, h2, c2, fm2, po2, *opt2)
         depth_ok_k = (p.max_depth <= 0) | (d_new_k < p.max_depth)
         gain_l_k = jnp.where(depth_ok_k, cand2.gain[:K], -jnp.inf)

@@ -4,26 +4,32 @@ The frontier-batched grower (ops/grower.py, leaf_batch=K) already amortizes
 per-split fixed cost, but each compiled step still runs partition ->
 election -> histogram as separately-launched regions with full HBM
 round-trips and dispatch gaps between them (the 36% "bookkeeping" share in
-BENCH_NOTES round 8).  This kernel fuses the per-member pipeline: for each
-of the K disjoint frontier windows, one grid program
+BENCH_NOTES round 8).  This kernel fuses the per-member pipeline over a
+PLANE-TILED ``(K, G)`` grid (batch member x feature-plane group — the
+histogram-engine-v2 layout shared with seg.py): for each of the K disjoint
+frontier windows, the member's FIRST plane program
 
   1. streams the window once and stably partitions it in place
      (partition._partition_window — the exact machinery of the standalone
      seg partition kernel);
-  2. elects the smaller child locally (nl <= cnt - nl — the grower's
+  2. elects the smaller child locally and parks the decision in the
+     persistent SMEM ``dec`` output (nl <= cnt - nl — the grower's
      single-host election; under tree_learner=data the election needs a
      psum of per-shard counts MID-STEP, which is why the fused path only
      engages when no axis_name is set and the two-launch path remains the
      data-parallel fallback);
-  3. histograms the smaller child over the freshly-partitioned rows
-     (seg._hist_window), reading tiles through the OUTPUT alias so phase 3
-     observes phase 1's writes (partition.read_aliased_tile — the same
-     idiom that fixes cross-program boundary reads, and the reason the
-     fused kernel works at all: the partition happened in the SAME program
-     invocation);
 
-and emits the packed per-member split decision (nl, nr, child_start,
-child_cnt) plus the stacked [K, 3, F*bpad] histogram block.  The best-split
+and then EVERY plane program (i, pt) — grid programs run sequentially, so
+(i, 0)'s writes are visible — reads the decision back and histograms its
+plane group over the freshly-partitioned rows (seg._hist_window), reading
+tiles through the OUTPUT alias so the histogram observes the partition's
+writes (partition.read_aliased_tile — the same idiom that fixes
+cross-program boundary reads, and the reason the fused kernel works at
+all: the partition happened in an EARLIER program of the same sequential
+grid).  Dead plane groups (feature_fraction / EFB) skip their tile loop
+via the ``live`` mask.  Each program emits one RAW [8, group*bpad]
+accumulator block (i32 on the int8 path, f32 on bf16); the digit
+recombine runs outside the kernel (seg.combine_hist_raw).  The best-split
 scan stays a separate launch: it needs the psummed histogram under
 tree_learner=data and the parent-minus-child sibling subtraction, neither
 of which is per-member-local.  On the basic numeric path it runs as the
@@ -31,12 +37,17 @@ existing fused Pallas scan (ops/pallas/split_scan.py), so the whole grow
 step is two kernel launches instead of three compiled regions plus their
 dispatch boundaries.
 
+Plane-tiling trade (same as seg.py): per-program VMEM scratch shrinks to
+O(group*bpad) — independent of F — at the cost of each plane program
+re-streaming the window's stat planes (G-fold redundant DMA, hidden under
+the one-hot matmul for every shape seg_vmem_ok admits).
+
 The XLA composition (`sort_partition_xla` chain + local election + masked
 reference histogram) is the always-available fallback AND the correctness
 oracle — it is definitionally the same computation the two-launch grower
-path performs, so CPU results are byte-identical by construction and
-tests/test_fused_step.py asserts the Pallas kernel (interpret mode off-TPU)
-matches it bit-for-bit.
+path performs (including the windowed CPU histogram, seg.seg_hist_cpu), so
+CPU results are byte-identical by construction and tests/test_fused_step.py
+asserts the Pallas kernel (interpret mode off-TPU) matches it bit-for-bit.
 """
 
 from __future__ import annotations
@@ -60,8 +71,10 @@ from .seg import (
     COL_ALIGN,
     TILE,
     _hist_window,
+    combine_hist_raw,
     hist_bpad,
     hist_group,
+    hist_ngroups,
     hist_sub,
     used_lanes,
 )
@@ -76,15 +89,16 @@ _INTERPRET = False
 
 def _fused_grow_kernel(
     scal_ref,  # SMEM [K, 8] i32: sbegin, cnt, feat, tbin, dl, nanb, iscat, 0
-    scales_ref,  # SMEM [2] f32: g_scale, h_scale (quantized mode; else 1s)
+    scales_ref,  # SMEM [2] f32: g_scale, h_scale (int8 mode; else 1s)
+    live_ref,  # SMEM [G] i32: per-plane-group live mask
     seg_any,  # ANY [LANES, n_pad] i16 (aliased to seg_out)
-    cat_ref,  # VMEM [1, bmt] f32 block — bin -> goes-left, one row/program
+    cat_ref,  # VMEM [1, bmt] f32 block — bin -> goes-left, one row/member
     tri_ref,  # VMEM [T, T] bf16 — tri[i, j] = (i <= j), cumsum-by-matmul
     gl_any,  # ANY [1, COL_ALIGN] f32 dummy (featpar never takes this path)
     seg_out,  # ANY [LANES, n_pad] i16 (aliased with seg_any)
     scratch_out,  # ANY [SUB_P, n_pad] i16 — partition right-stream spill
     dec_ref,  # SMEM [K, 4] i32: nl, nr, child_start, child_cnt per member
-    hist_ref,  # VMEM [1, 3, F * bpad] f32 block, one row per program
+    hist_ref,  # VMEM [1, 1, 8, group * bpad] f32 | i32 block (raw planes)
     in_stage,  # VMEM [SUB_P, T] i16 — partition staging
     out_stage,  # VMEM [SUB_P, T] i16
     stage_lo,  # VMEM [SUB_P, W] f32
@@ -93,7 +107,7 @@ def _fused_grow_kernel(
     rstage_hi,  # VMEM [SUB_P, W] f32
     gl_stage,  # VMEM [1, T] f32 (unused: use_gl is always False here)
     hist_stage,  # VMEM [SUB_H, TILE] i16 — histogram staging
-    acc,  # VMEM [8 | 4, F * bpad] f32 | i32
+    acc,  # VMEM [8, group * bpad] f32 | i32
     onehot,  # VMEM [TILE, group * bpad] bf16 | i8
     sem_in,
     sem_out,
@@ -113,61 +127,74 @@ def _fused_grow_kernel(
     read_via_input: bool = False,
 ):
     i = pl.program_id(0)
+    pt = pl.program_id(1)
     sbegin = scal_ref[i, 0]
     cnt = scal_ref[i, 1]
 
-    # ---- phase 1: in-place stable partition of this member's window
-    nl = _partition_window(
-        sbegin,
-        cnt,
-        scal_ref[i, 2],
-        scal_ref[i, 3],
-        scal_ref[i, 4],
-        scal_ref[i, 5],
-        scal_ref[i, 6],
-        seg_any,
-        seg_out,
-        scratch_out,
-        cat_ref,
-        tri_ref,
-        gl_any,
-        in_stage,
-        out_stage,
-        stage_lo,
-        stage_hi,
-        rstage_lo,
-        rstage_hi,
-        gl_stage,
-        sem_in,
-        sem_out,
-        sem_gl,
-        use_cat=use_cat,
-        sub=sub_p,
-        wide=wide,
-        bmt=bmt,
-        use_gl=False,
-        read_via_input=read_via_input,
-    )
+    # ---- phases 1+2 run ONCE per member, on its first plane program
+    @pl.when(pt == 0)
+    def _partition_and_elect():
+        # phase 1: in-place stable partition of this member's window
+        nl = _partition_window(
+            sbegin,
+            cnt,
+            scal_ref[i, 2],
+            scal_ref[i, 3],
+            scal_ref[i, 4],
+            scal_ref[i, 5],
+            scal_ref[i, 6],
+            seg_any,
+            seg_out,
+            scratch_out,
+            cat_ref,
+            tri_ref,
+            gl_any,
+            in_stage,
+            out_stage,
+            stage_lo,
+            stage_hi,
+            rstage_lo,
+            rstage_hi,
+            gl_stage,
+            sem_in,
+            sem_out,
+            sem_gl,
+            use_cat=use_cat,
+            sub=sub_p,
+            wide=wide,
+            bmt=bmt,
+            use_gl=False,
+            read_via_input=read_via_input,
+        )
+        # phase 2: local smaller-child election (single-host rule; the
+        # data-parallel psummed election cannot live mid-kernel, so that
+        # mode keeps the two-launch path — see module docstring).  The
+        # decision lands in the persistent SMEM output so this member's
+        # later plane programs can read it back.
+        nr = cnt - nl
+        left_smaller = nl <= nr
+        dec_ref[i, 0] = nl
+        dec_ref[i, 1] = nr
+        dec_ref[i, 2] = sbegin + jnp.where(left_smaller, 0, nl)
+        dec_ref[i, 3] = jnp.where(left_smaller, nl, nr)
 
-    # ---- phase 2: local smaller-child election (single-host rule; the
-    # data-parallel psummed election cannot live mid-kernel, so that mode
-    # keeps the two-launch path — see module docstring)
-    nr = cnt - nl
-    left_smaller = nl <= nr
-    child_start = sbegin + jnp.where(left_smaller, 0, nl)
-    child_cnt = jnp.where(left_smaller, nl, nr)
+    # ---- phase 3: this plane group's histogram over the JUST-partitioned
+    # rows; tiles come through the output alias so phase 1's writes (from
+    # this member's pt==0 program) are visible
+    child_start = dec_ref[i, 2]
+    child_cnt = dec_ref[i, 3]
 
-    # ---- phase 3: smaller-child histogram over the JUST-partitioned rows;
-    # tiles come through the output alias so phase 1's writes are visible
     def read_fn(base_col):
         return read_aliased_tile(
             seg_any, seg_out, hist_stage, sem_hist, base_col,
             read_via_input=read_via_input,
         )
 
-    row0, row1, row2 = _hist_window(
+    _hist_window(
         child_start,
         child_cnt,
+        pt,
+        live_ref[pt],
         read_fn,
         scales_ref,
         acc,
@@ -178,13 +205,7 @@ def _fused_grow_kernel(
         quantized=quantized,
         wide=wide,
     )
-    dec_ref[i, 0] = nl
-    dec_ref[i, 1] = nr
-    dec_ref[i, 2] = child_start
-    dec_ref[i, 3] = child_cnt
-    hist_ref[0, 0, :] = row0
-    hist_ref[0, 1, :] = row1
-    hist_ref[0, 2, :] = row2
+    hist_ref[0, 0] = acc[...]
 
 
 @functools.partial(
@@ -199,7 +220,8 @@ def fused_grow_step_pallas(
     scal: jnp.ndarray,  # [K, 8] i32 rows: sbegin, cnt, feat, tbin, dl,
     #                     nanb, iscat, 0 — one DISJOINT window per member
     catmask: jnp.ndarray,  # [K, bmt] f32 (bmt >= 256, 128-multiple)
-    scales: jnp.ndarray,  # [2] f32 grid scales (quantized; else 1s)
+    scales: jnp.ndarray,  # [2] f32 grid scales (int8 mode; else 1s)
+    live: jnp.ndarray,  # [G] i32 plane-group live mask
     *,
     f: int,
     num_bins: int,
@@ -214,8 +236,9 @@ def fused_grow_step_pallas(
 
     Returns (seg', dec[K, 4], hist[K, F, B, 3]) with dec rows
     (nl, nr, child_start, child_cnt).  Grid programs run sequentially on
-    the core, so the in-place aliasing and shared scratch stay safe
-    program-to-program (same argument as the batched partition kernel)."""
+    the core, so the in-place aliasing, the shared scratch, and the
+    dec-written-at-pt==0 handoff stay safe program-to-program (same
+    argument as the batched partition kernel)."""
     k = scal.shape[0]
     lanes = seg.shape[0]
     bmt = catmask.shape[1]
@@ -225,6 +248,8 @@ def fused_grow_step_pallas(
     sub_h = hist_sub(f, wide)
     bpad = hist_bpad(num_bins)
     group = hist_group(f, bpad)
+    ngroups = hist_ngroups(f, bpad)
+    acc_dtype = jnp.int32 if quantized else jnp.float32
     tri = jnp.tril(jnp.ones((T, T), jnp.bfloat16)).T  # tri[i, j] = i <= j
     gl_arr = jnp.zeros((1, COL_ALIGN), jnp.float32)
     kernel = functools.partial(
@@ -232,14 +257,17 @@ def fused_grow_step_pallas(
         sub_h=sub_h, wide=wide, bmt=bmt, bpad=bpad, group=group,
         quantized=quantized, read_via_input=read_via_input,
     )
-    seg_new, _, dec, hist = pl.pallas_call(
+    seg_new, _, dec, raw = pl.pallas_call(
         kernel,
-        grid=(k,),
+        grid=(k, ngroups),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec((1, bmt), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (1, bmt), lambda i, pt: (i, 0), memory_space=pltpu.VMEM
+            ),
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -248,7 +276,7 @@ def fused_grow_step_pallas(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(
-                (1, 3, f * bpad), lambda i: (i, 0, 0),
+                (1, 1, 8, group * bpad), lambda i, pt: (i, pt, 0, 0),
                 memory_space=pltpu.VMEM,
             ),
         ],
@@ -256,7 +284,7 @@ def fused_grow_step_pallas(
             jax.ShapeDtypeStruct((lanes, n_pad), jnp.int16),
             jax.ShapeDtypeStruct((sub_p, n_pad), jnp.int16),
             jax.ShapeDtypeStruct((k, 4), jnp.int32),
-            jax.ShapeDtypeStruct((k, 3, f * bpad), jnp.float32),
+            jax.ShapeDtypeStruct((k, ngroups, 8, group * bpad), acc_dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((sub_p, T), jnp.int16),
@@ -267,10 +295,7 @@ def fused_grow_step_pallas(
             pltpu.VMEM((sub_p, W), jnp.float32),
             pltpu.VMEM((1, T), jnp.float32),
             pltpu.VMEM((sub_h, TILE), jnp.int16),
-            pltpu.VMEM(
-                (4, f * bpad) if quantized else (8, f * bpad),
-                jnp.int32 if quantized else jnp.float32,
-            ),
+            pltpu.VMEM((8, group * bpad), acc_dtype),
             pltpu.VMEM(
                 (TILE, group * bpad), jnp.int8 if quantized else jnp.bfloat16
             ),
@@ -279,11 +304,14 @@ def fused_grow_step_pallas(
             pltpu.SemaphoreType.DMA,
             pltpu.SemaphoreType.DMA,
         ],
-        input_output_aliases={2: 0},
+        input_output_aliases={3: 0},
         interpret=interpret,
-    )(scal.astype(jnp.int32), scales.astype(jnp.float32), seg, catmask, tri,
-      gl_arr)
-    hist = hist.reshape(k, 3, f, bpad)[:, :, :, :num_bins].transpose(0, 2, 3, 1)
+    )(scal.astype(jnp.int32), scales.astype(jnp.float32),
+      live.astype(jnp.int32), seg, catmask, tri, gl_arr)
+    hist = combine_hist_raw(
+        raw, scales.astype(jnp.float32), f=f, bpad=bpad, group=group,
+        num_bins=num_bins, quantized=quantized,
+    )
     return seg_new, dec, hist
 
 
@@ -303,17 +331,20 @@ def fused_grow_step(
     n_pad: int,
     quant_scales=None,
     wide: bool = False,
+    live=None,  # [G] i32 plane-group live mask (None = all live)
 ):
     """Platform dispatch for the fused grow step.
 
-    TPU: one K-program Pallas launch (int8 grid accumulation when
-    ``quant_scales`` is given, like seg_hist).  Elsewhere: the XLA oracle
+    TPU: one (K, G)-program Pallas launch (2-digit int8 accumulation when
+    ``quant_scales`` is given — quantized training or the grower's default
+    hist accumulator, like seg_hist).  Elsewhere: the XLA oracle
     composition — sequential stable-sort partitions (disjoint windows make
-    the chain order-independent), the same local election, and the masked
-    reference histogram; exactly the computation the two-launch grower path
-    performs, so CPU training is byte-identical by construction.  The
-    ``_INTERPRET`` hook routes off-TPU calls through the interpret-mode
-    kernel instead, which is how tier-1 exercises the kernel without a TPU.
+    the chain order-independent), the same local election, and the
+    windowed/masked reference histogram (seg.seg_hist_batch_cpu, the exact
+    computation the two-launch grower path performs), so CPU training is
+    byte-identical by construction.  The ``_INTERPRET`` hook routes off-TPU
+    calls through the interpret-mode kernel instead, which is how tier-1
+    exercises the kernel without a TPU.
 
     Returns (seg', nl[K], nr[K], child_start[K], child_cnt[K],
     hist[K, F, B, 3])."""
@@ -324,7 +355,7 @@ def fused_grow_step(
     chaos.maybe_raise_pallas("fused_grow_step")
 
     from ..segpart import sort_partition_xla
-    from .seg import seg_hist_ref
+    from .seg import seg_hist_batch_cpu
 
     k = sbegins.shape[0]
     quantized = quant_scales is not None
@@ -333,9 +364,11 @@ def fused_grow_step(
         if quantized
         else jnp.ones((2,), jnp.float32)
     )
+    if live is None:
+        live = jnp.ones((hist_ngroups(f, hist_bpad(num_bins)),), jnp.int32)
 
     def _pallas(seg, sbegins, cnts, feats, tbins, dls, nanbs, iscats,
-                catmasks, scales, interpret=False):
+                catmasks, scales, live, interpret=False):
         bm = catmasks.shape[1]
         bmt = max(256, -(-bm // 128) * 128)  # cat-table width (wide bins)
         catm = jnp.zeros((k, bmt), jnp.float32)
@@ -346,15 +379,17 @@ def fused_grow_step(
             axis=1,
         ).astype(jnp.int32)
         seg_new, dec, hist = fused_grow_step_pallas(
-            seg, scal, catm, scales, f=f, num_bins=num_bins, n_pad=n_pad,
-            use_cat=bm > 1, quantized=quantized, wide=wide,
+            seg, scal, catm, scales, live, f=f, num_bins=num_bins,
+            n_pad=n_pad, use_cat=bm > 1, quantized=quantized, wide=wide,
             interpret=interpret,
         )
         return seg_new, dec[:, 0], dec[:, 1], dec[:, 2], dec[:, 3], hist
 
     def _xla(seg, sbegins, cnts, feats, tbins, dls, nanbs, iscats,
-             catmasks, _scales):
-        # the oracle ignores quant_scales, matching seg_hist's CPU behavior
+             catmasks, _scales, _live):
+        # the oracle ignores quant_scales/live, matching seg_hist's CPU
+        # behavior (f32 histograms of every plane — the byte-level
+        # reference the int8/plane-skip fast path is validated against)
         nls = []
         for i in range(k):
             seg, nl_i, _ = sort_partition_xla(
@@ -368,15 +403,15 @@ def fused_grow_step(
         left_smaller = nl <= nr
         child_start = sbegins + jnp.where(left_smaller, 0, nl)
         child_cnt = jnp.where(left_smaller, nl, nr)
-        hist = jax.vmap(
-            lambda s: seg_hist_ref(
-                seg, s, f=f, num_bins=num_bins, n_pad=n_pad, wide=wide
-            )
-        )(jnp.stack([child_start, child_cnt], axis=1).astype(jnp.int32))
+        hist = seg_hist_batch_cpu(
+            seg,
+            jnp.stack([child_start, child_cnt], axis=1).astype(jnp.int32),
+            f=f, num_bins=num_bins, n_pad=n_pad, wide=wide,
+        )
         return seg, nl, nr, child_start, child_cnt, hist
 
     args = (seg, sbegins, cnts, feats, tbins, dls, nanbs, iscats, catmasks,
-            scales)
+            scales, live)
     if jax.default_backend() != "tpu":
         if _INTERPRET:
             return _pallas(*args, interpret=True)

@@ -14,14 +14,18 @@ kernel instead:
   * builds the one-hot for a GROUP of features at once into a VMEM scratch
     ``[TR, FG*B_pad]`` via per-feature iota compares (VPU work, one [TR,B]
     block store per feature — no MXU involvement);
-  * contracts ``ghc6[TR, 6] x onehot[TR, FG*B_pad] -> [6, FG*B_pad]`` — the
+  * contracts ``ghc8[TR, 8] x onehot[TR, FG*B_pad] -> [8, FG*B_pad]`` — the
     contraction (TR) and lane (FG*B_pad ~ 2048) dims are both MXU-sized, so
     one wide matmul replaces FG narrow ones;
-  * ghc6 packs (g, h, count) split hi/lo into two bf16 terms each: the
-    one-hot factor is exact in bf16 and the residual carries ~8 extra
-    mantissa bits, giving ~2^-16 relative accuracy per element at full MXU
-    speed (ADVICE r1: this is NOT bit-exact f32 — the residual is itself
-    re-rounded to bf16; oracle tests bound the error).
+  * ghc8 packs (g, h) as a THREE-term bf16 split plus count hi/lo (the
+    one-hot factor is exact in bf16 and the residuals carry ~16 extra
+    mantissa bits — the 8-row operand is exactly the MXU's output sublane
+    tile, so the extra residual rows are free; histogram engine v2 made
+    the third term and the 8-row layout the default);
+  * emits the RAW [8, F*bpad] accumulator planes — 8 sublanes is the
+    f32/i32 VMEM tile height (GL005-clean, no baselined layout needed) —
+    and the term recombine runs OUTSIDE the kernel in plain XLA
+    (seg.combine_hist_raw, shared with the seg kernels).
 
 HBM traffic is exactly bins + ghc read once; the VMEM-resident accumulation
 mirrors the CUDA kernel's shared-memory histogram.
@@ -77,10 +81,17 @@ def _hist_kernel(
     ghc_t = ghc_ref[...]  # [TR, 3] f32 (mask already folded in)
     bins_t = bins_ref[...].astype(jnp.int32)  # [TR, F]
     tr = ghc_t.shape[0]
-    # hi/lo bf16 split packed as one [TR, 6] operand -> single wide matmul
+    # THREE-term bf16 split of g/h (count's residual is zero) packed as one
+    # [TR, 8] operand -> single wide matmul.  Row convention (shared with
+    # seg._hist_window / combine_hist_raw): 0 g_hi, 1 h_hi, 2 count,
+    # 3 g_lo, 4 h_lo, 5 c_lo, 6 g_lo2, 7 h_lo2.
     ghc_hi = ghc_t.astype(jnp.bfloat16)
-    ghc_lo = (ghc_t - ghc_hi.astype(jnp.float32)).astype(jnp.bfloat16)
-    ghc6 = jnp.concatenate([ghc_hi, ghc_lo], axis=1)  # [TR, 6]
+    r1 = ghc_t - ghc_hi.astype(jnp.float32)
+    ghc_lo = r1.astype(jnp.bfloat16)
+    ghc_lo2 = (r1[:, :2] - ghc_lo[:, :2].astype(jnp.float32)).astype(
+        jnp.bfloat16
+    )
+    ghc8 = jnp.concatenate([ghc_hi, ghc_lo, ghc_lo2], axis=1)  # [TR, 8]
 
     iota = jax.lax.broadcasted_iota(jnp.int32, (tr, bpad), 1)
     ngroups = (num_features + group - 1) // group
@@ -96,24 +107,24 @@ def _hist_kernel(
             onehot_ref[:, nf * bpad :] = jnp.zeros(
                 (tr, (group - nf) * bpad), jnp.bfloat16
             )
-        part6 = jax.lax.dot_general(
-            ghc6,
+        part = jax.lax.dot_general(
+            ghc8,
             onehot_ref[...],
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [6, FG*bpad]
+        )  # [8, FG*bpad]
         width = nf * bpad  # tail group writes only its live columns
-        out_ref[:, base * bpad : base * bpad + width] += (
-            part6[:3, :width] + part6[3:, :width]
-        )
+        out_ref[:, base * bpad : base * bpad + width] += part[:, :width]
 
 
 def tile_pallas_histogram(
     bins, ghc, num_bins, kernel_body, scratch_dtype, out_dtype, interpret
 ):
-    """Shared tile/pad/group machinery for the histogram kernels (bf16 hi/lo
-    and int8): rows tiled into VMEM, features grouped to ~_TARGET_LANES
-    lanes, accumulation across row tiles. Returns ([3, F*bpad], bpad)."""
+    """Shared tile/pad/group machinery for the histogram kernels (bf16
+    3-term and 2-digit int8): rows tiled into VMEM, features grouped to
+    ~_TARGET_LANES lanes, accumulation across row tiles.  Returns the RAW
+    accumulator planes ([8, F*bpad], bpad) — callers recombine outside the
+    kernel via seg.combine_hist_raw."""
     n, f = bins.shape
     bpad = _round_up(max(num_bins, 1), 128)
     group = min(max(1, _TARGET_LANES // bpad), f)
@@ -133,8 +144,8 @@ def tile_pallas_histogram(
             pl.BlockSpec((tr, f), lambda i: (i, 0)),
             pl.BlockSpec((tr, ghc.shape[1]), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((3, f * bpad), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((3, f * bpad), out_dtype),
+        out_specs=pl.BlockSpec((8, f * bpad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, f * bpad), out_dtype),
         scratch_shapes=[pltpu.VMEM((tr, group * bpad), scratch_dtype)],
         interpret=interpret,
         compiler_params=(
@@ -163,9 +174,15 @@ def histogram_pallas(
         from ..histogram import leaf_histogram_segment
 
         return leaf_histogram_segment(bins, grad, hess, mask, num_bins)
+    from .seg import combine_hist_raw
+
     ghc = jnp.stack([grad * mask, hess * mask, mask], axis=1)  # [N, 3]
     out, bpad = tile_pallas_histogram(
         bins, ghc, num_bins, _hist_kernel, jnp.bfloat16, jnp.float32, interpret
     )
-    # [3, F*bpad] -> [F, B, 3]
-    return out.reshape(3, f, bpad)[:, :, :num_bins].transpose(1, 2, 0)
+    # raw [8, F*bpad] planes -> recombined [F, B, 3] outside the kernel
+    return combine_hist_raw(
+        out[None, None],
+        jnp.ones((2,), jnp.float32),
+        f=f, bpad=bpad, group=f, num_bins=num_bins, quantized=False,
+    )[0]

@@ -6,15 +6,19 @@ quantized training enables in the reference
 PACKED_HIST_BIN_T int paths).
 
 With ``use_quantized_grad`` the per-row (g, h) are small integers times a
-scale (ops/quantize.py). This kernel recovers the int8 values, one-hots the
-bins as int8, and contracts int8 x int8 -> int32 on the MXU — EXACT integer
-accumulation (no bf16 hi/lo split needed) at twice the bf16 MXU rate. The
-dequantized [F, B, 3] f32 histogram comes out multiplied by the scales, so
-it drops into the existing split search unchanged.
+scale (ops/quantize.py). This kernel recovers the grid integers as a
+2-DIGIT int8 pair (q = hi*128 + lo, |hi| <= 127, |lo| <= 64 — histogram
+engine v2's shared convention, see seg.py), one-hots the bins as int8, and
+contracts int8 x int8 -> int32 on the MXU — EXACT integer accumulation on
+the quantized grid (no bf16 hi/lo split needed) at twice the bf16 MXU
+rate.  The kernel emits the RAW [8, F*bpad] i32 accumulator planes (the
+i32 VMEM tile height — GL005-clean); the digit recombine/dequantize runs
+outside in seg.combine_hist_raw, so the [F, B, 3] f32 histogram drops into
+the existing split search unchanged.
 
 Selected explicitly via ``hist_method='pallas_int8'`` (grower params); the
-'auto' path keeps the bf16 hi/lo kernel until the int8 lowering is validated
-on real hardware — interpret-mode tests pin numerics meanwhile.
+seg fast path engages the same 2-digit accumulation by DEFAULT via
+``hist_acc`` (ops/grower.py), with an f32 re-accumulate for near ties.
 """
 
 from __future__ import annotations
@@ -33,12 +37,13 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 from .histogram import tile_pallas_histogram
+from .seg import QMAX, combine_hist_raw
 
 
 def _hist_kernel_int8(
     bins_ref,
-    ghc_ref,  # [TR, 3] int8 (already masked)
-    out_ref,  # [3, F*bpad] int32
+    ghc_ref,  # [TR, 8] int8 2-digit rows (already masked; built outside)
+    out_ref,  # [8, F*bpad] int32 — RAW accumulator planes
     onehot_ref,  # [TR, FG*bpad] int8 scratch
     *,
     num_features: int,
@@ -51,7 +56,7 @@ def _hist_kernel_int8(
     def _init():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    ghc_t = ghc_ref[...]  # [TR, 3] int8
+    ghc_t = ghc_ref[...]  # [TR, 8] int8
     bins_t = bins_ref[...].astype(jnp.int32)
     tr = ghc_t.shape[0]
     iota = jax.lax.broadcasted_iota(jnp.int32, (tr, bpad), 1)
@@ -73,9 +78,29 @@ def _hist_kernel_int8(
             onehot_ref[...],
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.int32,
-        )  # [3, FG*bpad] int32 — exact
+        )  # [8, FG*bpad] int32 — exact
         width = nf * bpad
         out_ref[:, base * bpad : base * bpad + width] += part[:, :width]
+
+
+def int8_digit_rows(grad, hess, mask, g_scale, h_scale):
+    """[N, 8] int8 2-digit stat rows (g_hi, h_hi, m, g_lo, h_lo, 0, 0, 0):
+    q = round(stat/scale) clipped to +-QMAX, split q = hi*128 + lo with the
+    +64 bias so both digits are int8-safe (|hi| <= 127, |lo| <= 64).  On
+    the quantized-training grid (|q| <= 127) the split is exact."""
+    n = grad.shape[0]
+    m = (mask > 0).astype(jnp.int32)
+    qg = jnp.clip(jnp.round(grad / g_scale), -QMAX, QMAX).astype(jnp.int32) * m
+    qh = jnp.clip(jnp.round(hess / h_scale), -QMAX, QMAX).astype(jnp.int32) * m
+    g_hi = (qg + 64) >> 7
+    g_lo = qg - (g_hi << 7)
+    h_hi = (qh + 64) >> 7
+    h_lo = qh - (h_hi << 7)
+    return jnp.stack(
+        [g_hi, h_hi, m, g_lo, h_lo, jnp.zeros_like(m), jnp.zeros_like(m),
+         jnp.zeros_like(m)],
+        axis=1,
+    ).astype(jnp.int8)
 
 
 @functools.partial(
@@ -91,7 +116,7 @@ def histogram_pallas_int8(
     h_scale: jnp.ndarray,  # scalar f32
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """[F, B, 3] (sum_g, sum_h, count) from int8 MXU accumulation."""
+    """[F, B, 3] (sum_g, sum_h, count) from 2-digit int8 MXU accumulation."""
     n, f = bins.shape
     if f == 0:
         return jnp.zeros((0, num_bins, 3), jnp.float32)
@@ -99,17 +124,14 @@ def histogram_pallas_int8(
         from ..histogram import leaf_histogram_segment
 
         return leaf_histogram_segment(bins, grad, hess, mask, num_bins)
-    m8 = mask.astype(jnp.int8)
-    # grid integers are bounded by num_grad_quant_bins (<= 127, enforced by
-    # quantize_gradients); the clip guards foreign inputs from int8 wrap
-    qg = jnp.clip(jnp.round(grad / g_scale), -127, 127).astype(jnp.int8) * m8
-    qh = jnp.clip(jnp.round(hess / h_scale), -127, 127).astype(jnp.int8) * m8
-    ghc = jnp.stack([qg, qh, m8], axis=1)  # [N, 3] int8
+    ghc = int8_digit_rows(grad, hess, mask, g_scale, h_scale)
     out, bpad = tile_pallas_histogram(
         bins, ghc, num_bins, _hist_kernel_int8, jnp.int8, jnp.int32, interpret
     )
-    hist_i = out.reshape(3, f, bpad)[:, :, :num_bins].transpose(1, 2, 0)
     scales = jnp.stack(
-        [g_scale.astype(jnp.float32), h_scale.astype(jnp.float32), jnp.float32(1.0)]
+        [g_scale.astype(jnp.float32), h_scale.astype(jnp.float32)]
     )
-    return hist_i.astype(jnp.float32) * scales
+    return combine_hist_raw(
+        out[None, None], scales, f=f, bpad=bpad, group=f, num_bins=num_bins,
+        quantized=True,
+    )[0]

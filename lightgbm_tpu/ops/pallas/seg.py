@@ -34,7 +34,37 @@ histogram kernel DMAs [sub, T] column tiles covering only the used planes
 (minor-dim starts 128-aligned, misalignment folded into the validity mask)
 and transposes each tile in VMEM.
 
-Precision contract (ADVICE r2, tightened r3): the histogram accumulates
+Histogram engine v2 (this file's kernel contract):
+
+  * The kernel grid is PLANE-TILED: ``(K, G)`` where K is the frontier
+    batch and G = ceil(F / group) is the number of feature-plane groups.
+    Each program accumulates ONE group's [8, group*bpad] block, so the
+    per-program VMEM scratch is O(group*bpad) instead of O(F*bpad) — wide
+    (F, max_bin) shapes that previously failed ``seg_vmem_ok`` now fit.
+    The trade: every program re-streams the window's stat planes (G-fold
+    redundant DMA traffic); the one-hot matmul dominates per tile, so the
+    extra DMA hides under compute for all shapes the gate admits.
+  * Kernels emit RAW 8-sublane accumulator planes (f32 for the bf16 path,
+    i32 for the int8 paths); the digit-recombine/dequantize runs OUTSIDE
+    the kernel in plain XLA.  8 is exactly the f32/i32 VMEM tile height,
+    which retires the three GL005 sublane-3 layouts the previous
+    ``[3, F*bpad]`` outputs needed baselined.
+  * int8 accumulation is 2-DIGIT: q = round(stat/scale) clipped to
+    ±QMAX (127*128), split as q = hi*128 + lo with |hi| <= 127 and
+    |lo| <= 64 — both int8-safe — accumulated as int8 x int8 -> i32 on the
+    MXU and recombined outside as (S_hi*128 + S_lo)*scale.  For
+    quantized-gradient training (|q| <= 127 so hi in {-1,0,1}) this is
+    EXACT like the old 1-digit path; as the grower's default histogram
+    accumulator ("hist_acc") it carries ~14 bits per addend (relative
+    quantization step 1/16256 ~= 6e-5), and near-tie split candidates are
+    re-accumulated in the bf16/f32 path before any structure decision
+    (ops/grower.py near_tie_tol).
+  * Dead plane groups are SKIPPED: a [G] live mask (SMEM) zeroes a
+    program's tile loop, so feature_fraction / EFB-bundled workloads pay
+    only for live bundles.  Group 0 is always live (the grower reads
+    feature 0's row as the totals row).
+
+Precision contract (ADVICE r2, tightened r3): the bf16 path accumulates
 grad/hess as a THREE-TERM bf16 split (~26 mantissa bits per addend — i.e.
 f32-accurate for all practical gradients, the extra rows ride the matmul's
 6->8 sublane padding for free) with f32 accumulators, vs double histograms
@@ -64,6 +94,18 @@ TILE = 512  # rows per DMA tile in seg_hist
 N_STAT_LANES = 7
 MAX_SEG_BIN = 256  # byte-packed bins: values must fit u8 (narrow layout)
 MAX_WIDE_BIN = 65536  # u16 planes (wide layout, max_bin > 256)
+
+# 2-digit int8 quantization ceiling: q in [-QMAX, QMAX] splits as
+# q = hi*128 + lo with |hi| <= 127, |lo| <= 64 — both int8-safe.
+QMAX = 127 * 128
+
+# Test hook: route the seg histogram through the Pallas interpret-mode
+# kernels even off-TPU (tools/run_tests.sh int8 smoke).  Read at TRACE time,
+# like grow_step._INTERPRET.  This is also the grower's signal that the
+# int8-default histogram accumulator may engage off-TPU (the CPU fallback
+# ignores hist_acc — its masked/windowed reference path is the byte-level
+# oracle and stays f32).
+_INTERPRET = False
 
 
 def bin_lanes(f: int, wide: bool = False) -> int:
@@ -97,13 +139,15 @@ SEG_VMEM_BUDGET = 12 * 1024 * 1024  # scratch ceiling for the seg kernels
 def seg_vmem_ok(f: int, num_bins: int, has_cat: bool = False) -> bool:
     """Whether the seg kernels' VMEM scratch fits at this (F, max_bin).
 
-    seg_hist: acc [8, F*bpad] f32 + out [3, F*bpad] f32 + onehot
-    [TILE, ~max(bpad, 2048)] bf16 + the staging tile.  The categorical
-    partition additionally builds a [bmt, 256] one-hot (bf16).  Narrow
-    configs (max_bin <= 256) always fit; wide ones must be checked before
-    auto-selecting seg mode."""
-    bpad = (max(num_bins, 1) + 127) // 128 * 128
-    hist = 11 * f * bpad * 4 + TILE * max(bpad, 2048) * 2 + 128 * TILE * 2
+    The plane-tiled grid makes the histogram footprint O(group*bpad) per
+    program — acc [8, group*bpad] + the matching out block + onehot
+    [TILE, group*bpad] + the staging tile — independent of F.  The
+    categorical partition additionally builds a [bmt, 256] one-hot (bf16)
+    and is unchanged by the plane tiling, so it still binds wide-bin
+    categorical configs."""
+    bpad = hist_bpad(num_bins)
+    gb = hist_group(f, bpad) * bpad
+    hist = 2 * 8 * gb * 4 + TILE * gb * 2 + 128 * TILE * 2
     part = (max(256, bpad) * 256 * 2) if has_cat else 0
     return max(hist, part) <= SEG_VMEM_BUDGET
 
@@ -219,6 +263,12 @@ def hist_group(f: int, bpad: int) -> int:
     return min(max(1, _TARGET_LANES // bpad), f)
 
 
+def hist_ngroups(f: int, bpad: int) -> int:
+    """Feature-plane groups — the second grid dimension of the plane-tiled
+    hist kernels (each program accumulates exactly one group's block)."""
+    return -(-f // hist_group(f, bpad))
+
+
 def hist_sub(f: int, wide: bool) -> int:
     """DMA sublanes: only the used planes (bins + stats), padded to an i16
     sublane multiple — 32 planes at F=28, 4x less tile traffic than the
@@ -229,9 +279,11 @@ def hist_sub(f: int, wide: bool) -> int:
 def _hist_window(
     start,  # scalar i32 — window begin (data-row index)
     cnt,  # scalar i32 — window rows (0 = all-zero histogram)
+    pt,  # scalar i32 — this program's feature-plane group (grid dim 1)
+    live,  # scalar i32 — 0 skips the tile loop entirely (dead plane group)
     read_fn,  # (base_col: i32) -> [SUB, TILE] u16-in-i32 staged tile
     scales_ref,  # SMEM [2] f32: g_scale, h_scale (quantized mode; else 1s)
-    acc,  # VMEM [8 | 4, F * bpad] f32 | i32
+    acc,  # VMEM [8, group * bpad] f32 | i32 — RAW accumulator planes
     onehot,  # VMEM [TILE, group * bpad] bf16 | i8
     *,
     f: int,
@@ -245,10 +297,17 @@ def _hist_window(
     can run it over just-partitioned data — its ``read_fn`` reads tiles
     through the output alias; see partition.read_aliased_tile).
 
-    Returns (g_row, h_row, count_row), each [F * bpad] f32."""
+    Fills ``acc`` with the program's RAW [8, group*bpad] accumulator block
+    for plane group ``pt``; the caller copies it to the output and the
+    digit recombine runs outside the kernel (``combine_hist_raw``).  Row
+    convention (both dtypes): 0 g_hi, 1 h_hi, 2 count, 3 g_lo, 4 h_lo,
+    5 zero, 6 g_lo2, 7 h_lo2 (int8 leaves 5-7 zero)."""
     abegin = (start // COL_ALIGN) * COL_ALIGN
     off = start - abegin
     nt = (off + cnt + TILE - 1) // TILE
+    # dead plane group (feature_fraction / EFB bundling): zero trips — the
+    # output block stays zero and the grower never reads those rows
+    nt = jnp.where(live != 0, nt, 0)
     acc[...] = jnp.zeros_like(acc)
     # hoisted out of the tile loop: reciprocal-multiply instead of two
     # full-width divides per tile (quotients round to integers, so the
@@ -258,6 +317,7 @@ def _hist_window(
     GLO, GHI, HLO, HHI, M, _, _ = stat_lanes(f, wide)
     iota_rows = jax.lax.broadcasted_iota(jnp.int32, (TILE, 1), 0)[:, 0]
     iota_b = jax.lax.broadcasted_iota(jnp.int32, (TILE, bpad), 1)
+    ngroups = hist_ngroups(f, bpad)
 
     def body(t, _):
         # transpose the plane-major tile to row-major for the one-hot matmul
@@ -273,109 +333,147 @@ def _hist_window(
         m = xu[:, M].astype(jnp.float32) * valid
         gm = g * m
         hm = h * m
-        def _accumulate(stats_mat, oh_dtype, pref):
-            """Shared group loop: build the one-hot block per feature group
-            and contract rows on the MXU into acc."""
-            ngroups = (f + group - 1) // group
-            for gi in range(ngroups):
-                basef = gi * group
-                nf = min(group, f - basef)
-                for j in range(nf):
-                    fj = basef + j
-                    if wide:
-                        col = xu[:, fj]  # u16 plane per feature
-                    else:
-                        col = (xu[:, fj >> 1] >> (8 * (fj & 1))) & 0xFF
-                    onehot[:, j * bpad : (j + 1) * bpad] = (
-                        col[:, None] == iota_b
-                    ).astype(oh_dtype)
-                if nf < group:
-                    onehot[:, nf * bpad :] = jnp.zeros(
-                        (TILE, (group - nf) * bpad), oh_dtype
-                    )
-                part = jax.lax.dot_general(
-                    stats_mat,
-                    onehot[...],
-                    dimension_numbers=(((0,), (0,)), ((), ())),
-                    preferred_element_type=pref,
-                )
-                width = nf * bpad
-                acc[:, basef * bpad : basef * bpad + width] += part[:, :width]
-
         if quantized:
-            # quantized-gradient training: gm/hm are integer multiples of
-            # the grid scales (gradient_discretizer.cpp:70) — accumulate
-            # the small integers EXACTLY in i32 on the int8 MXU path (2x
-            # bf16 throughput) and dequantize once at the end.  The clip
-            # guards foreign (off-grid) inputs from int8 wrap, like
-            # histogram_int8.py.  Exactness bound: per-bin integer sums
-            # stay exact up to 2^31/|q|max rows per bin (~16.9M at the
-            # |q|=127 extreme, ~1e9 at the default 4-bin grid) and the f32
-            # dequantize is exact below 2^24 — beyond that the path is
-            # approximate like the bf16 one, not wrong (clip keeps
-            # per-addend magnitudes sane).
-            qg = jnp.clip(jnp.round(gm * inv_g), -127, 127).astype(jnp.int8)
-            qh = jnp.clip(jnp.round(hm * inv_h), -127, 127).astype(jnp.int8)
-            ghcq = jnp.concatenate(
+            # int8 MXU path (2x bf16 throughput), 2-DIGIT: q is clipped to
+            # +-QMAX and split q = hi*128 + lo (|hi| <= 127, |lo| <= 64 —
+            # the +64 bias makes the shift round-to-nearest so the low
+            # digit stays in int8 range).  Quantized-gradient training
+            # (gradient_discretizer.cpp:70 grid, |q| <= 127 so hi is just
+            # the sign spill) stays EXACT like the old 1-digit path: per-
+            # bin integer sums are exact to 2^31/192 rows (~11M at the
+            # |q|=127 extreme) in i32 and the f32 recombine is exact below
+            # 2^24.  As the default hist accumulator the grid carries ~14
+            # bits per addend — near ties are re-accumulated in bf16/f32
+            # by the grower before any structure decision.
+            qg = jnp.clip(jnp.round(gm * inv_g), -QMAX, QMAX).astype(jnp.int32)
+            qh = jnp.clip(jnp.round(hm * inv_h), -QMAX, QMAX).astype(jnp.int32)
+            g_hi = (qg + 64) >> 7
+            g_lo = qg - (g_hi << 7)
+            h_hi = (qh + 64) >> 7
+            h_lo = qh - (h_hi << 7)
+            # 5 live rows pad to the i32 output tile's 8 sublanes anyway,
+            # so the zero rows are free MXU work (same argument as the
+            # bf16 path's 6 -> 8 padding)
+            stats = jnp.concatenate(
                 [
-                    qg[:, None],
-                    qh[:, None],
+                    g_hi.astype(jnp.int8)[:, None],
+                    h_hi.astype(jnp.int8)[:, None],
                     m.astype(jnp.int8)[:, None],
-                    jnp.zeros((TILE, 1), jnp.int8),
+                    g_lo.astype(jnp.int8)[:, None],
+                    h_lo.astype(jnp.int8)[:, None],
+                    jnp.zeros((TILE, 3), jnp.int8),
                 ],
                 axis=1,
-            )  # [TILE, 4]
-            _accumulate(ghcq, jnp.int8, jnp.int32)
-            return 0
-        # THREE-term bf16 split of each f32 addend (~26 mantissa bits) —
-        # the matmul M-dim pads 6 -> 8 sublanes anyway, so the two extra
-        # residual rows are free MXU work (ADVICE r2: tighter precision
-        # contract at zero cost)
-        g_hi = gm.astype(jnp.bfloat16)
-        g_r1 = gm - g_hi.astype(jnp.float32)
-        g_lo = g_r1.astype(jnp.bfloat16)
-        g_lo2 = (g_r1 - g_lo.astype(jnp.float32)).astype(jnp.bfloat16)
-        h_hi = hm.astype(jnp.bfloat16)
-        h_r1 = hm - h_hi.astype(jnp.float32)
-        h_lo = h_r1.astype(jnp.bfloat16)
-        h_lo2 = (h_r1 - h_lo.astype(jnp.float32)).astype(jnp.bfloat16)
-        ghc8 = jnp.concatenate(
-            [
-                g_hi[:, None],
-                h_hi[:, None],
-                m.astype(jnp.bfloat16)[:, None],
-                g_lo[:, None],
-                h_lo[:, None],
-                jnp.zeros((TILE, 1), jnp.bfloat16),
-                g_lo2[:, None],
-                h_lo2[:, None],
-            ],
-            axis=1,
-        )  # [TILE, 8]
-        _accumulate(ghc8, jnp.bfloat16, jnp.float32)
+            )  # [TILE, 8]
+            oh_dtype, pref = jnp.int8, jnp.int32
+        else:
+            # THREE-term bf16 split of each f32 addend (~26 mantissa bits)
+            # — the matmul M-dim pads 6 -> 8 sublanes anyway, so the two
+            # extra residual rows are free MXU work (ADVICE r2: tighter
+            # precision contract at zero cost)
+            g_hi = gm.astype(jnp.bfloat16)
+            g_r1 = gm - g_hi.astype(jnp.float32)
+            g_lo = g_r1.astype(jnp.bfloat16)
+            g_lo2 = (g_r1 - g_lo.astype(jnp.float32)).astype(jnp.bfloat16)
+            h_hi = hm.astype(jnp.bfloat16)
+            h_r1 = hm - h_hi.astype(jnp.float32)
+            h_lo = h_r1.astype(jnp.bfloat16)
+            h_lo2 = (h_r1 - h_lo.astype(jnp.float32)).astype(jnp.bfloat16)
+            stats = jnp.concatenate(
+                [
+                    g_hi[:, None],
+                    h_hi[:, None],
+                    m.astype(jnp.bfloat16)[:, None],
+                    g_lo[:, None],
+                    h_lo[:, None],
+                    jnp.zeros((TILE, 1), jnp.bfloat16),
+                    g_lo2[:, None],
+                    h_lo2[:, None],
+                ],
+                axis=1,
+            )  # [TILE, 8]
+            oh_dtype, pref = jnp.bfloat16, jnp.float32
+
+        def build_onehot(gi):
+            """One-hot block for STATIC plane group gi (feature columns are
+            compile-time plane/byte selects, hence the unrolled dispatch on
+            the dynamic program id below)."""
+            basef = gi * group
+            nf = min(group, f - basef)
+            for j in range(nf):
+                fj = basef + j
+                if wide:
+                    col = xu[:, fj]  # u16 plane per feature
+                else:
+                    col = (xu[:, fj >> 1] >> (8 * (fj & 1))) & 0xFF
+                onehot[:, j * bpad : (j + 1) * bpad] = (
+                    col[:, None] == iota_b
+                ).astype(oh_dtype)
+            if nf < group:
+                onehot[:, nf * bpad :] = jnp.zeros(
+                    (TILE, (group - nf) * bpad), oh_dtype
+                )
+
+        if ngroups == 1:
+            build_onehot(0)
+        else:
+            for gi in range(ngroups):
+                pl.when(pt == gi)(functools.partial(build_onehot, gi))
+        # ONE matmul per tile per program — the plane-tiled grid moves the
+        # old per-program group loop onto grid dim 1
+        part = jax.lax.dot_general(
+            stats,
+            onehot[...],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=pref,
+        )
+        acc[...] += part
         return 0
 
     lax.fori_loop(0, nt, body, 0)
+
+
+def combine_hist_raw(
+    raw: jnp.ndarray,  # [K, G, 8, group * bpad] i32 | f32 raw planes
+    scales: jnp.ndarray,  # [2] f32 (quantized; ignored otherwise)
+    *,
+    f: int,
+    bpad: int,
+    group: int,
+    num_bins: int,
+    quantized: bool,
+) -> jnp.ndarray:
+    """Recombine the kernels' raw 8-sublane accumulator planes into the
+    [K, F, B, 3] (g, h, count) histogram — plain XLA, outside the kernel.
+
+    int8: g = (S_hi*128 + S_lo)*g_scale (the *128 is a f32 exponent bump,
+    exact; the digit sum is exact below 2^24 — same bound as the old
+    in-kernel dequantize).  bf16: the same 3-term sums the kernel used to
+    do in its epilogue."""
+    k, ngroups = raw.shape[0], raw.shape[1]
+    a = raw.reshape(k, ngroups, 8, group, bpad)
+    a = a.transpose(0, 2, 1, 3, 4).reshape(k, 8, ngroups * group, bpad)
+    a = a[:, :, :f, :]
     if quantized:
-        row0 = acc[0, :].astype(jnp.float32) * scales_ref[0]
-        row1 = acc[1, :].astype(jnp.float32) * scales_ref[1]
-        row2 = acc[2, :].astype(jnp.float32)
+        af = a.astype(jnp.float32)
+        g = (af[:, 0] * 128.0 + af[:, 3]) * scales[0]
+        h = (af[:, 1] * 128.0 + af[:, 4]) * scales[1]
+        c = af[:, 2]
     else:
-        # rows: 0 g_hi, 1 h_hi, 2 count, 3 g_lo, 4 h_lo, 5 zero,
-        # 6 g_lo2, 7 h_lo2
-        row0 = acc[0, :] + acc[3, :] + acc[6, :]
-        row1 = acc[1, :] + acc[4, :] + acc[7, :]
-        row2 = acc[2, :] + acc[5, :]
-    return row0, row1, row2
+        g = a[:, 0] + a[:, 3] + a[:, 6]
+        h = a[:, 1] + a[:, 4] + a[:, 7]
+        c = a[:, 2] + a[:, 5]
+    return jnp.stack([g, h, c], axis=-1)[:, :, :num_bins, :]
 
 
 def _seg_hist_kernel(
-    scal_ref,  # SMEM [K, 2] i32: (start, cnt) per grid program (K=1 serial)
+    scal_ref,  # SMEM [K, 2] i32: (start, cnt) per batch member
     scales_ref,  # SMEM [2] f32: g_scale, h_scale (quantized mode; else 1s)
+    live_ref,  # SMEM [G] i32: per-plane-group live mask
     seg_any,  # ANY [LANES, n_pad] i16 (plane-major)
-    out_ref,  # VMEM [3, F * bpad] f32 (batched: [1, 3, F * bpad] block)
+    out_ref,  # VMEM [1, 1, 8, group * bpad] f32 | i32 block (raw planes)
     in_stage,  # VMEM [SUB, TILE] i16 — only the used planes are DMA'd
-    acc,  # VMEM [8 | 4, F * bpad] f32 | i32
+    acc,  # VMEM [8, group * bpad] f32 | i32
     onehot,  # VMEM [TILE, group * bpad] bf16 | i8
     sem_in,
     *,
@@ -385,9 +483,9 @@ def _seg_hist_kernel(
     sub: int,
     quantized: bool,
     wide: bool,
-    batched: bool = False,
 ):
     i = pl.program_id(0)
+    pt = pl.program_id(1)
 
     def read_fn(base_col):
         dma = pltpu.make_async_copy(
@@ -402,9 +500,11 @@ def _seg_hist_kernel(
         dma.wait()
         return in_stage[...].astype(jnp.int32) & 0xFFFF
 
-    row0, row1, row2 = _hist_window(
+    _hist_window(
         scal_ref[i, 0],
         scal_ref[i, 1],
+        pt,
+        live_ref[pt],
         read_fn,
         scales_ref,
         acc,
@@ -415,24 +515,14 @@ def _seg_hist_kernel(
         quantized=quantized,
         wide=wide,
     )
-    if batched:
-        out_ref[0, 0, :] = row0
-        out_ref[0, 1, :] = row1
-        out_ref[0, 2, :] = row2
-    else:
-        out_ref[0, :] = row0
-        out_ref[1, :] = row1
-        out_ref[2, :] = row2
+    out_ref[0, 0] = acc[...]
 
 
-@functools.partial(
-    instrumented_jit,
-    static_argnames=("f", "num_bins", "n_pad", "quantized", "wide", "interpret"),
-)
 def seg_hist_pallas(
     seg: jnp.ndarray,
     scal: jnp.ndarray,  # [2] i32: start, cnt
     scales: Optional[jnp.ndarray] = None,  # [2] f32 grid scales (quantized)
+    live: Optional[jnp.ndarray] = None,  # [G] i32 plane-group live mask
     *,
     f: int,
     num_bins: int,
@@ -443,41 +533,16 @@ def seg_hist_pallas(
 ) -> jnp.ndarray:
     """Histogram [F, B, 3] (g, h, count) of packed rows [start, start+cnt).
 
-    ``quantized=True`` (requires ``scales``): integer grid accumulation on
-    the int8 MXU path — exact and ~2x the bf16 throughput."""
-    bpad = hist_bpad(num_bins)
-    group = hist_group(f, bpad)
-    sub = hist_sub(f, wide)
-    kernel = functools.partial(
-        _seg_hist_kernel, f=f, bpad=bpad, group=group, sub=sub,
-        quantized=quantized, wide=wide,
-    )
-    if scales is None:
-        scales = jnp.ones((2,), jnp.float32)
-    out = pl.pallas_call(
-        kernel,
-        grid=(1,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((3, f * bpad), jnp.float32),
-        scratch_shapes=[
-            pltpu.VMEM((sub, TILE), jnp.int16),
-            pltpu.VMEM(
-                (4, f * bpad) if quantized else (8, f * bpad),
-                jnp.int32 if quantized else jnp.float32,
-            ),
-            pltpu.VMEM(
-                (TILE, group * bpad), jnp.int8 if quantized else jnp.bfloat16
-            ),
-            pltpu.SemaphoreType.DMA,
-        ],
+    A thin K=1 wrapper over the batched plane-tiled kernel (one launch, G
+    grid programs).  ``quantized=True`` (requires ``scales``): 2-digit
+    integer accumulation on the int8 MXU path — exact on the quantized-
+    training grid and ~2x the bf16 throughput."""
+    out = seg_hist_pallas_batch(
+        seg, scal.reshape(1, 2), scales, live,
+        f=f, num_bins=num_bins, n_pad=n_pad, quantized=quantized, wide=wide,
         interpret=interpret,
-    )(scal.reshape(1, 2), scales.astype(jnp.float32), seg)
-    return out.reshape(3, f, bpad)[:, :, :num_bins].transpose(1, 2, 0)
+    )
+    return out[0]
 
 
 @functools.partial(
@@ -488,6 +553,7 @@ def seg_hist_pallas_batch(
     seg: jnp.ndarray,
     scal: jnp.ndarray,  # [K, 2] i32: (start, cnt) per batch member
     scales: Optional[jnp.ndarray] = None,
+    live: Optional[jnp.ndarray] = None,  # [G] i32 plane-group live mask
     *,
     f: int,
     num_bins: int,
@@ -497,47 +563,58 @@ def seg_hist_pallas_batch(
     interpret: bool = False,
 ) -> jnp.ndarray:
     """K histograms [K, F, B, 3] of K disjoint packed-row windows in ONE
-    launch: a K-program grid over the serial kernel (TPU grid programs run
-    sequentially on the core, so the shared staging/accumulator scratch is
-    reused safely program-to-program).  Frontier-batched growth
-    (ops/grower.py leaf_batch) uses this to build all K smaller-child
-    histograms per step with one program's fixed cost."""
+    plane-tiled launch: a (K, G) grid — batch member x feature-plane group
+    — over the shared kernel (TPU grid programs run sequentially on the
+    core, so the shared staging/accumulator scratch is reused safely
+    program-to-program).  Frontier-batched growth (ops/grower.py
+    leaf_batch) uses this to build all K smaller-child histograms per step
+    with one launch's fixed cost; ``live`` (default all-ones) skips dead
+    plane groups under feature_fraction / EFB bundling."""
     k = scal.shape[0]
     bpad = hist_bpad(num_bins)
     group = hist_group(f, bpad)
+    ngroups = hist_ngroups(f, bpad)
     sub = hist_sub(f, wide)
+    acc_dtype = jnp.int32 if quantized else jnp.float32
     kernel = functools.partial(
         _seg_hist_kernel, f=f, bpad=bpad, group=group, sub=sub,
-        quantized=quantized, wide=wide, batched=True,
+        quantized=quantized, wide=wide,
     )
     if scales is None:
         scales = jnp.ones((2,), jnp.float32)
-    out = pl.pallas_call(
+    if live is None:
+        live = jnp.ones((ngroups,), jnp.int32)
+    raw = pl.pallas_call(
         kernel,
-        grid=(k,),
+        grid=(k, ngroups),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
-            (1, 3, f * bpad), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            (1, 1, 8, group * bpad), lambda i, pt: (i, pt, 0, 0),
+            memory_space=pltpu.VMEM,
         ),
-        out_shape=jax.ShapeDtypeStruct((k, 3, f * bpad), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((k, ngroups, 8, group * bpad), acc_dtype),
         scratch_shapes=[
             pltpu.VMEM((sub, TILE), jnp.int16),
-            pltpu.VMEM(
-                (4, f * bpad) if quantized else (8, f * bpad),
-                jnp.int32 if quantized else jnp.float32,
-            ),
+            pltpu.VMEM((8, group * bpad), acc_dtype),
             pltpu.VMEM(
                 (TILE, group * bpad), jnp.int8 if quantized else jnp.bfloat16
             ),
             pltpu.SemaphoreType.DMA,
         ],
         interpret=interpret,
-    )(scal.astype(jnp.int32), scales.astype(jnp.float32), seg)
-    return out.reshape(k, 3, f, bpad)[:, :, :, :num_bins].transpose(0, 2, 3, 1)
+    )(
+        scal.astype(jnp.int32), scales.astype(jnp.float32),
+        live.astype(jnp.int32), seg,
+    )
+    return combine_hist_raw(
+        raw, scales.astype(jnp.float32), f=f, bpad=bpad, group=group,
+        num_bins=num_bins, quantized=quantized,
+    )
 
 
 def seg_hist_ref(seg: jnp.ndarray, scal: jnp.ndarray, *, f: int, num_bins: int,
@@ -553,11 +630,94 @@ def seg_hist_ref(seg: jnp.ndarray, scal: jnp.ndarray, *, f: int, num_bins: int,
     return leaf_histogram_segment(bins, g, h, m * window.astype(jnp.float32), num_bins)
 
 
+# CPU windowing engages only above this row count: below it the plain
+# masked full pass is cheap, and keeping small shapes on the original path
+# keeps every existing golden dump byte-stable (a windowed sum can differ
+# from the full-pass sum in -0.0/+0.0 only, but why risk even that).
+_CPU_WINDOW_ROWS = 32 * TILE
+
+
+def _window_caps(n_pad: int):
+    """Capacity ladder for the windowed CPU pass: 16*TILE, x4 per rung,
+    closed by the full array (mirrors the ordered path's _hist_caps)."""
+    caps, c = [], 16 * TILE
+    while c < n_pad:
+        caps.append(c)
+        c *= 4
+    caps.append(n_pad)
+    return caps
+
+
+def _seg_hist_windowed(seg, scal, *, f: int, num_bins: int, n_pad: int,
+                       wide: bool = False):
+    """Windowed CPU seg histogram: slice the smallest TILE-aligned capacity
+    bucket covering [start, start+cnt) and run the masked reference over
+    just that window, so CPU histogram work is proportional to the leaf
+    size instead of the full padded array (the dominant cost of the old
+    full-pass fallback at 1M+ rows).  lax.switch keeps the trace static
+    per capacity rung."""
+    caps = _window_caps(n_pad)
+    start = scal[0].astype(jnp.int32)
+    cnt = scal[1].astype(jnp.int32)
+    # TILE-aligning the window start costs < TILE rows of slack
+    need = cnt + TILE
+
+    def _branch(cap):
+        def _b(seg, start, cnt):
+            s0 = jnp.clip((start // TILE) * TILE, 0, n_pad - cap)
+            win = lax.dynamic_slice_in_dim(seg, s0, cap, axis=1)
+            return seg_hist_ref(
+                win, jnp.stack([start - s0, cnt]), f=f, num_bins=num_bins,
+                n_pad=cap, wide=wide,
+            )
+        return _b
+
+    idx = jnp.int32(0)
+    for c in caps[:-1]:
+        idx = idx + (need > c).astype(jnp.int32)
+    return lax.switch(idx, [_branch(c) for c in caps], seg, start, cnt)
+
+
+def seg_hist_cpu(seg, scal, *, f: int, num_bins: int, n_pad: int,
+                 wide: bool = False):
+    """Off-TPU seg histogram: capacity-bucketed windowed pass at scale,
+    plain masked full pass below the threshold (byte-identical to the
+    original fallback, keeping small goldens bit-stable).  Shared by the
+    two-launch dispatchers below AND the fused grow step's XLA oracle, so
+    fused-vs-two-launch stays byte-identical by construction."""
+    if n_pad > _CPU_WINDOW_ROWS:
+        return _seg_hist_windowed(
+            seg, scal, f=f, num_bins=num_bins, n_pad=n_pad, wide=wide
+        )
+    return seg_hist_ref(seg, scal, f=f, num_bins=num_bins, n_pad=n_pad,
+                        wide=wide)
+
+
+def seg_hist_batch_cpu(seg, scal_k, *, f: int, num_bins: int, n_pad: int,
+                       wide: bool = False):
+    """Off-TPU K-window histogram.  Above the windowing threshold each
+    member picks its own capacity bucket via a sequential Python loop (K is
+    small and static; vmapping lax.switch would execute every rung),
+    below it the vmapped full pass matches the historical path exactly."""
+    if n_pad > _CPU_WINDOW_ROWS:
+        return jnp.stack([
+            _seg_hist_windowed(
+                seg, scal_k[i], f=f, num_bins=num_bins, n_pad=n_pad, wide=wide
+            )
+            for i in range(scal_k.shape[0])
+        ])
+    return jax.vmap(
+        lambda s: seg_hist_ref(
+            seg, s, f=f, num_bins=num_bins, n_pad=n_pad, wide=wide
+        )
+    )(scal_k)
+
+
 def seg_hist(seg, scal, *, f: int, num_bins: int, n_pad: int,
-             quant_scales=None, wide: bool = False):
-    """Platform dispatch: Pallas on TPU (int8 grid accumulation when
-    ``quant_scales`` is given — quantized training), masked full pass
-    elsewhere."""
+             quant_scales=None, wide: bool = False, live=None):
+    """Platform dispatch: Pallas on TPU (2-digit int8 grid accumulation
+    when ``quant_scales`` is given — quantized training or the grower's
+    int8-default hist accumulator), windowed/masked reference elsewhere."""
     quantized = quant_scales is not None
     scales = (
         jnp.stack([quant_scales[0], quant_scales[1]]).astype(jnp.float32)
@@ -567,26 +727,34 @@ def seg_hist(seg, scal, *, f: int, num_bins: int, n_pad: int,
     if jax.default_backend() != "tpu":
         # no TPU registered: older jax lowers every platform_dependent
         # branch and the Pallas one cannot lower for CPU
-        return seg_hist_ref(seg, scal, f=f, num_bins=num_bins, n_pad=n_pad,
+        if _INTERPRET:
+            return seg_hist_pallas(
+                seg, scal, scales, live, f=f, num_bins=num_bins, n_pad=n_pad,
+                quantized=quantized, wide=wide, interpret=True,
+            )
+        return seg_hist_cpu(seg, scal, f=f, num_bins=num_bins, n_pad=n_pad,
                             wide=wide)
+    if live is None:
+        live = jnp.ones((hist_ngroups(f, hist_bpad(num_bins)),), jnp.int32)
     return jax.lax.platform_dependent(
         seg,
         scal,
         scales,
+        live,
         tpu=functools.partial(
             seg_hist_pallas, f=f, num_bins=num_bins, n_pad=n_pad,
             quantized=quantized, wide=wide,
         ),
-        default=lambda seg, scal, _s: seg_hist_ref(
+        default=lambda seg, scal, _s, _l: seg_hist_cpu(
             seg, scal, f=f, num_bins=num_bins, n_pad=n_pad, wide=wide
         ),
     )
 
 
 def seg_hist_batch(seg, scal_k, *, f: int, num_bins: int, n_pad: int,
-                   quant_scales=None, wide: bool = False):
+                   quant_scales=None, wide: bool = False, live=None):
     """K-window histogram dispatch ([K, 2] (start, cnt) -> [K, F, B, 3]):
-    one K-program Pallas launch on TPU, a vmapped masked full pass
+    one plane-tiled Pallas launch on TPU, the windowed/masked reference
     elsewhere."""
     quantized = quant_scales is not None
     scales = (
@@ -595,22 +763,26 @@ def seg_hist_batch(seg, scal_k, *, f: int, num_bins: int, n_pad: int,
         else jnp.ones((2,), jnp.float32)
     )
 
-    def _ref(seg, scal_k, _s):
-        return jax.vmap(
-            lambda s: seg_hist_ref(
-                seg, s, f=f, num_bins=num_bins, n_pad=n_pad, wide=wide
-            )
-        )(scal_k)
-
     if jax.default_backend() != "tpu":
-        return _ref(seg, scal_k, scales)
+        if _INTERPRET:
+            return seg_hist_pallas_batch(
+                seg, scal_k, scales, live, f=f, num_bins=num_bins,
+                n_pad=n_pad, quantized=quantized, wide=wide, interpret=True,
+            )
+        return seg_hist_batch_cpu(seg, scal_k, f=f, num_bins=num_bins,
+                                  n_pad=n_pad, wide=wide)
+    if live is None:
+        live = jnp.ones((hist_ngroups(f, hist_bpad(num_bins)),), jnp.int32)
     return jax.lax.platform_dependent(
         seg,
         scal_k,
         scales,
+        live,
         tpu=functools.partial(
             seg_hist_pallas_batch, f=f, num_bins=num_bins, n_pad=n_pad,
             quantized=quantized, wide=wide,
         ),
-        default=_ref,
+        default=lambda seg, scal_k, _s, _l: seg_hist_batch_cpu(
+            seg, scal_k, f=f, num_bins=num_bins, n_pad=n_pad, wide=wide
+        ),
     )

@@ -160,12 +160,21 @@ def _split_scan_kernel(
         lh_w = jnp.sum(lh_vec * onehot)
         lc_w = jnp.sum(lc_vec * onehot)
 
+        # within-feature runner-up over BOTH directions (winner's (dir, bin)
+        # excluded) — the grower's near-tie margin combines this with the
+        # other features' best rows (fused_best_split)
+        glose = jnp.where(cb_vec, gain_r, gain_l)
+        sec = jnp.maximum(
+            jnp.max(jnp.where(onehot > 0.0, _NEG, gwin)), jnp.max(glose)
+        )
+
         row = jnp.where(iota_o == 0, best_gain, 0.0)
         row = jnp.where(iota_o == 1, bin_f, row)
         row = jnp.where(iota_o == 2, go_left.astype(jnp.float32), row)
         row = jnp.where(iota_o == 3, lg_w, row)
         row = jnp.where(iota_o == 4, lh_w, row)
         row = jnp.where(iota_o == 5, lc_w, row)
+        row = jnp.where(iota_o == 6, sec, row)
         out_ref[fj, :] = row[0, :]
 
 
@@ -191,7 +200,7 @@ def split_scan_pallas(
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Per-feature best numeric split rows [F, 8]:
-    (gain, bin, default_left, left_g, left_h, left_cnt, 0, 0)."""
+    (gain, bin, default_left, left_g, left_h, left_cnt, second_gain, 0)."""
     bpad = (max(num_bins_pad, 1) + 127) // 128 * 128
     b = hist.shape[1]
     if b < bpad:
@@ -241,6 +250,7 @@ def fused_best_split(
     min_gain_to_split: float,
     feature_contri=None,
     interpret: bool = False,
+    with_margin: bool = False,
 ):
     """best_split (basic numeric path) backed by the Pallas scan kernel.
 
@@ -251,7 +261,13 @@ def fused_best_split(
     ``feature_contri`` ([F] f32): per-feature gain multipliers (reference
     FeatureMetainfo::penalty) — applied OUTSIDE the kernel to the
     per-feature improvement rows before the cross-feature argmax, mirroring
-    best_split's penalized path."""
+    best_split's penalized path.
+
+    ``with_margin``: also return the relative gain gap between the winner
+    and the global runner-up (other features' best rows + the winning
+    feature's in-kernel second-best, row col 6) — the int8-default
+    histogram path's near-tie trigger (non-finite gains -> +inf margin,
+    i.e. nothing to refine)."""
     from ..split import SplitCandidate, leaf_gain
 
     f, b, _ = hist.shape
@@ -287,7 +303,22 @@ def fused_best_split(
         r = rows[feat]
         improvement = r[0] - parent_gain - min_gain_to_split
     improvement = jnp.where(jnp.isfinite(r[0]), improvement, -jnp.inf)
-    return SplitCandidate(
+    if with_margin:
+        # global runner-up gain: best of the OTHER features vs the winning
+        # feature's own second-best (kernel row col 6); the parent/min_gain
+        # offset cancels in (best - second) so raw gains suffice
+        other = jnp.max(
+            jnp.where(
+                jnp.arange(f, dtype=jnp.int32) == feat, -jnp.inf, gains
+            )
+        ) if f > 1 else jnp.float32(-jnp.inf)
+        sec = jnp.maximum(other, r[6])
+        margin = jnp.where(
+            jnp.isfinite(r[0]) & jnp.isfinite(sec),
+            (r[0] - sec) / jnp.maximum(jnp.abs(r[0]), _EPS),
+            jnp.inf,
+        ).astype(jnp.float32)
+    cand = SplitCandidate(
         gain=improvement.astype(jnp.float32),
         feature=feat,
         bin=r[1].astype(jnp.int32),
@@ -301,3 +332,4 @@ def fused_best_split(
         is_cat=jnp.asarray(False),
         cat_mask=jnp.zeros((1,), bool),
     )
+    return (cand, margin) if with_margin else cand

@@ -73,6 +73,32 @@ def quantize_gradients(
     return qg * g_scale, qh * h_scale, g_scale, h_scale
 
 
+def hist_acc_scales(
+    grad: jnp.ndarray,  # [N] f32 TRUE gradients
+    hess: jnp.ndarray,  # [N] f32
+    mask: Optional[jnp.ndarray] = None,  # [N] in-bag mask (None = all)
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-iteration scales for the DEFAULT int8 histogram accumulator
+    (histogram engine v2): unlike ``quantize_gradients`` — which changes
+    the training values themselves — these scales only parameterize how
+    the seg kernels accumulate UNCHANGED f32 gradients on the int8 MXU
+    path.  The grid is the kernels' 2-digit ceiling (seg.QMAX = 16256), so
+    every in-bag |g| maps to at most QMAX with a relative quantization
+    step of ~1/16256 ~= 6e-5 — inside the near-tie tolerance the grower's
+    f32 re-accumulate pass covers (GrowerParams.near_tie_tol).
+
+    Computed ONCE per boosting iteration (the max is over the in-bag
+    rows), reused by every histogram launch of the tree."""
+    from .pallas.seg import QMAX
+
+    if mask is not None:
+        grad = grad * mask
+        hess = hess * mask
+    g_scale = jnp.maximum(jnp.max(jnp.abs(grad)) / QMAX, 1e-30)
+    h_scale = jnp.maximum(jnp.max(jnp.abs(hess)) / QMAX, 1e-30)
+    return g_scale.astype(jnp.float32), h_scale.astype(jnp.float32)
+
+
 @functools.partial(
     instrumented_jit,
     static_argnames=(

@@ -145,6 +145,12 @@ def best_split(
     #                   AdvancedLeafConstraints / CumulativeFeatureConstraint,
     #                   monotone_constraints.hpp:858/:146) — applied to the
     #                   numeric candidates instead of the scalar leaf bounds
+    with_margin: bool = False,  # also return the near-tie margin: the
+    #                   relative gain gap between the winning candidate and
+    #                   the global runner-up, +inf when either is non-finite.
+    #                   The grower's int8-default histogram path re-
+    #                   accumulates in f32 when this falls below
+    #                   near_tie_tol (histogram engine v2).
     bundle_end: Optional[jnp.ndarray] = None,  # [F, B] i32 — EFB planes
     #                   (bundling.py): for a bundle-plane bin inside a member
     #                   feature's sub-range, the sub-range's LAST bin; -1
@@ -400,6 +406,24 @@ def best_split(
     else:
         sel = gains
     flat = jnp.argmax(sel)
+    if with_margin:
+        # relative gap to the global runner-up across EVERY candidate
+        # (cases x features x bins) — a flip anywhere in this tensor is a
+        # structure change, so this is the conservative near-tie signal
+        sel_flat = sel.reshape(-1)
+        best_v = sel_flat[flat]
+        sec_v = jnp.max(
+            jnp.where(
+                jnp.arange(sel_flat.shape[0], dtype=jnp.int32) == flat,
+                -jnp.inf,
+                sel_flat,
+            )
+        )
+        margin = jnp.where(
+            jnp.isfinite(best_v) & jnp.isfinite(sec_v),
+            (best_v - sec_v) / jnp.maximum(jnp.abs(best_v), _EPS),
+            jnp.inf,
+        ).astype(jnp.float32)
     case = (flat // (f * b)).astype(jnp.int32)
     dl = (case == 1).astype(jnp.int32)
     rem = flat % (f * b)
@@ -473,6 +497,10 @@ def best_split(
         # constrained-parent form under use_full_gain) — the voting-parallel
         # learner's LightSplitInfo gains (voting_parallel_tree_learner.cpp:152)
         if use_penalized:
-            return cand_out, sel.max(axis=(0, 2))
-        return cand_out, gains.max(axis=(0, 2)) - parent_gain - min_gain_to_split
+            pf = sel.max(axis=(0, 2))
+        else:
+            pf = gains.max(axis=(0, 2)) - parent_gain - min_gain_to_split
+        return (cand_out, pf, margin) if with_margin else (cand_out, pf)
+    if with_margin:
+        return cand_out, margin
     return cand_out

@@ -269,13 +269,15 @@ def test_fused_step_aliased_boundary_reads_are_correct():
     bf16-vs-f32, compared at kernel tolerance)."""
     from lightgbm_tpu.ops.pallas.grow_step import fused_grow_step_pallas
     from lightgbm_tpu.ops.pallas.grow_step import fused_grow_step
+    from lightgbm_tpu.ops.pallas.seg import hist_bpad, hist_ngroups
 
     seg, rows, f, n_pad = _aliasing_case()
     scal = jnp.asarray(rows, jnp.int32)
     catm = jnp.zeros((2, 256), jnp.float32)
     ones = jnp.ones((2,), jnp.float32)
+    live = jnp.ones((hist_ngroups(f, hist_bpad(256)),), jnp.int32)
     seg_k, dec, hist = fused_grow_step_pallas(
-        seg, scal, catm, ones, f=f, num_bins=256, n_pad=n_pad,
+        seg, scal, catm, ones, live, f=f, num_bins=256, n_pad=n_pad,
         use_cat=False, interpret=True,
     )
     args = tuple(
@@ -292,7 +294,7 @@ def test_fused_step_aliased_boundary_reads_are_correct():
     )
     # the input-ref read corrupts this kernel the same way
     seg_bad, _, _ = fused_grow_step_pallas(
-        seg, scal, catm, ones, f=f, num_bins=256, n_pad=n_pad,
+        seg, scal, catm, ones, live, f=f, num_bins=256, n_pad=n_pad,
         use_cat=False, interpret=True, read_via_input=True,
     )
     assert not np.array_equal(np.asarray(seg_bad), np.asarray(seg_k))

@@ -364,7 +364,10 @@ def test_seg_vmem_gate():
 
     assert seg_vmem_ok(28, 256)  # the bench config always fits
     assert seg_vmem_ok(121, 1024)  # wide, moderate
-    assert not seg_vmem_ok(100, 4096)  # 18 MB acc — must fall back
+    # plane-tiled grid (histogram engine v2): the accumulator/one-hot
+    # scratch is sized per feature-GROUP, not per full feature set, so the
+    # old 18 MB full-F accumulator shape now fits comfortably
+    assert seg_vmem_ok(100, 4096)
     assert not seg_vmem_ok(121, 65536)
     assert not seg_vmem_ok(4, 65536, has_cat=True)  # cat one-hot blows up
 
@@ -423,6 +426,128 @@ def test_seg_hist_batch_dispatch_cpu(packed):
 
     p = packed
     windows = [(0, 2000), (2000, 3000)]
+    scal_k = jnp.asarray(windows, jnp.int32)
+    got = seg_hist_batch(
+        p["seg"], scal_k, f=p["f"], num_bins=256, n_pad=p["n_pad"]
+    )
+    for i, (st, cnt) in enumerate(windows):
+        want = seg_hist(
+            p["seg"], jnp.asarray([st, cnt], jnp.int32),
+            f=p["f"], num_bins=256, n_pad=p["n_pad"],
+        )
+        np.testing.assert_array_equal(np.asarray(got[i]), np.asarray(want))
+
+
+def test_seg_hist_int8_default_error_bound(packed):
+    """int8-by-default accumulation on TRUE f32 gradients: per-bin error is
+    bounded by the grid's rounding budget (cnt * scale / 2 per stat — each
+    row contributes at most half a quantization step; the i32 digit sums
+    themselves are exact)."""
+    from lightgbm_tpu.ops.pallas.seg import seg_hist_pallas
+    from lightgbm_tpu.ops.quantize import hist_acc_scales
+
+    p = packed
+    gs, hs = hist_acc_scales(
+        jnp.asarray(p["g"]), jnp.asarray(p["h"]), jnp.asarray(p["m"])
+    )
+    got = np.asarray(seg_hist_pallas(
+        p["seg"], jnp.asarray([17, 3000], jnp.int32),
+        jnp.stack([gs, hs]),
+        f=p["f"], num_bins=256, n_pad=p["n_pad"],
+        quantized=True, interpret=True,
+    ))
+    bo, go, ho, mo, _ = unpack_stats(p["seg"][:, 17:17 + 3000], p["f"])
+    ref = np.asarray(leaf_histogram_segment(bo, go, ho, mo, 256))
+    cnt = ref[:, :, 2]
+    assert np.array_equal(got[:, :, 2], cnt)  # counts are exact
+    assert (np.abs(got[:, :, 0] - ref[:, :, 0])
+            <= 0.5 * float(gs) * cnt + 1e-6).all()
+    assert (np.abs(got[:, :, 1] - ref[:, :, 1])
+            <= 0.5 * float(hs) * cnt + 1e-6).all()
+
+
+def test_seg_hist_live_plane_skip_interpret(packed):
+    """Dead plane groups under ``live`` come back all-zero while live
+    groups are untouched; group 0 carries the totals so the grower always
+    forces it live."""
+    from lightgbm_tpu.ops.pallas.seg import (
+        hist_bpad, hist_group, hist_ngroups, seg_hist_pallas,
+    )
+
+    p = packed
+    bpad = hist_bpad(256)
+    gb = hist_group(p["f"], bpad)
+    ng = hist_ngroups(p["f"], bpad)
+    assert ng > 1  # 11 features at bpad 256 -> 2 groups of 8
+    full = np.asarray(seg_hist_pallas(
+        p["seg"], jnp.asarray([17, 3000], jnp.int32),
+        f=p["f"], num_bins=256, n_pad=p["n_pad"], interpret=True,
+    ))
+    live = jnp.zeros((ng,), jnp.int32).at[0].set(1)
+    got = np.asarray(seg_hist_pallas(
+        p["seg"], jnp.asarray([17, 3000], jnp.int32), live=live,
+        f=p["f"], num_bins=256, n_pad=p["n_pad"], interpret=True,
+    ))
+    np.testing.assert_array_equal(got[:gb], full[:gb])  # live group intact
+    assert (got[gb:] == 0.0).all()  # dead group fully skipped
+    all_live = np.asarray(seg_hist_pallas(
+        p["seg"], jnp.asarray([17, 3000], jnp.int32),
+        live=jnp.ones((ng,), jnp.int32),
+        f=p["f"], num_bins=256, n_pad=p["n_pad"], interpret=True,
+    ))
+    np.testing.assert_array_equal(all_live, full)
+
+
+@pytest.fixture(scope="module")
+def packed_big():
+    """Above the CPU windowing threshold (32*TILE rows)."""
+    rng = np.random.default_rng(41)
+    f, n = 3, 40000
+    n_pad = padded_rows(n)
+    bins = rng.integers(0, 256, size=(n, f)).astype(np.int32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = rng.random(n).astype(np.float32) + 0.5
+    m = (rng.random(n) < 0.8).astype(np.float32)
+    seg = pack_rows(
+        jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h), jnp.asarray(m),
+        n_pad,
+    )
+    return dict(f=f, n=n, n_pad=n_pad, seg=seg)
+
+
+@pytest.mark.parametrize("st,cnt", [(0, 40000), (7000, 300), (33000, 6500)])
+def test_seg_hist_cpu_windowed_parity(packed_big, st, cnt):
+    """The capacity-bucketed windowed CPU pass == the full masked pass for
+    aligned and unaligned windows across capacity rungs."""
+    from lightgbm_tpu.ops.pallas.seg import (
+        _CPU_WINDOW_ROWS, seg_hist_ref,
+    )
+
+    p = packed_big
+    assert p["n_pad"] > _CPU_WINDOW_ROWS
+    scal = jnp.asarray([st, cnt], jnp.int32)
+    got = seg_hist(
+        p["seg"], scal, f=p["f"], num_bins=256, n_pad=p["n_pad"]
+    )
+    want = seg_hist_ref(
+        p["seg"], scal, f=p["f"], num_bins=256, n_pad=p["n_pad"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-6, atol=1e-4
+    )
+    # counts must be exact (integral sums of the same values)
+    np.testing.assert_array_equal(
+        np.asarray(got)[:, :, 2], np.asarray(want)[:, :, 2]
+    )
+
+
+def test_seg_hist_batch_cpu_windowed(packed_big):
+    """Batched off-TPU dispatch above the windowing threshold: per-member
+    capacity buckets (python loop) == serial windowed calls."""
+    from lightgbm_tpu.ops.pallas.seg import seg_hist_batch
+
+    p = packed_big
+    windows = [(0, 30000), (30000, 0), (31000, 5000)]
     scal_k = jnp.asarray(windows, jnp.int32)
     got = seg_hist_batch(
         p["seg"], scal_k, f=p["f"], num_bins=256, n_pad=p["n_pad"]

@@ -100,6 +100,51 @@ assert got == ref, "fused interpret-mode structure diverged from oracle"
 print("fused grow-step interpret smoke: structure parity OK")
 PYEOF
 
+# int8 histogram smoke: run the histogram engine's int8-by-default path
+# (seg kernels in interpret mode, which also engages the int8 accumulator
+# off-TPU) through a 3-iteration train, serial AND leaf_batch=2 fused, and
+# require structural parity with the f32 XLA oracle.  Fresh process for
+# the same trace-time-flag reason as the fused smoke; the oracle refs are
+# computed BEFORE the flags flip.  Exact parity holds on this workload
+# because no decisive split sits inside a sub-1e-4 relative-gain tie —
+# the engine's contract (zero flips at >=1e-4 gap, near-tie f32 refine
+# below) is property-tested in tests/test_split_scan.py; data with a
+# decisive deeper tie would exercise the benign-flip regime instead.
+echo "=== int8 fused-histogram smoke (3-iteration interpret-mode train vs oracle) ==="
+python - <<'PYEOF' || rc=$?
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.pallas import grow_step, seg
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(1200, 10)).astype(np.float32)
+y = (X[:, 0] + 0.6 * X[:, 1] + 0.1 * rng.normal(size=1200) > 0.2).astype(
+    np.float32)
+KEEP = ("split_feature=", "threshold=", "decision_type=", "left_child=",
+        "right_child=", "num_leaves=")
+
+def structure(**over):
+    p = dict(objective="binary", num_leaves=15, learning_rate=0.2,
+             hist_mode="seg", min_data_in_leaf=20, verbosity=-1,
+             deterministic=True, seed=7)
+    p.update(over)
+    b = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=3)
+    s = b.model_to_string()
+    return [l for l in s[s.index("Tree=0"):s.index("end of trees")].splitlines()
+            if l.startswith(KEEP)]
+
+ref = structure(grow_fused="off")
+ref_b2 = structure(grow_fused="off", leaf_batch=2)
+seg._INTERPRET = True       # seg kernels interpret + int8-default engages
+grow_step._INTERPRET = True
+got = structure(grow_fused="on")
+assert got == ref, "int8 histogram structure diverged from f32 oracle"
+got_b2 = structure(grow_fused="on", leaf_batch=2)
+assert got_b2 == ref_b2, (
+    "int8 batched (K=2) structure diverged from f32 oracle")
+print("int8 fused-histogram interpret smoke: structure parity OK")
+PYEOF
+
 # kill-and-resume smoke: SIGKILL a checkpointing train mid-run (via the
 # chaos harness, the closest stand-in for a TPU-pod preemption), resume
 # from the latest checkpoint, and require a byte-identical model dump vs
