@@ -30,6 +30,8 @@ from ..dataset import Dataset
 from ..metrics import Metric, create_metric
 from ..obs.collectives import collectives_snapshot, measured_summary
 from ..obs.device import sample_device_memory
+from ..obs.flight import get_flight
+from ..obs.health import HealthWatchdog
 from ..obs.jit import compile_count as _obs_compile_count
 from ..obs.registry import get_session
 from ..objectives import ObjectiveFunction, create_objective
@@ -186,6 +188,7 @@ class Booster:
             if int(ta_host.num_leaves) > 1:
                 should_continue = True
                 self._note_commit_rate(ta_host)
+            self._note_refine_rate(ta_host)
             decoded.append((kk, ta_host))
         if not should_continue:
             # no class found a positive-gain split: the iteration left no
@@ -276,6 +279,62 @@ class Booster:
                     f"{cfg.leaf_batch_min_commit_rate} at K={k}; "
                     f"continuing with K={self._grower_params.leaf_batch}"
                 )
+
+    def _note_refine_rate(self, ta_host) -> None:
+        """Histogram-engine-v2 gauges from an already-fetched tree: the
+        count of committed split decisions that took the int8 near-tie f32
+        refine, and its rate over the tree's decisions (root + both
+        children per committed split = 2*(num_leaves-1) + 1).  The
+        watchdog's refine-rate rule reads the rate gauge."""
+        ses = get_session()
+        if not ses.enabled or not self._int8_engaged():
+            return
+        refines = int(ta_host.refine_count)
+        decisions = 2 * max(0, int(ta_host.num_leaves) - 1) + 1
+        ses.set_gauge("hist/near_tie_refines", float(refines))
+        ses.set_gauge("hist/near_tie_refine_rate", refines / decisions)
+        ses.inc("hist/near_tie_refines_total", refines)
+
+    def _int8_engaged(self) -> bool:
+        """Host mirror of grow_tree's int8-accumulation engage decision
+        (every input is a static — see ops.grower.int8_acc_eligible)."""
+        from ..ops.grower import int8_acc_eligible
+
+        p = getattr(self, "_grower_params", None)
+        if p is None or self.train_set is None:
+            return False
+        return (
+            p.hist_mode == "seg"
+            and int(self._bins.shape[1]) > 0
+            and int(self.train_set.num_data) > 1
+            and int8_acc_eligible(
+                p,
+                quantized=self.config.use_quantized_grad,
+                monotone=self._monotone is not None,
+            )
+        )
+
+    def _note_live_plane(self, mask_host, f: int) -> None:
+        """hist/live_plane_skip_ratio gauge: fraction of seg histogram
+        plane groups skipped under this iteration's tree-level feature
+        mask.  Pure host numpy (the mask is built host-side), mirroring
+        grow_tree's seg_live derivation; skipped when the skip itself
+        cannot engage (non-seg mode, feature-parallel shards)."""
+        ses = get_session()
+        if not ses.enabled:
+            return
+        p = getattr(self, "_grower_params", None)
+        if p is None or p.hist_mode != "seg" or self._featpar:
+            return
+        from ..ops.grower import live_plane_fraction
+
+        if mask_host is None:
+            frac = 1.0  # full mask: every plane group stays live
+        else:
+            frac = live_plane_fraction(
+                mask_host, f, int(p.max_bin), n_forced=int(p.n_forced)
+            )
+        ses.set_gauge("hist/live_plane_skip_ratio", 1.0 - frac)
 
     def _update_pipelined(self, grad, hess, mask, feature_mask, k: int) -> bool:
         """Dispatch one iteration's device work; defer host bookkeeping.
@@ -404,6 +463,30 @@ class Booster:
                 device_accounting=cfg.obs_device_accounting,
                 measure_collectives=cfg.obs_collectives,
             )
+        # live ops plane: the flight ring records the tail of every train
+        # run (dump-on-fault lands next to the checkpoint dir when one is
+        # configured, else next to the telemetry sink); the watchdog
+        # evaluates alert rules once per update
+        import os as _os
+
+        fault_dir = cfg.checkpoint_dir or (
+            _os.path.dirname(_os.path.abspath(cfg.telemetry_out))
+            if cfg.telemetry_out
+            else ""
+        )
+        flight = get_flight()
+        flight.reset()  # ring events are per-run; capacity/dir persist
+        flight.configure(
+            capacity=cfg.flight_capacity,
+            fault_dir=fault_dir,
+            run_info={
+                "objective": cfg.objective,
+                "num_leaves": cfg.num_leaves,
+                "leaf_batch": cfg.leaf_batch,
+                "tree_learner": cfg.tree_learner,
+            },
+        )
+        self._watchdog = HealthWatchdog() if cfg.health_watchdog else None
         self.objective = create_objective(cfg)
         md = train_set.metadata
         n = train_set.num_data
@@ -962,15 +1045,19 @@ class Booster:
         self._grower_params = self._make_grower_params()
         ses = get_session()
         ses.inc("degradations")
-        ses.record(
-            {
-                "event": "degradation",
-                "component": "fused_grow_step",
-                "action": "fallback_to_xla_oracle",
-                "iter": int(self._iter),
-                "error": f"{type(exc).__name__}: {exc}"[:300],
-            }
-        )
+        event = {
+            "event": "degradation",
+            "component": "fused_grow_step",
+            "action": "fallback_to_xla_oracle",
+            "iter": int(self._iter),
+            "error": f"{type(exc).__name__}: {exc}"[:300],
+        }
+        ses.record(event)
+        # the latch is a survivable fault, but the triggering context is
+        # exactly what a postmortem needs — dump the flight ring now
+        flight = get_flight()
+        flight.note_event(event)
+        flight.dump("degradation")
         log_warning(
             "[resilience] fused Pallas grow step failed "
             f"({type(exc).__name__}); permanently falling back to the "
@@ -1566,6 +1653,28 @@ class Booster:
             return type(self.objective).__name__
         return str(self.params.get("objective", "custom"))
 
+    def _fault_dump(self, reason: str) -> str:
+        """Black-box the run before a numerics abort: register a critical
+        alert (so the dump carries it and ``health()`` reflects it), then
+        atomically write the flight ring next to the checkpoint dir.
+        Returns the dump path ("" when no fault_dir is configured)."""
+        ses = get_session()
+        ses.inc("numerics/guard_trips")
+        flight = get_flight()
+        it = int(self._iter)
+        wd = getattr(self, "_watchdog", None)
+        if wd is not None:
+            alert = wd.note_fault("numerics", it, reason, ses=ses)
+        else:
+            alert = {
+                "event": "alert", "rule": "numerics",
+                "severity": "critical", "iter": it, "message": reason,
+                "value": 1.0, "threshold": 0.0,
+            }
+        ses.record_alert(alert)
+        flight.note_alert(alert)
+        return flight.dump(reason)
+
     def _guard_gradients(self, grad, hess) -> None:
         """check_numerics guard: ONE device-side finiteness reduce over
         gradients+hessians per iteration, pulled as a single host bool.
@@ -1574,6 +1683,7 @@ class Booster:
         model silently."""
         ok = bool(jnp.isfinite(grad).all() & jnp.isfinite(hess).all())
         if not ok:
+            self._fault_dump("numerics_gradients")
             raise NumericsError(
                 f"non-finite gradients/hessians at iteration {self._iter} "
                 f"(objective={self._objective_name()}); model state is "
@@ -1589,6 +1699,7 @@ class Booster:
         gains = np.asarray(ta_host.split_gain)[:nn]
         leaves = np.asarray(ta_host.leaf_value)[: int(ta_host.num_leaves)]
         if not (np.isfinite(gains).all() and np.isfinite(leaves).all()):
+            self._fault_dump("numerics_tree")
             raise NumericsError(
                 f"non-finite split gain or leaf value in the tree grown at "
                 f"iteration {iteration} (objective={self._objective_name()})"
@@ -1628,8 +1739,27 @@ class Booster:
         """
         chaos.on_iteration(self._iter)  # no-op unless a test armed a fault
         ses = get_session()
+        flight = get_flight()
+        wd = getattr(self, "_watchdog", None)
         if not ses.enabled:
-            return self._update_impl(train_set, fobj)
+            # telemetry off: the always-on flight ring still gets a minimal
+            # iteration event (one dict per iteration) and the watchdog
+            # still sees walls; gauges/counters stay empty so gauge-based
+            # rules simply never fire
+            it = self._iter
+            t0 = time.perf_counter()
+            finished = self._update_impl(train_set, fobj)
+            if flight.active or wd is not None:
+                event = {
+                    "event": "iteration",
+                    "iter": it,
+                    "wall_ms": (time.perf_counter() - t0) * 1e3,
+                    "finished": bool(finished),
+                }
+                flight.note_event(event)
+                if wd is not None:
+                    wd.observe(event, ses)
+            return finished
         it = self._iter
         trees_before = len(self._bin_records_store)
         compiles_before = _obs_compile_count()
@@ -1699,9 +1829,16 @@ class Booster:
                 ses.inc("collective_measured_bytes_total", int(meas["bytes"]))
         sample_device_memory("iteration")
         ses.inc("iterations")
+        ses.set_gauge("hist/int8_engaged", float(self._int8_engaged()))
         # deferred: the engine annotates eval metrics into this event before
         # the JSONL line is flushed (next record / flush_pending)
         ses.record(event, defer=True)
+        flight.note_event(event)
+        if wd is not None:
+            # alerts are recorded via record_alert, which leaves the
+            # deferred iteration event pending (late eval annotations
+            # still land on its JSONL line)
+            wd.observe(event, ses)
         return finished
 
     def _update_impl(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
@@ -1811,6 +1948,7 @@ class Booster:
                     ta_host = fetch_tree_arrays(ta)
                 if cfg.check_numerics:
                     self._guard_tree(ta_host, self._iter)
+                self._note_refine_rate(ta_host)
                 n_leaves = int(ta_host.num_leaves)
             else:
                 n_leaves = 1
@@ -1942,12 +2080,14 @@ class Booster:
         cfg = self.config
         f = self._bins.shape[1]
         if cfg.feature_fraction >= 1.0 or f == 0:
+            self._note_live_plane(None, f)
             return self._full_feature_mask
         rng = np.random.default_rng(cfg.feature_fraction_seed + self._iter)
         used = max(1, int(round(f * cfg.feature_fraction)))
         chosen = rng.choice(f, size=used, replace=False)
         m = np.zeros(f, dtype=bool)
         m[chosen] = True
+        self._note_live_plane(m, f)
         return jnp.asarray(m)
 
     def rollback_one_iter(self) -> "Booster":
@@ -2097,6 +2237,15 @@ class Booster:
             "compile_count": _obs_compile_count(),
             "compile_counts_by_label": compile_counts_by_label(),
         }
+
+    def health(self) -> Dict[str, Any]:
+        """Live health snapshot: watchdog status (``ok``/``warn``/
+        ``critical``), active alerts, the counter/gauge tables and flight-
+        recorder state.  Same document as the exporter's ``GET /healthz``
+        (see README "Live observability")."""
+        from ..obs.export import health_snapshot
+
+        return health_snapshot(getattr(self, "_watchdog", None))
 
     def current_iteration(self) -> int:
         return self._iter

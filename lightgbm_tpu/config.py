@@ -409,6 +409,14 @@ class Config:
     # the analytic parallel.psum_bytes_per_iteration model)
     obs_device_accounting: bool = False
     obs_collectives: bool = True
+    # live ops plane (obs/flight, obs/health, obs/export): the flight
+    # recorder ring is always on (capacity below, floor 32); the health
+    # watchdog evaluates per-iteration alert rules host-side from recorded
+    # telemetry; obs_export_port > 0 serves /metrics (Prometheus text) and
+    # /healthz from a background HTTP endpoint for the run's duration
+    obs_export_port: int = 0
+    health_watchdog: bool = True
+    flight_capacity: int = 256
     profile_trace_dir: str = ""
     profile_iter_start: int = 0
     profile_iter_end: int = -1
@@ -611,6 +619,15 @@ class Config:
             )
         if self.checkpoint_keep < 0:
             raise ValueError("checkpoint_keep must be >= 0 (0 keeps all)")
+        if not (0 <= self.obs_export_port <= 65535):
+            raise ValueError(
+                "obs_export_port must be in [0, 65535] (0 disables)"
+            )
+        if self.flight_capacity < 32:
+            raise ValueError(
+                "flight_capacity must be >= 32 (the dump-on-fault contract "
+                "promises the last 32 iteration events)"
+            )
         if self.bagging_freq > 0 and (self.pos_bagging_fraction < 1.0 or self.neg_bagging_fraction < 1.0):
             if self.objective != "binary":
                 raise ValueError("pos/neg bagging fractions require binary objective")

@@ -18,6 +18,11 @@ from .callback import CallbackEnv, EarlyStopException, early_stopping, log_evalu
 from .config import Config
 from .dataset import Dataset
 from .obs.aggregate import global_rollup
+from .obs.flight import (
+    get_flight,
+    install_sigterm_handler,
+    uninstall_sigterm_handler,
+)
 from .obs.profiler import TraceWindow
 from .obs.registry import get_session
 from .utils.log import log_info
@@ -122,6 +127,24 @@ def train(
         restore_checkpoint(booster, resume_path)
         resumed = True
 
+    # live ops plane: opt-in Prometheus endpoint for the run's duration,
+    # and a SIGTERM handler that black-boxes the flight ring (preemption
+    # notice -> flight_<ts>.json next to the checkpoint dir) before dying
+    exporter = None
+    if cfg.obs_export_port > 0:
+        from .obs.export import MetricsExporter
+
+        exporter = MetricsExporter(
+            cfg.obs_export_port, health_provider=booster.health
+        )
+        exporter.start()
+        if cfg.verbosity >= 1:
+            log_info(
+                f"[obs] metrics exporter serving {exporter.url}/metrics "
+                f"and {exporter.url}/healthz"
+            )
+    sigterm_installed = install_sigterm_handler()
+
     begin_iteration = booster.current_iteration()
     if resumed:
         # total-iteration semantics: the resumed run stops where the
@@ -204,6 +227,10 @@ def train(
     finally:
         if trace is not None:
             trace.close()
+        if sigterm_installed:
+            uninstall_sigterm_handler()
+        if exporter is not None:
+            exporter.stop()
         if ses.enabled:
             # multi-host rollup (GlobalSyncUp analog; identity on one
             # process) and one train_summary event carrying the final

@@ -17,7 +17,12 @@ into:
   (graceful no-op on backends without allocator stats) — ``device``;
 * per-host aggregation — GlobalSyncUp-style counter/gauge merge plus
   straggler gauges for multi-host runs — ``aggregate``;
-* ``jax.profiler`` trace capture over an iteration window — ``profiler``.
+* ``jax.profiler`` trace capture over an iteration window — ``profiler``;
+* the LIVE ops plane — ``flight`` (always-on bounded ring buffer with
+  atomic dump-on-fault: NumericsError, degradation latch, SIGTERM),
+  ``health`` (per-iteration host-side watchdog emitting severity-tagged
+  alerts), ``export`` (Prometheus text-format snapshot + opt-in HTTP
+  endpoint via ``obs_export_port`` and the ``Booster.health()`` API).
 
 Enable with ``telemetry=True`` (params/Config), stream to a file with
 ``telemetry_out=<path.jsonl>``, make phase walls measure device time with
@@ -41,6 +46,20 @@ from .device import (  # noqa: F401
     device_memory_supported,
     sample_device_memory,
 )
+from .export import (  # noqa: F401
+    MetricsExporter,
+    health_snapshot,
+    prometheus_snapshot,
+    sanitize_metric_name,
+)
+from .flight import (  # noqa: F401
+    FlightRecorder,
+    get_flight,
+    install_sigterm_handler,
+    list_flight_dumps,
+    uninstall_sigterm_handler,
+)
+from .health import HealthWatchdog  # noqa: F401
 from .jit import (  # noqa: F401
     compile_count,
     compile_counts_by_label,
@@ -60,6 +79,16 @@ __all__ = [
     "TelemetrySession",
     "get_session",
     "session_disabled",
+    "FlightRecorder",
+    "get_flight",
+    "list_flight_dumps",
+    "install_sigterm_handler",
+    "uninstall_sigterm_handler",
+    "HealthWatchdog",
+    "MetricsExporter",
+    "health_snapshot",
+    "prometheus_snapshot",
+    "sanitize_metric_name",
     "instrumented_jit",
     "note_compile",
     "note_executable",

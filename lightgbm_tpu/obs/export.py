@@ -1,0 +1,220 @@
+"""Pull-based metrics export: Prometheus text snapshot + HTTP endpoint.
+
+``prometheus_snapshot`` renders the live counter/gauge tables in the
+Prometheus text exposition format (version 0.0.4) under a stable
+``lgbtpu_*`` namespace: counters get a ``_total`` suffix, gauge names are
+flattened (``/`` and ``.`` become ``_``), and the health watchdog's state
+rides along as ``lgbtpu_health_status`` (0=ok, 1=warn, 2=critical) plus
+per-rule ``lgbtpu_alert_active`` series.
+
+``MetricsExporter`` serves that snapshot from an opt-in background HTTP
+endpoint (``obs_export_port``; a daemon ``ThreadingHTTPServer``, so a
+hung scrape never blocks training and the thread dies with the process):
+
+* ``GET /metrics``  — Prometheus text format
+* ``GET /healthz``  — the ``Booster.health()`` JSON document
+
+Everything here is host-only code operating on already-recorded telemetry
+— no tracer reads, no device syncs (GL003/GL010-clean by construction).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+from .flight import get_flight
+from .health import _SEV_RANK, HealthWatchdog
+from .registry import TelemetrySession, _jsonable, get_session
+
+METRIC_PREFIX = "lgbtpu_"
+
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Flatten a registry counter/gauge name into a Prometheus name."""
+    flat = _NAME_BAD.sub("_", name.strip())
+    flat = re.sub(r"_+", "_", flat).strip("_")
+    if flat and flat[0].isdigit():
+        flat = "_" + flat
+    return METRIC_PREFIX + (flat or "unnamed")
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def prometheus_snapshot(
+    ses: Optional[TelemetrySession] = None,
+    health: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Render counters/gauges (+ optional health doc) as Prometheus text."""
+    ses = ses or get_session()
+    with ses._lock:
+        counters = dict(ses.counters)
+        gauges = dict(ses.gauges)
+    lines: List[str] = []
+
+    def emit(name: str, kind: str, value: float, help_text: str = "") -> None:
+        if help_text:
+            lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {_fmt_value(value)}")
+
+    emit(
+        METRIC_PREFIX + "up", "gauge", 1,
+        "telemetry endpoint liveness (constant 1 while serving)",
+    )
+    for raw in sorted(counters):
+        name = sanitize_metric_name(raw)
+        if not name.endswith("_total"):
+            name += "_total"
+        emit(name, "counter", counters[raw])
+    for raw in sorted(gauges):
+        emit(sanitize_metric_name(raw), "gauge", gauges[raw])
+    if health is not None:
+        status = str(health.get("status", "ok"))
+        emit(
+            METRIC_PREFIX + "health_status", "gauge",
+            {"ok": 0, "warn": 1, "critical": 2}.get(status, 1),
+            "watchdog status: 0=ok 1=warn 2=critical",
+        )
+        alerts = health.get("alerts") or []
+        lines.append(f"# TYPE {METRIC_PREFIX}alert_active gauge")
+        for alert in alerts:
+            rule = _NAME_BAD.sub("_", str(alert.get("rule", "unknown")))
+            sev = _NAME_BAD.sub("_", str(alert.get("severity", "warn")))
+            lines.append(
+                f'{METRIC_PREFIX}alert_active{{rule="{rule}",'
+                f'severity="{sev}"}} 1'
+            )
+    return "\n".join(lines) + "\n"
+
+
+def health_snapshot(
+    watchdog: Optional[HealthWatchdog] = None,
+    ses: Optional[TelemetrySession] = None,
+) -> Dict[str, Any]:
+    """The ``Booster.health()`` / ``GET /healthz`` document."""
+    ses = ses or get_session()
+    flight = get_flight()
+    with ses._lock:
+        counters = dict(ses.counters)
+        gauges = dict(ses.gauges)
+    alerts = watchdog.active_alerts() if watchdog is not None else []
+    status = watchdog.status() if watchdog is not None else "ok"
+    return _jsonable(
+        {
+            "schema": "lgbtpu.health.v1",
+            "status": status,
+            "status_rank": _SEV_RANK.get(status, 0),
+            "iter": int(counters.get("iterations", 0)),
+            "alerts": alerts,
+            "alerts_emitted": (
+                watchdog.alerts_emitted if watchdog is not None else 0
+            ),
+            "counters": counters,
+            "gauges": gauges,
+            "flight": {
+                "capacity": flight.capacity,
+                "n_events": len(flight.events()),
+                "last_dump": flight.last_dump_path,
+                "last_checkpoint": flight.last_checkpoint,
+            },
+        }
+    )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    exporter: "MetricsExporter"
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            body = prometheus_snapshot(
+                health=self.exporter._health()
+            ).encode("utf-8")
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/healthz":
+            body = json.dumps(self.exporter._health() or {}).encode("utf-8")
+            ctype = "application/json"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # silence per-request stderr spam
+        pass
+
+
+class MetricsExporter:
+    """Background HTTP endpoint serving /metrics and /healthz.
+
+    ``port=0`` binds an ephemeral port (useful in tests); the bound port
+    is available as ``.port`` after :meth:`start`.
+    """
+
+    def __init__(
+        self,
+        port: int,
+        host: str = "127.0.0.1",
+        health_provider: Optional[Callable[[], Dict[str, Any]]] = None,
+    ) -> None:
+        self._requested_port = int(port)
+        self._host = host
+        self._health_provider = health_provider
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def _health(self) -> Optional[Dict[str, Any]]:
+        if self._health_provider is None:
+            return health_snapshot()
+        try:
+            return self._health_provider()
+        except Exception:
+            return None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1] if self._server else 0
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}" if self._server else ""
+
+    def start(self) -> int:
+        if self._server is not None:
+            return self.port
+        handler = type("_BoundHandler", (_Handler,), {"exporter": self})
+        self._server = ThreadingHTTPServer(
+            (self._host, self._requested_port), handler
+        )
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="lgbtpu-metrics-exporter",
+            daemon=True,
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        server, self._server = self._server, None
+        thread, self._thread = self._thread, None
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if thread is not None:
+            thread.join(timeout=5.0)

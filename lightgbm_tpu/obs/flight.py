@@ -1,0 +1,280 @@
+"""Flight recorder: always-on bounded ring buffer with dump-on-fault.
+
+Post-hoc telemetry (the JSONL sink) answers "what happened over the whole
+run" — but only if the process lives long enough to flush it, and only if
+the operator remembered to turn it on.  The flight recorder is the black
+box for everything else: a process-global, bounded ``deque`` of the last N
+iteration events and alerts that costs one append per iteration, plus an
+atomic ``dump()`` that snapshots the ring, the live counter/gauge tables
+and the active alerts into ``flight_<ts>.json`` next to the checkpoint
+directory *before* the process dies.
+
+Fault sites wired in (see ``boosting/gbdt.py`` and ``engine.py``):
+
+* ``NumericsError`` — the non-finite guard rails dump before raising;
+* the fused-kernel degradation latch — dump when falling back to the XLA
+  oracle, so the triggering iteration's context survives;
+* SIGTERM/preemption — :func:`install_sigterm_handler` dumps and then
+  chains to the previously installed handler.
+
+This module is intentionally import-cycle-free: it must not import
+``resilience`` (``resilience.checkpoint`` imports ``..obs``), so the
+tmp+fsync+rename atomic-write idiom is restated locally.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional
+
+FLIGHT_SCHEMA = "lgbtpu.flight.v1"
+
+# Floor on ring capacity: the dump-on-fault contract promises the last
+# >= 32 iteration events whenever the run got that far.
+MIN_CAPACITY = 32
+DEFAULT_CAPACITY = 256
+_MAX_ALERTS = 128
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    """tmp + fsync + os.replace in the destination directory, so a kill at
+    any byte offset leaves either no file or a complete one."""
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".", suffix=".tmp", dir=directory
+    )
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:
+        dfd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+
+
+class FlightRecorder:
+    """Bounded ring of recent events + alerts with atomic fault dumps."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        self._lock = threading.Lock()
+        self._events: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=max(MIN_CAPACITY, int(capacity))
+        )
+        self._alerts: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=_MAX_ALERTS
+        )
+        self.active = True
+        self.fault_dir = ""
+        self.run_info: Dict[str, Any] = {}
+        self.last_checkpoint = ""
+        self.last_dump_path = ""
+        self.dump_count = 0
+
+    # ---------------------------------------------------------- lifecycle
+    def configure(
+        self,
+        capacity: Optional[int] = None,
+        fault_dir: Optional[str] = None,
+        run_info: Optional[Dict[str, Any]] = None,
+        active: Optional[bool] = None,
+    ) -> "FlightRecorder":
+        with self._lock:
+            if capacity is not None and capacity != self._events.maxlen:
+                self._events = collections.deque(
+                    self._events, maxlen=max(MIN_CAPACITY, int(capacity))
+                )
+            if fault_dir is not None:
+                self.fault_dir = fault_dir
+            if run_info is not None:
+                self.run_info = dict(run_info)
+            if active is not None:
+                self.active = bool(active)
+        return self
+
+    def reset(self) -> None:
+        """Clear the ring (new train run); keeps capacity/fault_dir."""
+        with self._lock:
+            self._events.clear()
+            self._alerts.clear()
+            self.last_checkpoint = ""
+            self.last_dump_path = ""
+            self.dump_count = 0
+
+    @property
+    def capacity(self) -> int:
+        return self._events.maxlen or 0
+
+    # -------------------------------------------------------------- feeds
+    def note_event(self, event: Dict[str, Any]) -> None:
+        """Append one event to the ring (O(1), evicts the oldest)."""
+        if not self.active:
+            return
+        with self._lock:
+            self._events.append(event)
+
+    def note_alert(self, alert: Dict[str, Any]) -> None:
+        """Record a watchdog alert (kept separately so a burst of events
+        cannot evict the alert history before a dump)."""
+        if not self.active:
+            return
+        with self._lock:
+            self._alerts.append(alert)
+            self._events.append(alert)
+
+    def note_checkpoint(self, path: str) -> None:
+        if not self.active:
+            return
+        with self._lock:
+            self.last_checkpoint = path
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def alerts(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._alerts)
+
+    # -------------------------------------------------------------- dumps
+    def snapshot(self, reason: str = "") -> Dict[str, Any]:
+        """JSON-serializable snapshot of the ring + live telemetry tables."""
+        from .registry import _jsonable, get_session
+
+        ses = get_session()
+        with self._lock:
+            events = list(self._events)
+            alerts = list(self._alerts)
+            snap = {
+                "schema": FLIGHT_SCHEMA,
+                "reason": reason,
+                "dumped_at_unix": time.time(),
+                "pid": os.getpid(),
+                "run_info": dict(self.run_info),
+                "last_checkpoint": self.last_checkpoint,
+                "ring_capacity": self._events.maxlen,
+                "n_events": len(events),
+                "n_alerts": len(alerts),
+            }
+        snap["counters"] = dict(ses.counters)
+        snap["gauges"] = dict(ses.gauges)
+        snap["events"] = events
+        snap["alerts"] = alerts
+        return _jsonable(snap)
+
+    def dump(self, reason: str, directory: Optional[str] = None) -> str:
+        """Atomically write ``flight_<ts>.json``; returns the path.
+
+        Never raises: this runs on fault paths (a dump failure must not
+        mask the original ``NumericsError``/signal).  Returns "" when no
+        destination directory is known or the write fails.
+        """
+        target = directory or self.fault_dir
+        if not self.active or not target:
+            return ""
+        try:
+            os.makedirs(target, exist_ok=True)
+            ts = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
+            path = os.path.join(
+                target, f"flight_{ts}_{os.getpid()}_{self.dump_count}.json"
+            )
+            _atomic_write_text(
+                path, json.dumps(self.snapshot(reason), indent=1)
+            )
+            with self._lock:
+                self.last_dump_path = path
+                self.dump_count += 1
+            return path
+        except Exception:
+            return ""
+
+
+_FLIGHT = FlightRecorder()
+
+
+def get_flight() -> FlightRecorder:
+    """The process-global flight recorder."""
+    return _FLIGHT
+
+
+def list_flight_dumps(directory: str) -> List[str]:
+    """All ``flight_*.json`` files in ``directory``, sorted by mtime."""
+    if not os.path.isdir(directory):
+        return []
+    out = [
+        os.path.join(directory, n)
+        for n in os.listdir(directory)
+        if n.startswith("flight_") and n.endswith(".json")
+    ]
+    out.sort(key=lambda p: (os.path.getmtime(p), p))
+    return out
+
+
+# ------------------------------------------------------------------ SIGTERM
+_prev_sigterm: Optional[Any] = None
+_sigterm_installed = False
+
+
+def _on_sigterm(signum, frame):  # pragma: no cover - exercised in subprocess
+    _FLIGHT.dump("sigterm")
+    prev = _prev_sigterm
+    if callable(prev):
+        prev(signum, frame)
+        return
+    # Default disposition: restore it and re-raise so the exit status is
+    # the conventional "killed by SIGTERM".
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    os.kill(os.getpid(), signal.SIGTERM)
+
+
+def install_sigterm_handler() -> bool:
+    """Dump the flight ring on SIGTERM, then chain to the previous handler.
+
+    Installed by ``engine.train`` for the duration of training (main
+    thread only — ``signal.signal`` raises elsewhere, in which case this
+    is a no-op returning False).  Idempotent.
+    """
+    global _prev_sigterm, _sigterm_installed
+    if _sigterm_installed:
+        return True
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    try:
+        _prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        return False
+    _sigterm_installed = True
+    return True
+
+
+def uninstall_sigterm_handler() -> None:
+    global _prev_sigterm, _sigterm_installed
+    if not _sigterm_installed:
+        return
+    try:
+        signal.signal(
+            signal.SIGTERM,
+            _prev_sigterm if _prev_sigterm is not None else signal.SIG_DFL,
+        )
+    except (ValueError, OSError):
+        pass
+    _prev_sigterm = None
+    _sigterm_installed = False

@@ -1,0 +1,277 @@
+"""Host-side health watchdog: per-iteration rule evaluation over telemetry.
+
+The watchdog never touches tracers or device values: it is evaluated once
+per boosting iteration from the *already-recorded* iteration event and the
+live counter/gauge tables (GL003/GL010-clean by construction — everything
+it reads was pulled to the host by the telemetry layer under its own
+gating).  Each rule emits a severity-tagged ``alert`` event into the
+registry (JSONL sink included) and the flight recorder ring, with a
+per-rule cooldown so a persistent condition alerts once per window
+instead of once per iteration.
+
+Rules (all thresholds are constructor kwargs; config exposes only the
+on/off switch to keep the Config surface small):
+
+==================  ========================================================
+``throughput``      iteration wall regressed vs an EMA of recent walls
+                    (compile iterations excluded — retraces legitimately
+                    spike the wall)
+``numerics``        the non-finite guard tripped (``numerics/guard_trips``
+                    counter delta) — CRITICAL; training is about to abort
+``commit_rate``     adaptive-``leaf_batch`` commit-rate EMA collapsed while
+                    batched growth is engaged
+``refine_rate``     int8 histogram near-tie refine rate spiked — the
+                    2-digit accumulator is re-doing too much work in f32,
+                    usually a symptom of near-constant gain landscapes
+``straggler``       per-host iteration-wall skew (max/mean) exceeds bound
+``hbm``             device bytes-in-use grew well past the run's baseline
+                    (leak / fragmentation watch)
+==================  ========================================================
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .flight import get_flight
+from .registry import TelemetrySession, get_session
+
+SEV_WARN = "warn"
+SEV_CRITICAL = "critical"
+
+_SEV_RANK = {SEV_WARN: 1, SEV_CRITICAL: 2}
+
+
+class HealthWatchdog:
+    """Stateful per-run watchdog; one instance per training Booster."""
+
+    def __init__(
+        self,
+        warmup_iters: int = 5,
+        cooldown_iters: int = 10,
+        activity_window: int = 25,
+        throughput_ema_alpha: float = 0.3,
+        throughput_factor: float = 3.0,
+        commit_rate_floor: float = 0.25,
+        refine_rate_ceiling: float = 0.5,
+        straggler_skew_ceiling: float = 1.5,
+        hbm_growth_factor: float = 1.5,
+        hbm_growth_floor_bytes: float = 64 * 1024 * 1024,
+    ) -> None:
+        self.warmup_iters = int(warmup_iters)
+        self.cooldown_iters = int(cooldown_iters)
+        self.activity_window = int(activity_window)
+        self.throughput_ema_alpha = float(throughput_ema_alpha)
+        self.throughput_factor = float(throughput_factor)
+        self.commit_rate_floor = float(commit_rate_floor)
+        self.refine_rate_ceiling = float(refine_rate_ceiling)
+        self.straggler_skew_ceiling = float(straggler_skew_ceiling)
+        self.hbm_growth_factor = float(hbm_growth_factor)
+        self.hbm_growth_floor_bytes = float(hbm_growth_floor_bytes)
+        self._wall_ema: Optional[float] = None
+        self._hbm_baseline: Optional[float] = None
+        self._seen = 0
+        self._guard_trips_seen = 0
+        self._last_fired: Dict[str, int] = {}
+        self._last_alert: Dict[str, Dict[str, Any]] = {}
+        self._last_iter = -1
+        self.alerts_emitted = 0
+
+    # ------------------------------------------------------------ emission
+    def _emit(
+        self,
+        out: List[Dict[str, Any]],
+        it: int,
+        rule: str,
+        severity: str,
+        message: str,
+        value: float,
+        threshold: float,
+    ) -> None:
+        last = self._last_fired.get(rule)
+        if last is not None and (it - last) < self.cooldown_iters:
+            # still refresh the remembered alert so health() reflects the
+            # latest reading during the cooldown window
+            self._last_alert[rule]["value"] = value
+            self._last_alert[rule]["iter"] = it
+            return
+        alert = {
+            "event": "alert",
+            "rule": rule,
+            "severity": severity,
+            "iter": it,
+            "message": message,
+            "value": value,
+            "threshold": threshold,
+        }
+        self._last_fired[rule] = it
+        self._last_alert[rule] = alert
+        self.alerts_emitted += 1
+        out.append(alert)
+
+    # ---------------------------------------------------------- evaluation
+    def observe(
+        self,
+        event: Dict[str, Any],
+        ses: Optional[TelemetrySession] = None,
+    ) -> List[Dict[str, Any]]:
+        """Evaluate all rules against one finished iteration.
+
+        ``event`` is the iteration event dict built by ``Booster.update``;
+        gauges/counters are read from the live session.  Emitted alerts are
+        recorded into the registry and flight ring, and returned.
+        """
+        ses = ses or get_session()
+        it = int(event.get("iter", self._last_iter + 1))
+        self._last_iter = it
+        self._seen += 1
+        out: List[Dict[str, Any]] = []
+        gauges = ses.gauges
+        counters = ses.counters
+
+        # numerics guard trips: critical, no warmup — a trip at iteration 0
+        # matters as much as one at iteration 1000.
+        trips = int(counters.get("numerics/guard_trips", 0))
+        if trips > self._guard_trips_seen:
+            self._emit(
+                out, it, "numerics", SEV_CRITICAL,
+                "non-finite guard tripped "
+                f"({trips - self._guard_trips_seen} new)",
+                float(trips), 0.0,
+            )
+            self._guard_trips_seen = trips
+
+        # throughput EMA regression (compile iterations excluded from both
+        # the EMA and the comparison — a retrace wall is not a regression)
+        wall = event.get("wall_ms")
+        compiled = bool(event.get("compiles_delta"))
+        if wall is not None and not compiled:
+            wall = float(wall)
+            ema = self._wall_ema
+            if ema is not None and self._seen > self.warmup_iters:
+                bound = self.throughput_factor * ema
+                if wall > bound:
+                    self._emit(
+                        out, it, "throughput", SEV_WARN,
+                        f"iteration wall {wall:.1f} ms > "
+                        f"{self.throughput_factor:g}x EMA {ema:.1f} ms",
+                        wall, bound,
+                    )
+            a = self.throughput_ema_alpha
+            self._wall_ema = wall if ema is None else (1 - a) * ema + a * wall
+
+        # adaptive-leaf_batch commit-rate collapse
+        rate = gauges.get("grower.commit_rate")
+        k_eff = gauges.get("grower.leaf_batch_effective", 1.0)
+        if (
+            rate is not None
+            and k_eff > 1.0
+            and self._seen > self.warmup_iters
+            and rate < self.commit_rate_floor
+        ):
+            self._emit(
+                out, it, "commit_rate", SEV_WARN,
+                f"batched-growth commit rate {rate:.3f} < "
+                f"{self.commit_rate_floor:g} at K={k_eff:g}",
+                float(rate), self.commit_rate_floor,
+            )
+
+        # int8 near-tie refine-rate spike (only meaningful when engaged)
+        refine = gauges.get("hist/near_tie_refine_rate")
+        if (
+            refine is not None
+            and gauges.get("hist/int8_engaged")
+            and refine > self.refine_rate_ceiling
+        ):
+            self._emit(
+                out, it, "refine_rate", SEV_WARN,
+                f"int8 near-tie refine rate {refine:.3f} > "
+                f"{self.refine_rate_ceiling:g}",
+                float(refine), self.refine_rate_ceiling,
+            )
+
+        # straggler skew (multi-host rollup gauges, when present)
+        skew = gauges.get("straggler/skew")
+        if skew is not None and skew > self.straggler_skew_ceiling:
+            self._emit(
+                out, it, "straggler", SEV_WARN,
+                f"iteration-wall skew max/mean {skew:.2f} > "
+                f"{self.straggler_skew_ceiling:g}",
+                float(skew), self.straggler_skew_ceiling,
+            )
+
+        # HBM watermark growth vs run baseline
+        in_use = gauges.get("memory/hbm_bytes_in_use")
+        if in_use is not None:
+            base = self._hbm_baseline
+            if base is None or in_use < base:
+                self._hbm_baseline = base = float(in_use)
+            bound = max(
+                self.hbm_growth_factor * base,
+                base + self.hbm_growth_floor_bytes,
+            )
+            if in_use > bound:
+                self._emit(
+                    out, it, "hbm", SEV_WARN,
+                    f"device bytes in use {in_use:.3e} > "
+                    f"{self.hbm_growth_factor:g}x baseline {base:.3e}",
+                    float(in_use), bound,
+                )
+
+        if out:
+            flight = get_flight()
+            for alert in out:
+                ses.inc("alerts_total")
+                ses.inc(f"alerts/{alert['rule']}")
+                ses.record_alert(alert)
+                flight.note_alert(alert)
+        return out
+
+    def note_fault(
+        self,
+        rule: str,
+        it: int,
+        message: str,
+        ses: Optional[TelemetrySession] = None,
+    ) -> Dict[str, Any]:
+        """Register an externally-detected critical fault (guard-rail trip)
+        as an active alert — used by the fault-dump path, which runs
+        outside the per-iteration :meth:`observe` cadence.  Syncs the
+        guard-trip counter watermark so a later observe doesn't re-alert
+        the same trip."""
+        alert = {
+            "event": "alert",
+            "rule": rule,
+            "severity": SEV_CRITICAL,
+            "iter": int(it),
+            "message": message,
+            "value": 1.0,
+            "threshold": 0.0,
+        }
+        self._last_fired[rule] = int(it)
+        self._last_alert[rule] = alert
+        self._last_iter = max(self._last_iter, int(it))
+        self.alerts_emitted += 1
+        if ses is not None:
+            self._guard_trips_seen = int(
+                ses.counters.get(
+                    "numerics/guard_trips", self._guard_trips_seen
+                )
+            )
+        return alert
+
+    # -------------------------------------------------------------- status
+    def active_alerts(self) -> List[Dict[str, Any]]:
+        """Alerts whose rule fired within the recent activity window."""
+        return [
+            dict(alert)
+            for rule, alert in sorted(self._last_alert.items())
+            if self._last_iter - self._last_fired[rule] <= self.activity_window
+        ]
+
+    def status(self) -> str:
+        """Worst severity among active alerts: ``ok``/``warn``/``critical``."""
+        worst = 0
+        for alert in self.active_alerts():
+            worst = max(worst, _SEV_RANK.get(alert["severity"], 1))
+        return {0: "ok", 1: SEV_WARN, 2: SEV_CRITICAL}[worst]

@@ -181,6 +181,29 @@ class TelemetrySession:
             else:
                 self._write_locked(event)
 
+    def record_alert(self, event: Dict[str, Any]) -> None:
+        """Record an alert without flushing a deferred iteration event.
+
+        Plain :meth:`record` flushes the pending deferred event first; an
+        alert raised between an iteration's ``update`` and its late eval
+        annotation must not do that (the annotation would land on the
+        alert instead, and the iteration's JSONL line would miss it).  The
+        alert is inserted *before* the pending event in ``events`` and its
+        JSONL line is written immediately; the pending event stays pending
+        and stays ``events[-1]`` for ``annotate_last``.
+        """
+        if not self.enabled:
+            return
+        with self._lock:
+            if self._pending is not None and self.events and (
+                self.events[-1] is self._pending
+            ):
+                self.events.insert(len(self.events) - 1, event)
+            else:
+                self.events.append(event)
+            if self._sink is not None:
+                self._write_locked(event)
+
     def annotate_last(self, fields: Dict[str, Any]) -> None:
         """Merge fields into the most recent event (pre-flush for JSONL)."""
         if not self.enabled:

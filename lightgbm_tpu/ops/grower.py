@@ -226,6 +226,11 @@ class TreeArrays(NamedTuple):
     # while_loop trip count) — the host derives the frontier-batch commit
     # rate (num_leaves-1)/(steps*K) from it to clamp leaf_batch adaptively
     grow_steps: jnp.ndarray  # scalar int32
+    # committed split decisions that took the int8 near-tie f32 refine
+    # (histogram engine v2); always 0 when int8 accumulation is off.  The
+    # host derives hist/near_tie_refine_rate = refine_count / decisions
+    # with decisions = 2*(num_leaves-1) + 1 (root + both children per split)
+    refine_count: jnp.ndarray  # scalar int32
     split_is_cat: jnp.ndarray  # [L-1] bool
     cat_mask: jnp.ndarray  # [L-1, Bm] bool — bin goes left (Bm=1 if no cat)
 
@@ -264,6 +269,57 @@ class _State(NamedTuple):
     forced_ok: jnp.ndarray  # still applying forced splits (n_forced > 0)
     cegb_used: jnp.ndarray  # [F] bool — feature bought (use_cegb)
     steps: jnp.ndarray  # scalar i32 — grow-loop steps (TreeArrays.grow_steps)
+    refines: jnp.ndarray  # scalar i32 — committed near-tie f32 refines
+
+
+def int8_acc_eligible(
+    p: "GrowerParams", quantized: bool = False, monotone: bool = False
+) -> bool:
+    """Shared int8-accumulation gate (histogram engine v2).
+
+    Every input is a static (GrowerParams fields, backend, interpret
+    flag), so the SAME predicate serves both the trace-time engage
+    decision inside ``grow_tree`` and the host-side ``hist/int8_engaged``
+    telemetry gauge — a single source of truth instead of two copies that
+    could drift.  Callers AND this with their own seg-path condition
+    (``hist_mode == "seg"`` and a non-degenerate shape).
+    """
+    from .pallas import seg as _seg_mod
+
+    if quantized or monotone:
+        return False
+    if p.hist_acc == "bf16" or p.axis_name is not None:
+        return False
+    return jax.default_backend() == "tpu" or _seg_mod._INTERPRET
+
+
+def live_plane_fraction(
+    feature_mask, f: int, num_bins: int, n_forced: int = 0
+) -> float:
+    """Host-side mirror of ``grow_tree``'s ``seg_live`` plane-group mask.
+
+    Returns the fraction of seg-histogram plane groups that stay live
+    under the TREE-level feature mask (group 0 is always live; forced
+    splits or a single group disable the skip -> 1.0).  Pure numpy on the
+    already-host-resident mask, so the telemetry gauge
+    ``hist/live_plane_skip_ratio`` = 1 - live_plane_fraction costs no
+    device sync.
+    """
+    import numpy as np
+
+    from .pallas.seg import hist_bpad, hist_group, hist_ngroups
+
+    if n_forced > 0 or f <= 0:
+        return 1.0
+    gb = hist_group(f, hist_bpad(num_bins))
+    ng = hist_ngroups(f, hist_bpad(num_bins))
+    if ng <= 1:
+        return 1.0
+    fm = np.asarray(feature_mask).astype(bool)
+    fm_pad = np.pad(fm, (0, ng * gb - f))
+    live = fm_pad.reshape(ng, gb).any(axis=1)
+    live[0] = True
+    return float(live.sum()) / float(ng)
 
 
 def voting_active(p: "GrowerParams", f: int) -> bool:
@@ -537,6 +593,7 @@ def pack_tree_arrays(ta: "TreeArrays"):
             ta.leaf_depth,
             ta.num_leaves[None],
             ta.grow_steps[None],
+            ta.refine_count[None],
             ta.split_is_cat.astype(jnp.int32),
             ta.cat_mask.astype(jnp.int32).reshape(-1),
         ]
@@ -563,7 +620,8 @@ def unpack_tree_arrays(ints, floats, nn: int, L: int) -> "TreeArrays":
     leaf_depth = ints[off + nn : off + nn + L]
     num_leaves = ints[off + nn + L]
     grow_steps = ints[off + nn + L + 1]
-    off = off + nn + L + 2
+    refine_count = ints[off + nn + L + 2]
+    off = off + nn + L + 3
     split_is_cat = ints[off : off + nn].astype(bool)
     off += nn
     bm = max(1, (len(ints) - off) // max(nn, 1))
@@ -587,6 +645,7 @@ def unpack_tree_arrays(ints, floats, nn: int, L: int) -> "TreeArrays":
         leaf_depth=leaf_depth,
         num_leaves=num_leaves,
         grow_steps=grow_steps,
+        refine_count=refine_count,
         split_is_cat=split_is_cat,
         cat_mask=cat_mask,
     )
@@ -937,15 +996,8 @@ def grow_tree(
         # exact integer grid), any axis_name (distributed reduction semantics
         # and psum byte volumes stay untouched), monotone constraints (the
         # refine re-scan would need the full constraint plumbing).
-        from .pallas import seg as _seg_mod
-
-        use_int8_acc = (
-            use_seg
-            and seg_qs is None
-            and p.hist_acc != "bf16"
-            and p.axis_name is None
-            and mono_arr is None
-            and (jax.default_backend() == "tpu" or _seg_mod._INTERPRET)
+        use_int8_acc = use_seg and int8_acc_eligible(
+            p, quantized=seg_qs is not None, monotone=mono_arr is not None
         )
         if use_int8_acc:
             from .quantize import hist_acc_scales
@@ -1321,6 +1373,11 @@ def grow_tree(
         forced_ok=jnp.asarray(p.n_forced > 0),
         cegb_used=cegb_used0,
         steps=jnp.asarray(0, jnp.int32),
+        refines=(
+            near0.astype(jnp.int32)
+            if use_int8_acc
+            else jnp.asarray(0, jnp.int32)
+        ),
     )
 
     node_ids = jnp.arange(L - 1, dtype=jnp.int32)
@@ -2049,6 +2106,11 @@ def grow_tree(
             # serial fori_loop runs L-1 trips regardless of early done;
             # count only productive steps so commit rate reads 1.0
             steps=st.steps + can_split.astype(jnp.int32),
+            refines=st.refines + (
+                jnp.sum(near2.astype(jnp.int32)) * can_split.astype(jnp.int32)
+                if use_int8_acc
+                else 0
+            ),
         )
 
     def body_batched(st: _State) -> _State:
@@ -2627,6 +2689,19 @@ def grow_tree(
             forced_ok=forced_ok_next,
             cegb_used=st.cegb_used,
             steps=st.steps + 1,
+            # near2 is [2K] ordered [K left, K right]; a refine counts only
+            # when its member committed (speculative members re-run anyway)
+            refines=st.refines + (
+                jnp.sum(
+                    jnp.where(
+                        jnp.concatenate([commit_k, commit_k]),
+                        near2.astype(jnp.int32),
+                        0,
+                    )
+                )
+                if use_int8_acc
+                else 0
+            ),
         )
 
     with jax.named_scope("leaf_loop"):
@@ -2678,6 +2753,7 @@ def grow_tree(
         leaf_depth=state.leaf_depth,
         num_leaves=state.num_leaves,
         grow_steps=state.steps,
+        refine_count=state.refines,
         split_is_cat=state.split_is_cat,
         cat_mask=state.node_cat_mask,
     )

@@ -92,6 +92,104 @@ def maybe_poison_gradients(grad, hess, iteration: int) -> Tuple[Any, Any]:
     return flat.reshape(grad.shape), hess
 
 
+def flight_dump_drill_numerics(workdir: str) -> str:
+    """Drill: poisoned gradients must leave a flight dump behind.
+
+    Arms ``poison_gradients_at`` under ``check_numerics`` on a tiny train,
+    asserts the run dies with :class:`NumericsError` AND that the flight
+    recorder wrote a valid ``flight_*.json`` into ``workdir`` carrying the
+    critical ``numerics`` alert.  Returns the dump path.  Imports lazily —
+    the harness module must stay import-cheap for production runs.
+    """
+    import numpy as np
+
+    from .. import engine
+    from ..dataset import Dataset
+    from . import NumericsError
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 5))
+    y = X[:, 0] + 0.1 * rng.normal(size=300)
+    poison_gradients_at(3)
+    try:
+        try:
+            engine.train(
+                {
+                    "objective": "regression", "num_leaves": 7,
+                    "verbosity": -1, "check_numerics": True,
+                    "checkpoint_dir": workdir,
+                },
+                Dataset(X, y), 6,
+            )
+        except NumericsError:
+            pass
+        else:
+            raise AssertionError(
+                "poisoned gradients did not raise NumericsError"
+            )
+    finally:
+        disarm("poison_gradients")
+    return _assert_flight_dump(workdir, "numerics")
+
+
+def flight_dump_drill_degradation(workdir: str) -> str:
+    """Drill: the fused-kernel degradation latch must leave a flight dump.
+
+    Arms ``force_pallas_raise`` mid-train on the fused path; the run must
+    COMPLETE (the latch falls back to the XLA oracle) and the latch must
+    have dumped the flight ring into ``workdir``.  Returns the dump path.
+    """
+    import numpy as np
+
+    from .. import engine
+    from ..dataset import Dataset
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(600, 8)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    force_pallas_raise(2)
+    try:
+        booster = engine.train(
+            {
+                "objective": "binary", "num_leaves": 7, "verbosity": -1,
+                "hist_mode": "seg", "grow_fused": "on",
+                "checkpoint_dir": workdir,
+            },
+            Dataset(X, y), 4,
+        )
+    finally:
+        disarm("force_pallas_raise")
+    assert booster.current_iteration() >= 1, "degraded run did not continue"
+    return _assert_flight_dump(workdir, "degradation")
+
+
+def _assert_flight_dump(workdir: str, reason_prefix: str) -> str:
+    """Shared dump validity assertions for the drills above."""
+    import json
+
+    from ..obs.flight import FLIGHT_SCHEMA, list_flight_dumps
+
+    dumps = list_flight_dumps(workdir)
+    assert dumps, f"no flight_*.json written to {workdir}"
+    with open(dumps[-1]) as f:
+        doc = json.load(f)
+    assert doc["schema"] == FLIGHT_SCHEMA, doc.get("schema")
+    assert doc["reason"].startswith(reason_prefix), doc["reason"]
+    n_iter_events = sum(
+        1 for e in doc["events"] if e.get("event") == "iteration"
+    )
+    # the contract is "last >= 32 iteration events OR every iteration the
+    # run got through" — these drills die early, so all iterations so far
+    # must be present
+    assert n_iter_events >= min(32, 1), doc["n_events"]
+    if reason_prefix == "numerics":
+        assert any(
+            a.get("rule") == "numerics" and a.get("severity") == "critical"
+            for a in doc["alerts"]
+        ), f"numerics alert missing from dump alerts: {doc['alerts']}"
+    return dumps[-1]
+
+
 def maybe_raise_pallas(where: str, iteration: Optional[int] = None) -> None:
     """Consulted before dispatching the fused Pallas grow step.
 

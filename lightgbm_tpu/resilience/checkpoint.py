@@ -18,7 +18,7 @@ import re
 import tempfile
 from typing import List, Optional, Tuple
 
-from ..obs import get_session
+from ..obs import get_flight, get_session
 from ..utils.log import log_info
 
 _CKPT_RE = re.compile(r"^ckpt_iter_(\d+)\.pkl$")
@@ -100,9 +100,12 @@ def save_checkpoint(booster, directory: str, keep_last: Optional[int] = None) ->
     atomic_write_bytes(path, pickle.dumps(state, protocol=4))
     ses = get_session()
     ses.inc("checkpoints_saved")
-    ses.record(
-        {"event": "checkpoint", "iter": state["iter"], "path": path}, defer=True
-    )
+    event = {"event": "checkpoint", "iter": state["iter"], "path": path}
+    ses.record(event, defer=True)
+    # a fault dump names the newest durable checkpoint it pairs with
+    flight = get_flight()
+    flight.note_checkpoint(path)
+    flight.note_event(event)
     if keep_last and keep_last > 0:
         for _, old in list_checkpoints(directory)[:-keep_last]:
             try:

@@ -1,0 +1,527 @@
+"""Live ops plane: flight recorder, health watchdog, metrics exporter.
+
+Covers the always-on ring buffer (bounds/eviction/atomic dumps), the
+per-iteration alert rules against synthetic telemetry, the Prometheus
+text exporter (schema + line format + HTTP endpoint), the chaos-drill
+fault dumps, and the zero-retrace contract for the whole plane.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import textwrap
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.obs.export import (  # noqa: E402
+    MetricsExporter,
+    health_snapshot,
+    prometheus_snapshot,
+    sanitize_metric_name,
+)
+from lightgbm_tpu.obs.flight import (  # noqa: E402
+    FLIGHT_SCHEMA,
+    MIN_CAPACITY,
+    FlightRecorder,
+    get_flight,
+    list_flight_dumps,
+)
+from lightgbm_tpu.obs.health import (  # noqa: E402
+    SEV_CRITICAL,
+    SEV_WARN,
+    HealthWatchdog,
+)
+from lightgbm_tpu.obs.registry import TelemetrySession, get_session  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    ses = get_session()
+    ses.configure(enabled=False)
+    ses.reset()
+    flight = get_flight()
+    flight.reset()
+    flight.configure(fault_dir="", run_info={}, active=True)
+    yield
+    ses.configure(enabled=False)
+    ses.reset()
+    flight.reset()
+    flight.configure(fault_dir="", run_info={}, active=True)
+
+
+def _data(n=400, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n)
+    return X, y
+
+
+def _iter_event(i, wall=10.0, **extra):
+    e = {"event": "iteration", "iter": i, "wall_ms": wall}
+    e.update(extra)
+    return e
+
+
+# ------------------------------------------------------------- flight ring
+def test_ring_bounds_and_eviction():
+    fr = FlightRecorder(capacity=40)
+    for i in range(100):
+        fr.note_event(_iter_event(i))
+    events = fr.events()
+    assert len(events) == 40
+    assert events[0]["iter"] == 60  # oldest 60 evicted
+    assert events[-1]["iter"] == 99
+
+
+def test_ring_capacity_floor_and_reconfigure():
+    fr = FlightRecorder(capacity=1)
+    assert fr.capacity == MIN_CAPACITY
+    fr.configure(capacity=64)
+    assert fr.capacity == 64
+    for i in range(10):
+        fr.note_event(_iter_event(i))
+    fr.configure(capacity=48)  # reconfigure keeps buffered events
+    assert [e["iter"] for e in fr.events()] == list(range(10))
+
+
+def test_alert_history_survives_event_burst():
+    fr = FlightRecorder(capacity=32)
+    alert = {"event": "alert", "rule": "hbm", "severity": SEV_WARN, "iter": 3}
+    fr.note_alert(alert)
+    for i in range(500):
+        fr.note_event(_iter_event(i))
+    # the alert was evicted from the event ring by the burst...
+    assert all(e.get("event") != "alert" for e in fr.events())
+    # ...but the dedicated alert history still has it for the dump
+    assert fr.alerts() == [alert]
+
+
+def test_inactive_recorder_records_nothing(tmp_path):
+    fr = FlightRecorder()
+    fr.configure(fault_dir=str(tmp_path), active=False)
+    fr.note_event(_iter_event(0))
+    assert fr.events() == []
+    assert fr.dump("test") == ""
+    assert list_flight_dumps(str(tmp_path)) == []
+
+
+# ------------------------------------------------------------ atomic dumps
+def test_dump_atomicity_and_schema(tmp_path):
+    fr = FlightRecorder(capacity=64)
+    fr.configure(
+        fault_dir=str(tmp_path), run_info={"objective": "regression"}
+    )
+    for i in range(50):
+        fr.note_event(_iter_event(i))
+    fr.note_alert(
+        {"event": "alert", "rule": "numerics", "severity": SEV_CRITICAL,
+         "iter": 49, "message": "boom"}
+    )
+    fr.note_checkpoint(str(tmp_path / "ckpt_iter_00000048.pkl"))
+    path = fr.dump("numerics_test")
+    assert os.path.isfile(path)
+    # tmp+rename: no stray temp files next to the dump
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["schema"] == FLIGHT_SCHEMA
+    assert doc["reason"] == "numerics_test"
+    assert doc["run_info"] == {"objective": "regression"}
+    assert doc["last_checkpoint"].endswith("ckpt_iter_00000048.pkl")
+    iters = [e for e in doc["events"] if e["event"] == "iteration"]
+    assert len(iters) >= MIN_CAPACITY
+    assert doc["alerts"][-1]["rule"] == "numerics"
+    assert doc["n_events"] == len(doc["events"])
+    # second dump gets a distinct filename even within the same second
+    path2 = fr.dump("numerics_test")
+    assert path2 != path
+    assert list_flight_dumps(str(tmp_path)) == [path, path2]
+
+
+def test_dump_without_directory_is_silent_noop():
+    fr = FlightRecorder()
+    fr.note_event(_iter_event(0))
+    assert fr.dump("whatever") == ""
+
+
+# --------------------------------------------------------- watchdog rules
+def _warm_watchdog(wd, ses, n=None, wall=10.0):
+    n = wd.warmup_iters + 2 if n is None else n
+    alerts = []
+    for i in range(n):
+        alerts += wd.observe(_iter_event(i, wall=wall), ses)
+    return n
+
+
+def _fresh_session():
+    ses = TelemetrySession()
+    ses.configure(enabled=True)
+    return ses
+
+
+def test_throughput_rule_and_compile_exclusion():
+    ses = _fresh_session()
+    wd = HealthWatchdog()
+    n = _warm_watchdog(wd, ses, wall=10.0)
+    # a compile iteration's wall spike is NOT a regression
+    out = wd.observe(_iter_event(n, wall=500.0, compiles_delta=2), ses)
+    assert out == []
+    out = wd.observe(_iter_event(n + 1, wall=500.0), ses)
+    assert [a["rule"] for a in out] == ["throughput"]
+    assert out[0]["severity"] == SEV_WARN
+    assert out[0]["value"] == 500.0
+    assert ses.counters["alerts_total"] == 1
+    assert ses.counters["alerts/throughput"] == 1
+
+
+def test_rule_cooldown_suppresses_repeat_alerts():
+    ses = _fresh_session()
+    wd = HealthWatchdog(cooldown_iters=10)
+    n = _warm_watchdog(wd, ses, wall=10.0)
+    assert wd.observe(_iter_event(n, wall=900.0), ses)
+    # persistently slow: within the cooldown window nothing new fires
+    fired = []
+    for i in range(n + 1, n + 8):
+        fired += wd.observe(_iter_event(i, wall=900.0), ses)
+    assert fired == []
+    assert wd.alerts_emitted == 1
+    # the remembered alert tracked the reading while the rule stayed armed
+    # (at n+1 the wall still beat the bound; after that the EMA absorbed
+    # the sustained level, which is exactly the regression-not-new-normal
+    # semantics the EMA gives us)
+    assert wd.active_alerts()[0]["iter"] == n + 1
+
+
+def test_numerics_rule_is_critical_and_skips_warmup():
+    ses = _fresh_session()
+    wd = HealthWatchdog()
+    ses.inc("numerics/guard_trips")
+    out = wd.observe(_iter_event(0), ses)
+    assert [a["rule"] for a in out] == ["numerics"]
+    assert out[0]["severity"] == SEV_CRITICAL
+    assert wd.status() == "critical"
+    # same trip count -> no re-alert
+    assert wd.observe(_iter_event(1), ses) == []
+
+
+def test_commit_rate_rule_requires_batched_growth():
+    ses = _fresh_session()
+    wd = HealthWatchdog(commit_rate_floor=0.25)
+    ses.set_gauge("grower.commit_rate", 0.1)
+    ses.set_gauge("grower.leaf_batch_effective", 1.0)
+    n = _warm_watchdog(wd, ses)
+    assert wd.active_alerts() == []  # K=1: rule disarmed
+    ses.set_gauge("grower.leaf_batch_effective", 4.0)
+    out = wd.observe(_iter_event(n), ses)
+    assert [a["rule"] for a in out] == ["commit_rate"]
+
+
+def test_refine_rate_rule_requires_int8_engaged():
+    ses = _fresh_session()
+    wd = HealthWatchdog(refine_rate_ceiling=0.5)
+    ses.set_gauge("hist/near_tie_refine_rate", 0.9)
+    n = _warm_watchdog(wd, ses)
+    assert wd.active_alerts() == []  # not engaged: rule disarmed
+    ses.set_gauge("hist/int8_engaged", 1.0)
+    out = wd.observe(_iter_event(n), ses)
+    assert [a["rule"] for a in out] == ["refine_rate"]
+
+
+def test_straggler_and_hbm_rules():
+    ses = _fresh_session()
+    wd = HealthWatchdog(
+        straggler_skew_ceiling=1.5,
+        hbm_growth_factor=1.5,
+        hbm_growth_floor_bytes=1024,
+    )
+    ses.set_gauge("memory/hbm_bytes_in_use", 1e6)
+    n = _warm_watchdog(wd, ses)
+    assert wd.active_alerts() == []
+    ses.set_gauge("straggler/skew", 2.0)
+    ses.set_gauge("memory/hbm_bytes_in_use", 1e6 * 1.6)
+    out = wd.observe(_iter_event(n), ses)
+    assert sorted(a["rule"] for a in out) == ["hbm", "straggler"]
+    assert wd.status() == "warn"
+
+
+def test_alerts_expire_from_active_window():
+    ses = _fresh_session()
+    wd = HealthWatchdog(activity_window=5, cooldown_iters=3)
+    ses.inc("numerics/guard_trips")
+    wd.observe(_iter_event(0), ses)
+    assert wd.status() == "critical"
+    for i in range(1, 10):
+        wd.observe(_iter_event(i), ses)
+    assert wd.active_alerts() == []
+    assert wd.status() == "ok"
+
+
+def test_note_fault_registers_active_alert_without_observe():
+    ses = _fresh_session()
+    ses.inc("numerics/guard_trips")
+    wd = HealthWatchdog()
+    wd.note_fault("numerics", 7, "gradient non-finite", ses=ses)
+    assert wd.status() == "critical"
+    assert wd.active_alerts()[0]["message"] == "gradient non-finite"
+    # the watermark synced: a later observe doesn't double-alert
+    assert wd.observe(_iter_event(8), ses) == []
+
+
+def test_record_alert_preserves_deferred_iteration_line(tmp_path):
+    sink = str(tmp_path / "events.jsonl")
+    ses = TelemetrySession()
+    ses.configure(enabled=True, sink_path=sink)
+    ses.record({"event": "iteration", "iter": 0}, defer=True)
+    ses.record_alert({"event": "alert", "rule": "hbm", "iter": 0})
+    ses.annotate_last({"eval": {"t": {"l2": 1.0}}})
+    ses.close()
+    lines = [json.loads(x) for x in open(sink)]
+    assert [e["event"] for e in lines] == ["alert", "iteration"]
+    # the late eval annotation landed on the iteration, not the alert
+    assert lines[1]["eval"] == {"t": {"l2": 1.0}}
+    assert "eval" not in lines[0]
+    assert [e["event"] for e in ses.events] == ["alert", "iteration"]
+
+
+# ------------------------------------------------------------- exporter
+def test_sanitize_metric_name():
+    assert sanitize_metric_name("hist/near_tie_refines") == (
+        "lgbtpu_hist_near_tie_refines"
+    )
+    assert sanitize_metric_name("grower.commit_rate") == (
+        "lgbtpu_grower_commit_rate"
+    )
+    assert sanitize_metric_name("9lives") == "lgbtpu__9lives"
+    assert sanitize_metric_name("a//b..c") == "lgbtpu_a_b_c"
+
+
+def test_prometheus_snapshot_format():
+    ses = get_session()
+    ses.configure(enabled=True)
+    ses.inc("iterations", 5)
+    ses.inc("hist/near_tie_refines_total", 3)
+    ses.set_gauge("grower.commit_rate", 0.75)
+    ses.set_gauge("hist/int8_engaged", 1.0)
+    wd = HealthWatchdog()
+    wd.note_fault("numerics", 4, "boom", ses=ses)
+    text = prometheus_snapshot(ses, health=health_snapshot(wd, ses))
+    lines = text.strip().splitlines()
+    import re
+
+    sample_re = re.compile(
+        r"^[a-zA-Z_][a-zA-Z0-9_]*(\{[^}]*\})? -?[0-9][0-9.e+-]*$"
+    )
+    samples = [ln for ln in lines if not ln.startswith("#")]
+    assert samples, text
+    for ln in samples:
+        assert sample_re.match(ln), f"bad exposition line: {ln!r}"
+        assert ln.startswith("lgbtpu_"), ln
+    by_name = {ln.split(" ")[0]: ln.rsplit(" ", 1)[1] for ln in samples}
+    assert by_name["lgbtpu_up"] == "1"
+    assert by_name["lgbtpu_iterations_total"] == "5"
+    assert by_name["lgbtpu_grower_commit_rate"] == "0.75"
+    assert by_name["lgbtpu_health_status"] == "2"
+    assert (
+        'lgbtpu_alert_active{rule="numerics",severity="critical"}' in by_name
+    )
+    # every sample has a TYPE line; counters carry the _total suffix
+    typed = {
+        ln.split(" ")[2] for ln in lines if ln.startswith("# TYPE ")
+    }
+    for name in by_name:
+        assert name.split("{")[0] in typed, name
+    assert "lgbtpu_iterations_total" in typed
+
+
+def test_health_snapshot_schema():
+    ses = get_session()
+    ses.configure(enabled=True)
+    ses.inc("iterations", 3)
+    wd = HealthWatchdog()
+    doc = health_snapshot(wd, ses)
+    assert doc["schema"] == "lgbtpu.health.v1"
+    assert doc["status"] == "ok"
+    assert doc["iter"] == 3
+    assert doc["alerts"] == []
+    assert set(doc["flight"]) == {
+        "capacity", "n_events", "last_dump", "last_checkpoint",
+    }
+    json.dumps(doc)  # JSON-serializable end to end
+
+
+def test_exporter_http_endpoint():
+    ses = get_session()
+    ses.configure(enabled=True)
+    ses.inc("iterations", 2)
+    exporter = MetricsExporter(0)  # ephemeral port
+    try:
+        port = exporter.start()
+        assert port > 0 and exporter.url
+        body = urllib.request.urlopen(
+            exporter.url + "/metrics", timeout=5
+        ).read().decode()
+        assert "lgbtpu_iterations_total 2" in body
+        health = json.loads(
+            urllib.request.urlopen(
+                exporter.url + "/healthz", timeout=5
+            ).read()
+        )
+        assert health["schema"] == "lgbtpu.health.v1"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(exporter.url + "/nope", timeout=5)
+    finally:
+        exporter.stop()
+    assert exporter.port == 0  # stopped
+
+
+# -------------------------------------------------- end-to-end fault paths
+def test_chaos_drill_numerics_flight_dump(tmp_path):
+    from lightgbm_tpu.resilience import chaos
+
+    path = chaos.flight_dump_drill_numerics(str(tmp_path))
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["reason"].startswith("numerics")
+    assert any(a["rule"] == "numerics" for a in doc["alerts"])
+
+
+def test_chaos_drill_degradation_flight_dump(tmp_path):
+    from lightgbm_tpu.resilience import chaos
+
+    path = chaos.flight_dump_drill_degradation(str(tmp_path))
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "degradation"
+    assert any(e.get("event") == "degradation" for e in doc["events"])
+
+
+def test_sigterm_dumps_flight_ring(tmp_path):
+    script = textwrap.dedent(
+        """
+        import os, signal, sys
+        from lightgbm_tpu.obs.flight import get_flight, install_sigterm_handler
+
+        flight = get_flight()
+        flight.configure(fault_dir=sys.argv[1], run_info={"drill": "sigterm"})
+        for i in range(40):
+            flight.note_event({"event": "iteration", "iter": i})
+        assert install_sigterm_handler()
+        os.kill(os.getpid(), signal.SIGTERM)
+        raise SystemExit("survived SIGTERM")
+        """
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", script, str(tmp_path)],
+        capture_output=True, text=True, env=env, timeout=240,
+    )
+    assert proc.returncode == -signal.SIGTERM, (
+        proc.returncode, proc.stderr
+    )
+    dumps = list_flight_dumps(str(tmp_path))
+    assert len(dumps) == 1
+    with open(dumps[0]) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "sigterm"
+    assert doc["run_info"] == {"drill": "sigterm"}
+    assert sum(1 for e in doc["events"] if e["event"] == "iteration") >= 32
+
+
+def test_booster_health_api_and_exporter_during_training(tmp_path):
+    X, y = _data()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    scraped = {}
+
+    def scrape(env):
+        if env.iteration == 2 and not scraped:
+            url = f"http://127.0.0.1:{port}"
+            scraped["metrics"] = urllib.request.urlopen(
+                url + "/metrics", timeout=5
+            ).read().decode()
+            scraped["health"] = json.loads(
+                urllib.request.urlopen(url + "/healthz", timeout=5).read()
+            )
+
+    booster = lgb.train(
+        {
+            "objective": "regression", "num_leaves": 7, "verbosity": -1,
+            "telemetry": True, "obs_export_port": port,
+        },
+        lgb.Dataset(X, y), 5, callbacks=[scrape],
+    )
+    assert scraped, "scrape callback never ran"
+    assert "lgbtpu_iterations_total" in scraped["metrics"]
+    assert "lgbtpu_health_status 0" in scraped["metrics"]
+    assert scraped["health"]["status"] == "ok"
+    assert scraped["health"]["iter"] >= 2
+    doc = booster.health()
+    assert doc["schema"] == "lgbtpu.health.v1"
+    assert doc["iter"] == 5
+    assert doc["status"] == "ok"
+    # the endpoint is torn down with the train loop
+    with pytest.raises(Exception):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=2
+        )
+    # flight ring followed the run (last events are iterations 0..4)
+    flight_iters = [
+        e["iter"] for e in get_flight().events()
+        if e.get("event") == "iteration"
+    ]
+    assert flight_iters == list(range(5))
+
+
+def test_hist_gauges_present_when_telemetry_on():
+    X, y = _data()
+    lgb.train(
+        {
+            "objective": "regression", "num_leaves": 7, "verbosity": -1,
+            "telemetry": True, "feature_fraction": 0.5,
+            # the live-plane skip + int8 engage decisions are seg-histogram
+            # features; the gauges are only published when that plane exists
+            "hist_mode": "seg",
+        },
+        lgb.Dataset(X, y), 3,
+    )
+    gauges = get_session().gauges
+    assert "hist/int8_engaged" in gauges
+    assert "hist/live_plane_skip_ratio" in gauges
+    assert 0.0 <= gauges["hist/live_plane_skip_ratio"] <= 1.0
+
+
+# ------------------------------------------------------- retrace contract
+def test_live_plane_zero_retrace_delta(tmp_path):
+    X, y = _data()
+    base = {"objective": "regression", "num_leaves": 7, "verbosity": -1}
+    # warm every jit label with the plane disabled
+    lgb.train(dict(base, health_watchdog=False), lgb.Dataset(X, y), 3)
+    before = dict(lgb.compile_counts_by_label())
+    # same shapes with the full live plane on: watchdog + flight + exporter
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    lgb.train(
+        dict(
+            base,
+            telemetry=True,
+            telemetry_out=str(tmp_path / "events.jsonl"),
+            health_watchdog=True,
+            obs_export_port=port,
+            flight_capacity=64,
+        ),
+        lgb.Dataset(X, y), 3,
+    )
+    after = dict(lgb.compile_counts_by_label())
+    assert after == before, (
+        f"live ops plane caused retraces: before={before} after={after}"
+    )

@@ -1,0 +1,371 @@
+"""Live terminal dashboard for a running lightgbm_tpu training job.
+
+Two data sources, pick one:
+
+    python tools/obs_top.py --endpoint http://127.0.0.1:9184
+    python tools/obs_top.py --tail events.jsonl
+
+``--endpoint`` polls the opt-in metrics exporter (``obs_export_port``),
+scraping ``/metrics`` (Prometheus text) and ``/healthz`` (JSON) each
+refresh.  ``--tail`` follows a telemetry JSONL file (``telemetry_out``)
+from its current end, consuming iteration/alert/predict events as the
+trainer appends them.  Either way the frame shows: health status,
+iterations + iters/s, wall and phase p50/p99 over a sliding window,
+collective-byte gauges, key histogram/int8 gauges, and the most recent
+alerts.
+
+Dependency-free by design: plain ANSI escapes (no curses), stdlib HTTP
+client, nearest-rank percentiles.  ``--once`` renders a single frame and
+exits (used by the test suite and handy for cron snapshots).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+CLEAR = "\x1b[2J\x1b[H"
+BOLD = "\x1b[1m"
+DIM = "\x1b[2m"
+RED = "\x1b[31m"
+YELLOW = "\x1b[33m"
+GREEN = "\x1b[32m"
+RESET = "\x1b[0m"
+
+_STATUS_COLOR = {"ok": GREEN, "warn": YELLOW, "critical": RED}
+
+
+def _percentile(vals: List[float], q: float) -> float:
+    """Nearest-rank percentile (matches tools/telemetry_summary.py)."""
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+def parse_prometheus(text: str) -> Dict[str, float]:
+    """Parse Prometheus text exposition into {name_or_series: value}.
+
+    Labeled series keep their label block as part of the key, so
+    ``lgbtpu_alert_active{rule="hbm",severity="warn"}`` stays distinct.
+    """
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        # value is the last whitespace-separated token; the name (with an
+        # optional {label} block that may itself contain spaces) is the rest
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name.strip()] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+class TopState:
+    """Sliding-window aggregation shared by both data sources."""
+
+    def __init__(self, window: int = 120) -> None:
+        self.window = int(window)
+        self.iter_marks: Deque[Tuple[float, float]] = deque(maxlen=self.window)
+        self.walls: Deque[float] = deque(maxlen=self.window)
+        self.phases: Dict[str, Deque[float]] = {}
+        self.predict_phases: Dict[str, Deque[float]] = {}
+        self.alerts: Deque[Dict[str, Any]] = deque(maxlen=8)
+        self.metrics: Dict[str, float] = {}
+        self.health: Dict[str, Any] = {}
+        self.iterations = 0.0
+        self.source = ""
+        self.error = ""
+
+    # ---------------------------------------------------------- ingestion
+    def update_from_metrics(
+        self,
+        metrics: Dict[str, float],
+        health: Optional[Dict[str, Any]],
+        now: Optional[float] = None,
+    ) -> None:
+        now = time.time() if now is None else now
+        self.metrics = metrics
+        self.health = health or {}
+        self.error = ""
+        iters = metrics.get("lgbtpu_iterations_total", 0.0)
+        if not self.iter_marks or iters != self.iter_marks[-1][1]:
+            self.iter_marks.append((now, iters))
+        self.iterations = iters
+        for alert in self.health.get("alerts") or []:
+            if not any(
+                a.get("rule") == alert.get("rule")
+                and a.get("iter") == alert.get("iter")
+                for a in self.alerts
+            ):
+                self.alerts.append(alert)
+
+    def update_from_events(
+        self, events: List[Dict[str, Any]], now: Optional[float] = None
+    ) -> None:
+        now = time.time() if now is None else now
+        for e in events:
+            kind = e.get("event")
+            if kind == "iteration":
+                self.iterations = float(e.get("iter", self.iterations)) + 1
+                self.iter_marks.append((now, self.iterations))
+                if "wall_ms" in e:
+                    self.walls.append(float(e["wall_ms"]))
+                for k, v in (e.get("phases") or {}).items():
+                    self.phases.setdefault(
+                        k, deque(maxlen=self.window)
+                    ).append(float(v))
+            elif kind == "alert":
+                self.alerts.append(e)
+            elif kind == "predict":
+                for k, v in (e.get("phases") or {}).items():
+                    self.predict_phases.setdefault(
+                        k, deque(maxlen=self.window)
+                    ).append(float(v))
+            elif kind == "train_summary":
+                for k, v in (e.get("gauges") or {}).items():
+                    if isinstance(v, (int, float)):
+                        self.metrics["gauge:" + k] = float(v)
+
+    # --------------------------------------------------------- derivation
+    def iters_per_sec(self) -> float:
+        if len(self.iter_marks) < 2:
+            return 0.0
+        (t0, i0), (t1, i1) = self.iter_marks[0], self.iter_marks[-1]
+        dt = t1 - t0
+        return (i1 - i0) / dt if dt > 0 else 0.0
+
+    def status(self) -> str:
+        if self.health:
+            return str(self.health.get("status", "ok"))
+        rank = self.metrics.get("lgbtpu_health_status")
+        if rank is not None:
+            return {0: "ok", 1: "warn", 2: "critical"}.get(int(rank), "warn")
+        worst = ""
+        for a in self.alerts:
+            if a.get("severity") == "critical":
+                return "critical"
+            worst = "warn"
+        return worst or "ok"
+
+    def gauge(self, name: str) -> Optional[float]:
+        """Look a gauge up under either source's naming."""
+        for key in (
+            "gauge:" + name,
+            "lgbtpu_" + name.replace("/", "_").replace(".", "_"),
+        ):
+            if key in self.metrics:
+                return self.metrics[key]
+        g = (self.health.get("gauges") or {}).get(name)
+        return float(g) if g is not None else None
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def render_frame(state: TopState, width: int = 78, color: bool = True) -> str:
+    """Pure frame renderer — returns the dashboard text for one refresh."""
+
+    def c(code: str, s: str) -> str:
+        return f"{code}{s}{RESET}" if color else s
+
+    status = state.status()
+    lines: List[str] = []
+    lines.append(
+        c(BOLD, "lgbtpu obs_top")
+        + f"  {state.source}"
+        + "  health: "
+        + c(_STATUS_COLOR.get(status, YELLOW), status.upper())
+    )
+    if state.error:
+        lines.append(c(RED, f"  source error: {state.error}"))
+    lines.append(
+        f"  iter {int(state.iterations)}"
+        f"   {state.iters_per_sec():.2f} it/s"
+        + (
+            f"   wall p50 {_percentile(list(state.walls), 50):.1f} ms"
+            f"  p99 {_percentile(list(state.walls), 99):.1f} ms"
+            if state.walls
+            else ""
+        )
+    )
+    if state.phases:
+        lines.append(c(DIM, "  train phases (ms)      p50      p99"))
+        for k in sorted(state.phases):
+            vals = list(state.phases[k])
+            lines.append(
+                f"    {k:<18}{_percentile(vals, 50):>9.2f}"
+                f"{_percentile(vals, 99):>9.2f}"
+            )
+    if state.predict_phases:
+        lines.append(c(DIM, "  predict phases (ms)    p50      p99"))
+        for k in sorted(state.predict_phases):
+            vals = list(state.predict_phases[k])
+            lines.append(
+                f"    {k:<18}{_percentile(vals, 50):>9.2f}"
+                f"{_percentile(vals, 99):>9.2f}"
+            )
+    gauge_rows: List[str] = []
+    for label, name, fmt in (
+        ("int8 engaged", "hist/int8_engaged", "{:.0f}"),
+        ("near-tie refine rate", "hist/near_tie_refine_rate", "{:.3f}"),
+        ("live-plane skip", "hist/live_plane_skip_ratio", "{:.3f}"),
+        ("commit rate", "grower.commit_rate", "{:.3f}"),
+        ("straggler skew", "straggler/skew", "{:.2f}"),
+    ):
+        v = state.gauge(name)
+        if v is not None:
+            gauge_rows.append(f"    {label:<22}{fmt.format(v):>10}")
+    for label, name in (
+        ("hbm in use", "memory/hbm_bytes_in_use"),
+        ("collective hist", "collective_hist_bytes"),
+        ("collective ring/dev", "collective_ring_bytes_per_device"),
+    ):
+        v = state.gauge(name)
+        if v is not None:
+            gauge_rows.append(f"    {label:<22}{_fmt_bytes(v):>10}")
+    if gauge_rows:
+        lines.append(c(DIM, "  gauges"))
+        lines.extend(gauge_rows)
+    lines.append(
+        c(DIM, f"  alerts (last {len(state.alerts)})")
+        if state.alerts
+        else c(DIM, "  alerts: none")
+    )
+    for a in list(state.alerts)[-8:]:
+        sev = str(a.get("severity", "warn"))
+        lines.append(
+            "    "
+            + c(_STATUS_COLOR.get(sev, YELLOW), f"[{sev}]")
+            + f" it{a.get('iter', '?')} {a.get('rule', '?')}: "
+            + str(a.get("message", ""))[: max(10, width - 30)]
+        )
+    return "\n".join(line[: width + 24] for line in lines) + "\n"
+
+
+# ------------------------------------------------------------- data sources
+
+
+def _fetch(url: str, timeout: float) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def poll_endpoint(state: TopState, base: str, timeout: float = 2.0) -> None:
+    base = base.rstrip("/")
+    try:
+        metrics = parse_prometheus(
+            _fetch(base + "/metrics", timeout).decode("utf-8")
+        )
+        try:
+            health = json.loads(_fetch(base + "/healthz", timeout))
+        except Exception:
+            health = None
+        state.update_from_metrics(metrics, health)
+    except Exception as e:  # endpoint gone == run finished; keep last frame
+        state.error = str(e)
+
+
+class JsonlTail:
+    """Incremental reader for an append-only telemetry JSONL file."""
+
+    def __init__(self, path: str, from_start: bool = False) -> None:
+        self.path = path
+        self._pos = 0
+        if not from_start:
+            try:
+                import os
+
+                self._pos = os.path.getsize(path)
+            except OSError:
+                self._pos = 0
+
+    def read_new(self) -> List[Dict[str, Any]]:
+        events: List[Dict[str, Any]] = []
+        try:
+            with open(self.path) as fp:
+                fp.seek(self._pos)
+                for line in fp:
+                    if not line.endswith("\n"):
+                        break  # partial trailing write; re-read next poll
+                    self._pos += len(line)
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        events.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+        except OSError:
+            pass
+        return events
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live dashboard for lightgbm_tpu training telemetry"
+    )
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument(
+        "--endpoint", help="metrics exporter base URL (obs_export_port)"
+    )
+    src.add_argument("--tail", help="telemetry JSONL file to follow")
+    ap.add_argument(
+        "--interval", type=float, default=1.0, help="refresh seconds"
+    )
+    ap.add_argument(
+        "--from-start",
+        action="store_true",
+        help="with --tail, consume the whole file instead of only new lines",
+    )
+    ap.add_argument(
+        "--once", action="store_true", help="render one frame and exit"
+    )
+    ap.add_argument("--no-color", action="store_true")
+    ap.add_argument("--width", type=int, default=78)
+    args = ap.parse_args(argv)
+
+    state = TopState()
+    tail: Optional[JsonlTail] = None
+    if args.tail:
+        state.source = f"tail:{args.tail}"
+        # --once over a file only makes sense from the start
+        tail = JsonlTail(args.tail, from_start=args.from_start or args.once)
+    else:
+        state.source = f"endpoint:{args.endpoint}"
+
+    color = not args.no_color and sys.stdout.isatty()
+    try:
+        while True:
+            if tail is not None:
+                state.update_from_events(tail.read_new())
+            else:
+                poll_endpoint(state, args.endpoint)
+            frame = render_frame(state, width=args.width, color=color)
+            if args.once:
+                sys.stdout.write(frame)
+                return 0
+            sys.stdout.write(CLEAR + frame)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        sys.stdout.write("\n")
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -57,6 +57,69 @@ lgb.train(
 PYEOF
 rm -f "$tel_out"
 
+# live-obs smoke: a 3-iteration train must serve parseable Prometheus
+# text from the opt-in exporter WHILE training (scraped from an iteration
+# callback), the chaos drills must each leave a valid flight dump behind,
+# and the offline tools must digest both artifacts.
+echo "=== live-obs smoke (exporter scrape + chaos flight dumps + obs_top) ==="
+python - <<'PYEOF' || rc=$?
+import json
+import socket
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.resilience import chaos
+
+with socket.socket() as s:
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+
+scraped = {}
+
+def scrape(env):
+    if env.iteration == 1 and not scraped:
+        url = f"http://127.0.0.1:{port}"
+        scraped["metrics"] = urllib.request.urlopen(
+            url + "/metrics", timeout=5).read().decode()
+        scraped["health"] = json.loads(
+            urllib.request.urlopen(url + "/healthz", timeout=5).read())
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(400, 6))
+y = X[:, 0] + 0.1 * rng.normal(size=400)
+tel = tempfile.mktemp(suffix=".jsonl")
+booster = lgb.train(
+    {"objective": "regression", "num_leaves": 7, "verbosity": -1,
+     "telemetry": True, "telemetry_out": tel, "obs_export_port": port},
+    lgb.Dataset(X, y), 3, callbacks=[scrape],
+)
+assert scraped, "exporter scrape callback never ran"
+for line in scraped["metrics"].splitlines():  # parseable exposition text
+    assert line.startswith("#") or len(line.split(" ")) == 2, line
+assert "lgbtpu_iterations_total" in scraped["metrics"]
+assert scraped["health"]["status"] == "ok"
+assert booster.health()["iter"] == 3
+print("live-obs smoke: exporter served parseable metrics during training")
+
+dumps = []
+for drill in (chaos.flight_dump_drill_numerics,
+              chaos.flight_dump_drill_degradation):
+    wd = tempfile.mkdtemp(prefix="lgbm_tpu_flight_smoke_")
+    dumps.append(drill(wd))
+    print(f"live-obs smoke: {drill.__name__} -> {dumps[-1]}")
+
+for tool_args in ([ "tools/telemetry_summary.py", "--flight"] + dumps,
+                  ["tools/obs_top.py", "--tail", tel, "--once",
+                   "--no-color"]):
+    r = subprocess.run([sys.executable] + tool_args, capture_output=True)
+    assert r.returncode == 0, (tool_args, r.stderr.decode())
+print("live-obs smoke: flight dumps + offline tools OK")
+PYEOF
+
 # perf-contract gate: collect the deterministic telemetry slice (retraces
 # by label, analytic+measured collective bytes, executable FLOPs/temp HBM)
 # and diff it against the committed contract.  HARD gate — any drift in a

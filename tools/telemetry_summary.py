@@ -2,12 +2,15 @@
 
 Usage:
     python tools/telemetry_summary.py events.jsonl [more.jsonl ...]
+    python tools/telemetry_summary.py --flight flight_*.json
     python -m lightgbm_tpu ... telemetry=true telemetry_out=events.jsonl
 
 Prints one human block per file: iteration count, wall/phase means with
 p50/p99 percentiles, compile deltas, collective-byte totals (analytic and
-measured), cost/memory gauge columns from the train_summary event, plus
-predict-event rollups when present.  Exits non-zero on empty or unparseable
+measured), cost/memory gauge columns from the train_summary event,
+watchdog alert rollups, plus predict-event rollups (with per-phase
+p50/p99) when present.  ``--flight`` switches to pretty-printing flight
+recorder fault dumps instead.  Exits non-zero on empty or unparseable
 input so CI smoke checks can gate on it (tools/run_tests.sh runs a
 3-iteration train through this).
 """
@@ -118,6 +121,21 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     rollups = [e for e in events if e.get("event") == "host_rollup"]
     if rollups:
         out["hosts"] = rollups[-1].get("hosts")
+    alerts = [e for e in events if e.get("event") == "alert"]
+    if alerts:
+        by_rule: Dict[str, int] = defaultdict(int)
+        worst = "warn"
+        for a in alerts:
+            by_rule[str(a.get("rule", "unknown"))] += 1
+            if a.get("severity") == "critical":
+                worst = "critical"
+        out["alerts_total"] = len(alerts)
+        out["alerts_by_rule"] = dict(sorted(by_rule.items()))
+        out["alerts_worst_severity"] = worst
+        last = alerts[-1]
+        out["last_alert"] = {
+            k: last.get(k) for k in ("iter", "rule", "severity", "message")
+        }
     if preds:
         out["predict_runs"] = len(preds)
         out["predict_rows"] = sum(int(e.get("rows", 0)) for e in preds)
@@ -125,13 +143,84 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             int(e.get("chunks", 0)) for e in preds
         )
         out["predict_compiles"] = sum(int(e.get("compiles", 0)) for e in preds)
+        pvals: Dict[str, List[float]] = defaultdict(list)
+        for e in preds:
+            for k, v in (e.get("phases") or {}).items():
+                pvals[k].append(float(v))
+        if pvals:
+            out["predict_phases_ms_p50"] = {
+                k: round(_percentile(v, 50), 2)
+                for k, v in sorted(pvals.items())
+            }
+            out["predict_phases_ms_p99"] = {
+                k: round(_percentile(v, 99), 2)
+                for k, v in sorted(pvals.items())
+            }
     return out
+
+
+def print_flight(path: str) -> int:
+    """Pretty-print a flight recorder fault dump (flight_*.json)."""
+    with open(path) as fp:
+        try:
+            doc = json.load(fp)
+        except json.JSONDecodeError as e:
+            raise SystemExit(f"{path}: bad flight dump JSON: {e}")
+    print(f"== flight dump {path}")
+    print(f"  schema: {doc.get('schema')}")
+    print(f"  reason: {doc.get('reason')}")
+    print(
+        f"  dumped_at_unix: {doc.get('dumped_at_unix')}  "
+        f"pid: {doc.get('pid')}"
+    )
+    if doc.get("run_info"):
+        print(f"  run_info: {json.dumps(doc['run_info'])}")
+    if doc.get("last_checkpoint"):
+        print(f"  last_checkpoint: {doc['last_checkpoint']}")
+    events = doc.get("events") or []
+    by_kind: Dict[str, int] = defaultdict(int)
+    for e in events:
+        by_kind[str(e.get("event", "?"))] += 1
+    print(
+        f"  ring: {len(events)}/{doc.get('ring_capacity')} events "
+        f"{json.dumps(dict(sorted(by_kind.items())))}"
+    )
+    iters = [e for e in events if e.get("event") == "iteration"]
+    if iters:
+        lo, hi = iters[0].get("iter"), iters[-1].get("iter")
+        walls = [float(e.get("wall_ms", 0.0)) for e in iters]
+        print(
+            f"  iterations: {lo}..{hi}  wall_ms "
+            f"p50 {_percentile(walls, 50):.2f} "
+            f"p99 {_percentile(walls, 99):.2f}"
+        )
+    alerts = doc.get("alerts") or []
+    print(f"  alerts: {len(alerts)}")
+    for a in alerts[-10:]:
+        print(
+            f"    [{a.get('severity', '?')}] it{a.get('iter', '?')} "
+            f"{a.get('rule', '?')}: {a.get('message', '')}"
+        )
+    tail = events[-5:]
+    if tail:
+        print("  last events:")
+        for e in tail:
+            print(f"    {json.dumps(e)[:160]}")
+    return 0
 
 
 def main(argv: List[str]) -> int:
     if not argv:
         print(__doc__)
         return 2
+    if argv[0] == "--flight":
+        if len(argv) < 2:
+            print("--flight needs at least one flight_*.json", file=sys.stderr)
+            return 2
+        rc = 0
+        for path in argv[1:]:
+            rc = max(rc, print_flight(path))
+        return rc
     rc = 0
     for path in argv:
         events = load_events(path)
