@@ -467,7 +467,101 @@ def _leaf_batch_sweep(X, y, timed_iters: int):
     return out
 
 
+def mesh_layout_sweep() -> dict:
+    """Named-mesh layout sweep on the 8-virtual-CPU-device mesh.
+
+    For each layout spec (data (8,1), feature, hybrid (4,2) — all through
+    the single ``parallel/mesh.py`` grow path) train a fixed workload and
+    record iters/sec plus the analytic-vs-measured collective byte totals;
+    for the data layout additionally compare ``overlap_collectives`` on vs
+    off (double-buffered histogram psums).  Runs standalone via
+    ``python bench.py --mesh-sweep`` (the device-count flag must be set
+    before the backend initializes, so this is its own process).
+    """
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs.registry import get_session
+
+    # layout COMPARISON shape, not the headline: small enough that five
+    # cases (incl. 255-leaf compiles) fit a CPU-fallback bench budget
+    n_rows = int(os.environ.get("BENCH_MESH_ROWS", 64_000))
+    n_features = 28
+    timed_iters = int(os.environ.get("BENCH_MESH_ITERS", 5))
+    X, y = _make_data(n_rows, n_features)
+    ses = get_session()
+
+    cases = {
+        "serial": {},
+        # pin overlap off/on explicitly — "auto" engages at leaf_batch>1,
+        # which would make the pair measure the same program
+        "data": {"tree_learner": "data", "overlap_collectives": "off"},
+        "data_overlap": {"tree_learner": "data", "overlap_collectives": "on"},
+        "feature": {"tree_learner": "feature"},
+        "hybrid": {"tree_learner": "data", "mesh_layout": "hybrid"},
+    }
+    out = {}
+    for name, extra in cases.items():
+        ses.configure(enabled=False)
+        ses.reset()
+        params = dict(
+            _PARAMS,
+            num_leaves=int(os.environ.get("BENCH_MESH_LEAVES", 63)),
+            telemetry=True,
+            **extra,
+        )
+        ips, booster, stats = _train_bench(
+            X, y, timed_iters, params=params
+        )
+        rec = {
+            "iters_per_sec": round(ips, 4),
+            "recompiles_timed": stats["recompiles_timed"],
+        }
+        spec = getattr(booster, "_mesh_spec", None)
+        if spec is not None:
+            rec["mesh"] = {"data": spec.data, "feature": spec.feature}
+            tel = booster.telemetry()
+            iters = [
+                e for e in tel["events"] if e["event"] == "iteration"
+            ]
+            analytic = sum(
+                e["collective"]["psum_bytes"]
+                for e in iters if "collective" in e
+            )
+            measured = sum(
+                e["collective_measured"]["psum_bytes"]
+                for e in iters if "collective_measured" in e
+            )
+            rec["analytic_psum_bytes"] = int(analytic)
+            rec["measured_psum_bytes"] = int(measured)
+            if measured and analytic:
+                rec["measured_vs_analytic"] = round(measured / analytic, 4)
+            rec["overlap"] = bool(
+                booster._grower_params.overlap_collectives
+            )
+        ses.configure(enabled=False)
+        ses.reset()
+        out[name] = rec
+    return out
+
+
 def main() -> None:
+    if "--mesh-sweep" in sys.argv:
+        # standalone: 8 virtual CPU devices, CPU pinned before backend init
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        print(json.dumps({"mesh_layout_sweep": mesh_layout_sweep()}))
+        return
     platform_note = None
     on_accel = _probe_accelerator()
     if not on_accel:

@@ -501,31 +501,30 @@ class Booster:
         self._multiproc = False  # process-local rows (pre_partition multi-host)
         self._featpar = 0  # feature-parallel shard count (rows replicated)
         self._proc_row_offset = 0
+        self._mesh_spec = None
         if cfg.tree_learner in ("data", "feature", "voting"):
-            from jax.sharding import Mesh
+            import dataclasses as _dc
 
-            from ..parallel import DATA_AXIS, choose_devices
+            from ..parallel import choose_devices
+            from ..parallel.mesh import build_mesh, choose_spec
 
             devices = choose_devices()
-            if devices is not None and cfg.tree_learner == "feature":
-                # feature-parallel: rows replicated, features sliced
-                # (reference feature_parallel_tree_learner.cpp:37 — every
-                # machine holds the full data).  The mesh shrinks to the
-                # largest device count dividing the used-feature count.
-                f_used_cnt = train_set.num_planes
-                dn = 0
-                for d in range(min(len(devices), max(f_used_cnt, 1)), 0, -1):
-                    if f_used_cnt % d == 0:
-                        dn = d
-                        break
-                if dn > 1:
-                    self._featpar = dn
-                    devices = devices[:dn]
-                else:
-                    devices = None  # degenerate: serial
+            # named-mesh layout (parallel/mesh.py): the tree_learner maps to
+            # a default mesh shape and mesh_layout overrides it — data
+            # (rows sharded), feature (features sliced, rows replicated,
+            # reference feature_parallel_tree_learner.cpp:37) or hybrid
+            # (2-D).  Every shape runs the same jitted grow path.
+            layout = cfg.mesh_layout
+            if layout == "auto":
+                layout = "feature" if cfg.tree_learner == "feature" else "data"
+            spec = (
+                choose_spec(layout, len(devices), train_set.num_planes)
+                if devices is not None
+                else None
+            )
             if (
-                devices is not None
-                and not self._featpar  # rows replicated: no padding at all
+                spec is not None
+                and spec.data > 1
                 and self.objective is not None
                 and self.objective.need_query
                 # multi-process feeding keeps ALL devices: trimming by the
@@ -534,19 +533,24 @@ class Booster:
                 # check below enforces the no-padding invariant instead
                 and not (jax.process_count() > 1 and cfg.pre_partition)
             ):
-                dn = len(devices)
-                while dn > 1 and n % dn != 0:
-                    dn -= 1  # ranking rows can't be weight-0 padded
-                devices = devices[:dn] if dn > 1 else None
-            if devices is not None:
-                self._mesh = Mesh(np.array(devices), (DATA_AXIS,))
+                # ranking rows can't be weight-0 padded: shrink the DATA
+                # axis until rows divide it (the feature axis never pads)
+                dd = spec.data
+                while dd > 1 and n % dd != 0:
+                    dd -= 1
+                spec = _dc.replace(spec, data=dd)
+            if spec is not None and spec.size > 1:
+                self._mesh_spec = spec
+                self._mesh = build_mesh(spec, devices)
+                self._featpar = spec.feature if spec.feature > 1 else 0
                 nproc = jax.process_count()
                 if nproc > 1 and cfg.pre_partition and self._featpar:
                     raise ValueError(
-                        "tree_learner='feature' needs the full data on every "
-                        "process (feature_parallel_tree_learner.cpp:37) — it "
-                        "cannot combine with pre_partition row partitioning; "
-                        "use tree_learner='data' for multi-host training"
+                        "feature-sliced mesh layouts need the full data on "
+                        "every process (feature_parallel_tree_learner.cpp:37)"
+                        " — they cannot combine with pre_partition row "
+                        "partitioning; use the pure data layout for "
+                        "multi-host training"
                     )
                 if nproc > 1 and cfg.pre_partition:
                     # ---- process-local data feeding (reference: each machine
@@ -568,7 +572,8 @@ class Booster:
                         )
                     pidx = jax.process_index()
                     nloc_dev = len(
-                        [d for d in devices if d.process_index == pidx]
+                        [d for d in devices[: spec.size]
+                         if d.process_index == pidx]
                     )
                     counts = multihost_utils.process_allgather(
                         np.asarray([n], np.int64)
@@ -587,8 +592,13 @@ class Booster:
                     self._proc_row_offset = int(counts[:pidx].sum())
                     self._n_global = int(counts.sum())
                     self._n_dev_global = lpad * nproc
-                elif not self._featpar:
-                    self._pad_rows = (-n) % len(devices)
+                else:
+                    # pad to a multiple of the DATA-axis size — the feature
+                    # axis replicates rows, so padding by the total device
+                    # count would over-pad any 2-D (or pure-feature) mesh
+                    from ..parallel import pad_rows_for
+
+                    self._pad_rows = pad_rows_for(n, self._mesh)
         pad = self._pad_rows
         n_dev = n + pad  # LOCAL device rows (== global when single-process)
 
@@ -644,25 +654,12 @@ class Booster:
         else:
             self._has_init_score = False
 
-        # device data
-        if self._mesh is not None and self._featpar:
-            # feature-parallel: every shard holds all rows; the grower
-            # slices features by axis_index internally
-            from ..parallel import replicate
-
-            self._score = replicate(init, self._mesh)
-            self._bins = replicate(train_set.bins, self._mesh)
-            if self.objective is not None:
-                for holder, name, axis in self.objective.per_row_device_arrays():
-                    arr = getattr(holder, name, None)
-                    if arr is None:
-                        continue
-                    setattr(
-                        holder,
-                        name,
-                        replicate(np.asarray(arr, dtype=np.float32), self._mesh),
-                    )
-        elif self._mesh is not None:
+        # device data: ONE placement path for every mesh layout, driven by
+        # the logical-axis-rule table (parallel/mesh.py AXIS_RULES).  Rows
+        # shard over the 'data' axis and replicate over 'feature'; on a
+        # pure-feature (1, F) mesh the data axis has size 1, so the same
+        # specs degenerate to full replication (pad_rows is 0 there).
+        if self._mesh is not None:
             from ..parallel import pad_rows_np, shard_cols, shard_rows
 
             self._score = shard_cols(init, self._mesh, process_local=self._multiproc)
@@ -735,14 +732,12 @@ class Booster:
 
             base = np.ones(n_dev, np.float32)
             base[n:] = 0.0
-            if self._featpar:
-                from ..parallel import replicate
-
-                self._ones_mask = replicate(base, self._mesh)
-            else:
-                self._ones_mask = shard_rows(
-                    base, self._mesh, process_local=self._multiproc
-                )
+            # rows role: sharded over 'data', replicated over 'feature' —
+            # on a pure-feature mesh the data axis is 1, so this IS the
+            # old replicate placement
+            self._ones_mask = shard_rows(
+                base, self._mesh, process_local=self._multiproc
+            )
             self._setup_sharded_grower()
         else:
             self._ones_mask = jnp.ones((n,), jnp.float32)
@@ -923,12 +918,16 @@ class Booster:
         """(Re)build the shard_map'd grower for the current GrowerParams.
         shard_map needs concrete arrays for every operand: dummies stand in
         for the optional ones (statically gated off inside grow_tree)."""
-        from ..parallel import make_sharded_grow
+        from ..parallel.mesh import MeshSpec, make_mesh_grow
 
         f_used = self._bins.shape[1]
-        self._sharded_grow = make_sharded_grow(
-            self._mesh, self._grower_params,
-            feature_parallel=bool(self._featpar),
+        spec = getattr(self, "_mesh_spec", None)
+        if spec is None and self._mesh is not None:
+            # meshes restored outside the constructor path (tests building
+            # boosters by hand) default to the pure-data layout
+            spec = MeshSpec("data", data=self._mesh.size)
+        self._sharded_grow = make_mesh_grow(
+            self._mesh, self._grower_params, spec
         )
         self._mono_arg = (
             self._monotone
@@ -1347,6 +1346,16 @@ class Booster:
             # a runtime kernel failure latched the XLA fallback
             # (_degrade_fused); the latch survives checkpoint/restore
             grow_fused = False
+        # double-buffered histogram collectives: 'auto' engages whenever
+        # the frontier batch exists and a mesh is up (the grower further
+        # gates on an actual histogram psum axis — see use_overlap); kept
+        # False for serial/leaf_batch=1 configs so their trace keys are
+        # unchanged
+        overlap = (
+            cfg.overlap_collectives != "off"
+            and leaf_k > 1
+            and self._mesh is not None
+        )
         return GrowerParams(
             num_leaves=cfg.num_leaves,
             max_bin=self._max_bin_padded,
@@ -1391,6 +1400,7 @@ class Booster:
             use_bundle=self._has_bundle,
             leaf_batch=leaf_k,
             grow_fused=grow_fused,
+            overlap_collectives=overlap,
             monotone_penalty=cfg.monotone_penalty,
             use_feature_contri=self._feature_contri is not None,
             # measured collectives only make sense with a mesh; static so the
@@ -1789,16 +1799,24 @@ class Booster:
             "leaf_batch": int(self.config.leaf_batch),
             "finished": bool(finished),
         }
-        if self._mesh is not None and self.config.tree_learner == "data":
-            from ..parallel import psum_bytes_per_iteration
+        if (
+            self._mesh is not None
+            # voting's elected-slice psums are data-dependent (top-k per
+            # shard), so the analytic shape model covers every layout BUT it
+            and self.config.tree_learner != "voting"
+        ):
+            from ..parallel.mesh import MeshSpec, mesh_psum_bytes_per_iteration
 
+            spec = getattr(self, "_mesh_spec", None) or MeshSpec(
+                "data", data=int(self._mesh.devices.size)
+            )
             k = max(1, self.num_tree_per_iteration)
             per_tree = (
                 event["splits"] // max(1, len(new_recs))
                 if new_recs
                 else max(1, self.config.num_leaves - 1)
             )
-            coll = psum_bytes_per_iteration(
+            coll = mesh_psum_bytes_per_iteration(
                 per_tree,
                 int(self._bins.shape[1]),
                 # PADDED bin-axis size: the psum moves the [F, B, 3] padded
@@ -1806,7 +1824,7 @@ class Booster:
                 # the same B the trace actually uses
                 int(self._grower_params.max_bin),
                 leaf_batch=int(self.config.leaf_batch),
-                mesh_size=int(self._mesh.devices.size),
+                spec=spec,
             )
             coll = {k2: v * k for k2, v in coll.items()}
             event["collective"] = coll

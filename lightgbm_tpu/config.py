@@ -354,6 +354,22 @@ class Config:
     # relative gain gap below which the int8 winner counts as a near tie
     # and its histogram is redone with direct f32 accumulation
     hist_near_tie_tol: float = 1e-3
+    # TPU extension: named-mesh layout (parallel/mesh.py).  'auto' derives
+    # the layout from tree_learner ('data'/'voting' -> all devices on the
+    # data axis, 'feature' -> all on the feature axis); 'data'/'feature'
+    # force a 1-D layout; 'hybrid' factors the devices into a
+    # (data, feature) 2-D mesh — rows sharded AND features sliced, the
+    # layout a multi-chip pod wants.  All layouts run the SAME jitted
+    # grow path; this knob only changes the mesh shape.
+    mesh_layout: str = "auto"
+    # TPU extension: double-buffered histogram collectives — split the
+    # frontier-batched histogram psum into two half-stack psums issued
+    # between the half builds, so the all-reduce of buffer 0 overlaps the
+    # histogram build of buffer 1 (byte-identical; see ops/grower.py).
+    # 'auto' = on whenever there is a data-axis histogram psum and
+    # leaf_batch > 1 (the serial loop has nothing to overlap with);
+    # 'on' / 'off' force it.
+    overlap_collectives: str = "auto"
     early_stopping_round: int = 0
     early_stopping_min_delta: float = 0.0
     first_metric_only: bool = False
@@ -607,6 +623,15 @@ class Config:
             raise ValueError("grow_fused must be one of 'auto', 'on', 'off'")
         if self.hist_acc not in ("auto", "int8", "bf16"):
             raise ValueError("hist_acc must be one of 'auto', 'int8', 'bf16'")
+        if self.mesh_layout not in ("auto", "data", "feature", "hybrid"):
+            raise ValueError(
+                "mesh_layout must be one of 'auto', 'data', 'feature', "
+                "'hybrid'"
+            )
+        if self.overlap_collectives not in ("auto", "on", "off"):
+            raise ValueError(
+                "overlap_collectives must be one of 'auto', 'on', 'off'"
+            )
         if self.hist_near_tie_tol < 0.0:
             raise ValueError("hist_near_tie_tol must be >= 0")
         if not (0.0 <= self.leaf_batch_min_commit_rate <= 1.0):

@@ -69,11 +69,51 @@ def _axis_key_str(key) -> str:
         return "params.axis_name"
     if key and key[0] == "literal":
         return f'literal "{key[1]}"'
+    if key and key[0] == "mesh":
+        return f"mesh axes ({key[1]})"
     if key == ("none",):
         return "None"
     if key == ("host",):
         return "host"
     return "?"
+
+
+def _mesh_axis_names(project: Project) -> frozenset:
+    """Axis-name literals declared by a module-level ``MESH_AXIS_NAMES``
+    tuple (parallel/mesh.py) — the named-mesh table.
+
+    GL008(a) treats literals drawn from this table as ONE consistent
+    source per jitted region: a 2-D ``('data', 'feature')`` grow path
+    legitimately psums histograms over one mesh axis while electing the
+    winner over the other, and both spellings come from the same table.
+    Literals NOT in the table (a typo'd axis, an ad-hoc string) still
+    count as separate sources and keep firing."""
+    names: Set[str] = set()
+    for mod in project.modules.values():
+        for node in mod.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "MESH_AXIS_NAMES"
+                and isinstance(node.value, (ast.Tuple, ast.List))
+            ):
+                for e in node.value.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                        names.add(e.value)
+    return frozenset(names)
+
+
+def _collapse_mesh_literals(keys: Set, mesh_names: frozenset) -> Set:
+    """Merge literal axis keys that all come from the mesh-axis table into
+    one ``('mesh', ...)`` pseudo-key; every other key passes through."""
+    mesh_lits = {
+        k for k in keys if k[0] == "literal" and k[1] in mesh_names
+    }
+    if len(mesh_lits) < 2:
+        return keys
+    merged = ("mesh", ", ".join(sorted(k[1] for k in mesh_lits)))
+    return (keys - mesh_lits) | {merged}
 
 
 # ------------------------------------------------------------------ GL007
@@ -188,7 +228,10 @@ def _check_gl007(project: Project) -> List[Finding]:
 def _check_gl008(project: Project) -> List[Finding]:
     idx = spmd_index(project)
     findings: List[Finding] = []
-    # (a) mixed axis-name sources inside one jitted region
+    mesh_names = _mesh_axis_names(project)
+    # (a) mixed axis-name sources inside one jitted region.  Literals from
+    # the MESH_AXIS_NAMES table collapse to one source first: the named-mesh
+    # grow path runs per-axis collectives over both 'data' and 'feature'.
     seen_entries: Set[int] = set()
     for rel, mod, fn, _statics in jit_entries(project):
         if id(fn) in seen_entries:
@@ -199,6 +242,7 @@ def _check_gl008(project: Project) -> List[Finding]:
             continue
         summary = idx.scope_summary(scope, depth=8)
         keys = {k for (_kind, k) in summary if k[0] in ("literal", "param")}
+        keys = _collapse_mesh_literals(keys, mesh_names)
         if len(keys) <= 1:
             continue
         findings.append(

@@ -22,6 +22,13 @@ telemetry (``collective_measured/*``).
 ``measure`` is a TRACE-TIME flag: it rides in ``GrowerParams`` (a static jit
 argument), so toggling it retraces instead of silently reusing a stale
 trace.  With ``measure=False`` the wrappers compile to the bare collective.
+
+Double-buffered sites: the grower's overlap path (``overlap_collectives``)
+splits the frontier histogram psum into ``hist_db0`` / ``hist_db1`` —
+member-half k's reduction issued while member-half k+1's histograms build.
+Both buffers are measured like any other site; ``measured_summary`` sums
+every ``psum/*`` key, so the per-iteration byte total is invariant under
+overlap on/off (the same payload, in two launches).
 """
 
 from __future__ import annotations

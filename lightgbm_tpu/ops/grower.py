@@ -68,6 +68,12 @@ class GrowerParams:
     # formulation keeps full columns addressable).  Value = number of
     # feature shards; 0 = off.
     feature_shard: int = 0
+    # named-mesh second axis (parallel/mesh.py): when set, feature shards
+    # elect/broadcast over THIS axis while histogram/count psums keep
+    # running over axis_name — the hybrid ('data','feature') 2D layout.
+    # None preserves the one-axis world: feature_shard > 1 reuses
+    # axis_name for the election (rows replicated, no histogram psum).
+    feature_axis_name: Optional[str] = None
     # categorical split search (sorted-subset scan, feature_histogram.cpp:147);
     # False keeps every cat-related array at width 1 (static no-op)
     use_cat: bool = False
@@ -163,6 +169,16 @@ class GrowerParams:
     # structure decision (int8 grid step ~6e-5 relative; 1e-3 covers the
     # worst-case gain-domain amplification under gradient cancellation)
     near_tie_tol: float = 1e-3
+    # double-buffered histogram collectives: under leaf_batch > 1 with a
+    # histogram psum axis, split the [K, F, B, 3] frontier stack into two
+    # half-window psums (sites "hist_db0"/"hist_db1") issued BETWEEN the
+    # half-builds, so XLA's async all-reduce of buffer 0 overlaps the
+    # histogram build of buffer 1.  Byte-identical to the single psum
+    # (psum is elementwise per member; member order is preserved) and the
+    # measured byte total is unchanged (obs.collectives sums every
+    # psum/* site).  Structurally off at leaf_batch=1 — the serial loop
+    # has nothing to overlap with.  gbdt resolves 'auto'/'on'/'off'.
+    overlap_collectives: bool = False
 
 
 def _hist_caps(n: int, full_range: bool = False) -> list:
@@ -289,6 +305,11 @@ def int8_acc_eligible(
     if quantized or monotone:
         return False
     if p.hist_acc == "bf16" or p.axis_name is not None:
+        return False
+    if p.feature_shard > 1:
+        # pure-feature mesh layout: axis_name is None but shards hold
+        # feature slices, and the near-tie with_margin re-scan is not
+        # plumbed through the feature-parallel election
         return False
     return jax.default_backend() == "tpu" or _seg_mod._INTERPRET
 
@@ -797,11 +818,23 @@ def grow_tree(
     # voting-parallel: histograms stay LOCAL; only elected slices are
     # psummed inside _candidate_for_leaf (scalar stats still psum globally)
     use_voting = voting_active(p, f)
-    # feature-parallel: rows replicated, features sliced per shard — no
-    # histogram psum at all; the only collective is the winner all-reduce
-    # (plus the root-totals broadcast below)
-    use_featpar = (
-        p.feature_shard > 1 and p.axis_name is not None and f > 0
+    # feature-parallel: features sliced per shard over feat_axis; the only
+    # feature-axis collective is the winner all-reduce (plus the
+    # root-totals broadcast below).  One-axis world (feature_axis_name
+    # None): feat_axis aliases axis_name, rows replicated, no histogram
+    # psum.  Two-axis world (named mesh): election runs over the
+    # 'feature' axis while rows stay sharded over axis_name, so histogram
+    # and count psums keep running over the data axis (hybrid layout).
+    feat_axis = (
+        p.feature_axis_name
+        if p.feature_axis_name is not None
+        else (p.axis_name if p.feature_shard > 1 else None)
+    )
+    use_featpar = p.feature_shard > 1 and feat_axis is not None and f > 0
+    # are rows partitioned across axis_name?  False when feature-parallel
+    # reuses the one data axis for the election (rows replicated there)
+    rows_sharded = p.axis_name is not None and (
+        not use_featpar or feat_axis != p.axis_name
     )
     if use_featpar:
         if p.hist_mode not in ("gather", "full", "seg"):
@@ -820,7 +853,7 @@ def grow_tree(
                 "training (histogram rows live on the owning shard)"
             )
         f_loc = f // p.feature_shard
-        sh_lo = lax.axis_index(p.axis_name) * f_loc
+        sh_lo = lax.axis_index(feat_axis) * f_loc
 
         def _fslice(arr, axis=0):
             return lax.dynamic_slice_in_dim(arr, sh_lo, f_loc, axis=axis)
@@ -830,20 +863,20 @@ def grow_tree(
             (reference SyncUpGlobalBestSplit, feature_parallel_tree_learner
             .cpp:74 — here a pmax + owner-selected psum broadcast)."""
             gmax = timed_pmax(
-                cand.gain, p.axis_name, site="elect",
+                cand.gain, feat_axis, site="elect",
                 measure=p.measure_collectives,
             )
-            idx = lax.axis_index(p.axis_name)
+            idx = lax.axis_index(feat_axis)
             owner = timed_pmin(
                 jnp.where(cand.gain >= gmax, idx, p.feature_shard),
-                p.axis_name, site="elect", measure=p.measure_collectives,
+                feat_axis, site="elect", measure=p.measure_collectives,
             )
             mine = (idx == owner) & jnp.isfinite(gmax)
 
             def bc(x):
                 xf = jnp.where(mine, x, jnp.zeros_like(x))
                 return timed_psum(
-                    xf, p.axis_name, site="elect",
+                    xf, feat_axis, site="elect",
                     measure=p.measure_collectives,
                 )
 
@@ -867,7 +900,7 @@ def grow_tree(
         def _fslice(arr, axis=0):
             return arr
 
-    hist_axis = None if (use_voting or use_featpar) else p.axis_name
+    hist_axis = p.axis_name if (rows_sharded and not use_voting) else None
     # per-shard feature slice of the bin matrix (identity when not
     # feature-parallel) — used by the full-mode and root histograms
     bins_loc = _fslice(bins, axis=1) if f > 0 else bins
@@ -892,6 +925,12 @@ def grow_tree(
                 raise ValueError(
                     f"leaf_batch > 1 does not support {what}; set leaf_batch=1"
                 )
+    # double-buffered histogram collectives (see GrowerParams doc): only
+    # meaningful when there IS a frontier stack to split and a histogram
+    # psum axis to overlap against
+    use_overlap = (
+        p.overlap_collectives and leaf_k > 1 and hist_axis is not None
+    )
 
     def cand_for_leaf(hist, g, h, c, fm, lb=None, ub=None, pout=0.0,
                       rand=None, cpen=None, adv=None, depth=None,
@@ -1064,7 +1103,7 @@ def grow_tree(
         caps = sorted(
             _hist_caps(
                 n,
-                full_range=(p.axis_name is not None and p.feature_shard <= 1),
+                full_range=rows_sharded,
             )
         )  # ascending child-histogram capacities
         caps_arr = jnp.asarray(caps, dtype=jnp.int32)
@@ -1252,9 +1291,9 @@ def grow_tree(
         # the values agree only up to summation order, and downstream gains
         # must be bit-identical across shards (out_specs declare the tree
         # replicated) — broadcast shard 0's totals
-        idx0 = lax.axis_index(p.axis_name) == 0
+        idx0 = lax.axis_index(feat_axis) == 0
         totals = timed_psum(
-            jnp.where(idx0, totals, jnp.zeros_like(totals)), p.axis_name,
+            jnp.where(idx0, totals, jnp.zeros_like(totals)), feat_axis,
             site="counts", measure=p.measure_collectives,
         )
     root_used = jnp.zeros((f,), bool)
@@ -1558,10 +1597,10 @@ def grow_tree(
                     colv, tbin, dl.astype(jnp.int32), nan_bins[feat],
                     cis.astype(jnp.int32), cmask.astype(jnp.float32),
                 )
-                mine = lax.axis_index(p.axis_name) == owner
+                mine = lax.axis_index(feat_axis) == owner
                 gl_vec = timed_psum(
                     jnp.where(mine, glv.astype(jnp.float32), 0.0),
-                    p.axis_name, site="partition",
+                    feat_axis, site="partition",
                     measure=p.measure_collectives,
                 )
             with jax.named_scope("partition"):
@@ -1665,7 +1704,7 @@ def grow_tree(
             rows_l = jnp.sum(in_leaf & go_left).astype(jnp.int32)
             rows_in = jnp.sum(in_leaf).astype(jnp.int32)
             rows_r = rows_in - rows_l
-            if p.axis_name is not None and not use_featpar:
+            if rows_sharded:
                 # the smaller-child choice must be GLOBAL: if shards chose
                 # locally, some would histogram the left child and others
                 # the right, and the psum would mix the two (the reference
@@ -2290,12 +2329,14 @@ def grow_tree(
                 left_smaller_k = nleft_k <= nright_k
             child_start_k = begin_k + jnp.where(left_smaller_k, 0, nleft_k)
             child_cnt_k = jnp.where(left_smaller_k, nleft_k, nright_k)
-            with jax.named_scope("histogram"):
-                sm_k = seg_hist_batch(
+            wins_k = jnp.stack([child_start_k, child_cnt_k], axis=1).astype(
+                jnp.int32
+            )
+
+            def _seg_hist_win(w):
+                return seg_hist_batch(
                     order,
-                    jnp.stack([child_start_k, child_cnt_k], axis=1).astype(
-                        jnp.int32
-                    ),
+                    w,
                     f=f_seg,
                     num_bins=B,
                     n_pad=n_pad_seg,
@@ -2303,11 +2344,32 @@ def grow_tree(
                     wide=seg_wide,
                     live=seg_live,
                 )
-            if hist_axis is not None:
-                sm_k = timed_psum(
-                    sm_k, hist_axis, site="hist",
+
+            if use_overlap:
+                # double-buffered: build buffer 0, issue its psum, build
+                # buffer 1 while the buffer-0 all-reduce is in flight
+                kh = K // 2
+                with jax.named_scope("histogram_db0"):
+                    sm_a = _seg_hist_win(wins_k[:kh])
+                sm_a = timed_psum(
+                    sm_a, hist_axis, site="hist_db0",
                     measure=p.measure_collectives,
                 )
+                with jax.named_scope("histogram_db1"):
+                    sm_b = _seg_hist_win(wins_k[kh:])
+                sm_b = timed_psum(
+                    sm_b, hist_axis, site="hist_db1",
+                    measure=p.measure_collectives,
+                )
+                sm_k = jnp.concatenate([sm_a, sm_b], axis=0)
+            else:
+                with jax.named_scope("histogram"):
+                    sm_k = _seg_hist_win(wins_k)
+                if hist_axis is not None:
+                    sm_k = timed_psum(
+                        sm_k, hist_axis, site="hist",
+                        measure=p.measure_collectives,
+                    )
         elif use_ordered:
             begin_k = st.leaf_begin[l_k]
             cnt_k = jnp.where(active_k, st.leaf_nrows[l_k], 0)
@@ -2346,6 +2408,7 @@ def grow_tree(
             child_cnt_k = jnp.where(left_smaller_k, nleft_k, nright_k)
             with jax.named_scope("histogram"):
                 sm_list = []
+                done_halves = []
                 for i in range(K):
                     cbucket_i = jnp.clip(
                         jnp.searchsorted(caps_arr, tc_k[i], side="left"),
@@ -2359,12 +2422,27 @@ def grow_tree(
                             (order, child_start_k[i], child_cnt_k[i]),
                         )
                     )
-                sm_k = jnp.stack(sm_list)
-            if hist_axis is not None:
-                sm_k = timed_psum(
-                    sm_k, hist_axis, site="hist",
+                    if use_overlap and i == K // 2 - 1:
+                        # double-buffered: buffer 0's psum flies while the
+                        # remaining members' histograms build
+                        done_halves.append(timed_psum(
+                            jnp.stack(sm_list), hist_axis, site="hist_db0",
+                            measure=p.measure_collectives,
+                        ))
+                        sm_list = []
+            if use_overlap:
+                done_halves.append(timed_psum(
+                    jnp.stack(sm_list), hist_axis, site="hist_db1",
                     measure=p.measure_collectives,
-                )
+                ))
+                sm_k = jnp.concatenate(done_halves, axis=0)
+            else:
+                sm_k = jnp.stack(sm_list)
+                if hist_axis is not None:
+                    sm_k = timed_psum(
+                        sm_k, hist_axis, site="hist",
+                        measure=p.measure_collectives,
+                    )
         else:
             # gather / full: row membership per member, leaf_id writes
             # deferred to the commit decision below
@@ -2414,6 +2492,7 @@ def grow_tree(
                 )
                 with jax.named_scope("histogram"):
                     sm_list = []
+                    done_halves = []
                     for i in range(K):
                         bucket_i = jnp.clip(
                             jnp.searchsorted(caps_arr, tc_k[i], side="left"),
@@ -2423,23 +2502,57 @@ def grow_tree(
                         sm_list.append(
                             lax.switch(bucket_i, hist_branches_loc, member_k[i])
                         )
-                    sm_k = jnp.stack(sm_list)
+                        if use_overlap and i == K // 2 - 1:
+                            done_halves.append(timed_psum(
+                                jnp.stack(sm_list), hist_axis,
+                                site="hist_db0",
+                                measure=p.measure_collectives,
+                            ))
+                            sm_list = []
+                    if use_overlap:
+                        done_halves.append(timed_psum(
+                            jnp.stack(sm_list), hist_axis, site="hist_db1",
+                            measure=p.measure_collectives,
+                        ))
+                        sm_k = jnp.concatenate(done_halves, axis=0)
+                    else:
+                        sm_k = jnp.stack(sm_list)
             else:
                 left_smaller_k = c_lc_k <= c_rc_k
                 member_k = in_leaf_k & jnp.where(
                     left_smaller_k[:, None], go_left_k, ~go_left_k
                 )
-                with jax.named_scope("histogram"):
-                    mask_k = count_mask[None, :] * member_k
-                    sm_k = jax.vmap(
+
+                def _full_hist(mask_win):
+                    return jax.vmap(
                         lambda m: leaf_histogram(
                             bins_loc, grad, hess, m, B,
                             method=p.hist_method,
                             axis_name=None,
                             quant_scales=quant_scales,
                         )
-                    )(mask_k)
-            if hist_axis is not None:
+                    )(mask_win)
+
+                mask_k = count_mask[None, :] * member_k
+                if use_overlap:
+                    kh = K // 2
+                    with jax.named_scope("histogram_db0"):
+                        sm_a = _full_hist(mask_k[:kh])
+                    sm_a = timed_psum(
+                        sm_a, hist_axis, site="hist_db0",
+                        measure=p.measure_collectives,
+                    )
+                    with jax.named_scope("histogram_db1"):
+                        sm_b = _full_hist(mask_k[kh:])
+                    sm_b = timed_psum(
+                        sm_b, hist_axis, site="hist_db1",
+                        measure=p.measure_collectives,
+                    )
+                    sm_k = jnp.concatenate([sm_a, sm_b], axis=0)
+                else:
+                    with jax.named_scope("histogram"):
+                        sm_k = _full_hist(mask_k)
+            if hist_axis is not None and not use_overlap:
                 sm_k = timed_psum(
                     sm_k, hist_axis, site="hist",
                     measure=p.measure_collectives,

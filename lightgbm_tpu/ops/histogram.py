@@ -100,6 +100,7 @@ def leaf_histogram(
     axis_name: Optional[str] = None,
     quant_scales=None,  # (g_scale, h_scale) for the pallas_int8 methods
     measure: bool = False,  # timed-psum instrumentation (obs/collectives)
+    psum_site: str = "hist",  # measured-site label (hist | hist_db0 | hist_db1)
 ) -> jnp.ndarray:
     """Dispatch histogram impl; psum across the data mesh axis if given.
 
@@ -107,7 +108,10 @@ def leaf_histogram(
     ReduceScatter (src/treelearner/data_parallel_tree_learner.cpp:286, XLA
     collective over ICI instead of hand-rolled TCP recursive-halving).
     ``measure`` (static, from ``GrowerParams.measure_collectives``) swaps
-    the bare psum for the timed/byte-counted wrapper.
+    the bare psum for the timed/byte-counted wrapper.  ``psum_site``
+    lets double-buffered callers label which buffer this reduction feeds
+    (the grower's overlap path psums half the frontier under
+    ``hist_db0`` while building the other half, then ``hist_db1``).
     """
     if method == "auto":
         # Dispatch on the LOWERING platform, not the process-global default
@@ -134,7 +138,7 @@ def leaf_histogram(
                 default=functools.partial(leaf_histogram_segment, num_bins=num_bins),
             )
         if axis_name is not None:
-            hist = timed_psum(hist, axis_name, site="hist", measure=measure)
+            hist = timed_psum(hist, axis_name, site=psum_site, measure=measure)
         return hist
     if method == "pallas":
         from .pallas.histogram import histogram_pallas
@@ -166,5 +170,5 @@ def leaf_histogram(
     else:
         raise ValueError(f"unknown histogram method {method!r}")
     if axis_name is not None:
-        hist = timed_psum(hist, axis_name, site="hist", measure=measure)
+        hist = timed_psum(hist, axis_name, site=psum_site, measure=measure)
     return hist

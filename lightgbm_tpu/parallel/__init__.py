@@ -134,8 +134,27 @@ def choose_devices(min_devices: int = 2):
     return None
 
 
+def data_axis_size(mesh: Optional[Mesh]) -> int:
+    """Size of the row-sharding ('data') axis of ``mesh``.
+
+    Row padding and per-shard row math must divide THIS, not the total
+    device count: on a 2-D ``(data, feature)`` mesh rows are replicated
+    over the feature axis, so a hybrid (4, 2) mesh needs rows % 4 == 0,
+    not rows % 8.  A mesh without a 'data' axis (or no mesh) shards
+    nothing, hence size 1."""
+    if mesh is None:
+        return 1
+    return int(dict(mesh.shape).get(DATA_AXIS, 1))
+
+
+def pad_rows_for(n_rows: int, mesh: Optional[Mesh]) -> int:
+    """Rows of padding so ``n_rows`` divides the mesh's DATA axis."""
+    return (-int(n_rows)) % data_axis_size(mesh)
+
+
 def pad_rows_np(arr: np.ndarray, pad: int, fill=0):
-    """Pad axis 0 of a host array with ``fill`` so rows divide the mesh."""
+    """Pad axis 0 of a host array with ``fill`` so rows divide the mesh's
+    data axis (compute ``pad`` with ``pad_rows_for``)."""
     if pad == 0:
         return arr
     widths = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)

@@ -529,6 +529,50 @@ def test_gl008_silent_on_single_source_through_helpers(tmp_path):
     assert by_rule(run_lint(root), "GL008") == []
 
 
+def test_gl008_mesh_axis_table_literals_are_one_source(tmp_path):
+    """The two-axis world: literals drawn from the project's
+    MESH_AXIS_NAMES table (parallel/mesh.py) are ONE consistent source —
+    a 2-D grow path psums histograms over 'data' and elects the winner
+    over 'feature' inside the same jitted region."""
+    root = make_project(tmp_path, {
+        "parallel/mesh.py": """\
+            MESH_AXIS_NAMES = ("data", "feature")
+            """,
+        "app.py": """\
+            @instrumented_jit
+            def entry(x):
+                x = timed_psum(x, "data", site="hist")
+                return timed_psum(x, "feature", site="elect")
+            """,
+    })
+    assert by_rule(run_lint(root), "GL008") == []
+
+
+def test_gl008_mesh_table_does_not_launder_foreign_sources(tmp_path):
+    """The collapse merges ONLY table literals: a typo'd axis next to a
+    table literal, or a table literal mixed with the params plumbing,
+    are still two sources."""
+    root = make_project(tmp_path, {
+        "parallel/mesh.py": """\
+            MESH_AXIS_NAMES = ("data", "feature")
+            """,
+        "app.py": """\
+            @instrumented_jit
+            def typo(x):
+                x = timed_psum(x, "data", site="hist")
+                return timed_psum(x, "mdata", site="elect")
+
+            @instrumented_jit
+            def mixed(x, axis_name):
+                x = timed_psum(x, axis_name, site="hist")
+                return timed_psum(x, "feature", site="elect")
+            """,
+    })
+    assert idents(run_lint(root), "GL008") == {
+        "typo:axis-sources", "mixed:axis-sources",
+    }
+
+
 # ===================================================================== GL009
 def test_gl009_flags_nonstatic_scalar_params(tmp_path):
     """Scalar-annotated params outside static_argnames retrace per value;

@@ -1,0 +1,200 @@
+"""Named-mesh layout battery (parallel/mesh.py, ISSUE 13).
+
+Every distributed layout is a MESH SHAPE consumed by the single
+``make_mesh_grow`` path — so the contract is uniform and testable on the
+8-virtual-device CPU mesh:
+
+* structure parity: data / feature / hybrid specs all reproduce the
+  serial tree structure full-dump (the reference's distributed tests
+  assert the same across N localhost workers);
+* pad math: row padding divides the DATA axis, not the total device
+  count (a hybrid (4, 2) mesh pads rows % 4 — the satellite-1 fix);
+* overlap: ``overlap_collectives`` splits the frontier histogram psum
+  into hist_db0/hist_db1 without changing a byte of the model or a byte
+  of the measured collective totals;
+* retrace: each layout's grow path traces once and stays warm;
+* resume: checkpoints restore onto the same mesh layout byte-identically.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs.registry import get_session
+
+# layout name -> the params that select it (everything else identical)
+LAYOUTS = {
+    "data": {"tree_learner": "data"},
+    "feature": {"tree_learner": "feature"},
+    "hybrid": {"tree_learner": "data", "mesh_layout": "hybrid"},
+}
+
+STRUCT_KEYS = (
+    "num_leaves", "split_feature", "threshold", "left_child", "right_child",
+)
+
+
+def _structure(bst):
+    """Tree-structure lines of the full dump (config echo excluded)."""
+    return "\n".join(
+        l for l in bst.model_to_string().splitlines()
+        if l.split("=")[0] in STRUCT_KEYS
+    )
+
+
+def _data(n=512, f=10, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (
+        X[:, 0] + 0.5 * X[:, 1] ** 2 + rng.normal(scale=0.1, size=n) > 0.4
+    ).astype(np.float64)
+    return X, y
+
+
+def _params(**over):
+    p = dict(
+        objective="binary",
+        num_leaves=15,
+        learning_rate=0.1,
+        min_data_in_leaf=5,
+        verbosity=-1,
+        max_bin=63,
+        seed=3,
+    )
+    p.update(over)
+    return p
+
+
+def _train(X, y, extra, rounds=5):
+    p = _params(**extra)
+    return lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=rounds)
+
+
+# ------------------------------------------------------- structure parity
+@pytest.mark.parametrize("layout", sorted(LAYOUTS))
+def test_layout_structure_parity_vs_serial(layout, cpu_mesh_devices):
+    """All three layouts flow through make_mesh_grow and reproduce the
+    serial structure, selected ONLY by the spec."""
+    X, y = _data()
+    serial = _train(X, y, {})
+    dist = _train(X, y, LAYOUTS[layout])
+    spec = dist._mesh_spec
+    assert spec is not None, f"{layout} layout did not form a mesh"
+    # the spec IS the layout: a shape, not a code path
+    want_shape = {
+        "data": (8, 1),      # all devices on the data axis
+        "feature": (1, 5),   # largest divisor of the 10 planes <= 8
+        "hybrid": (4, 2),    # 8 devices, fd=2 divides devices and planes
+    }[layout]
+    assert (spec.data, spec.feature) == want_shape
+    assert dict(dist._mesh.shape) == {"data": spec.data,
+                                      "feature": spec.feature}
+    assert _structure(dist) == _structure(serial)
+    np.testing.assert_allclose(
+        dist.predict(X), serial.predict(X), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_hybrid_pad_rows_from_data_axis(cpu_mesh_devices):
+    """Satellite-1 regression: on a (4, 2) hybrid mesh, 994 rows need
+    (-994) % 4 == 2 padding rows — deriving the pad from the total device
+    count would write 6 and break per-shard row math."""
+    X, y = _data(n=994)
+    serial = _train(X, y, {})
+    dist = _train(X, y, LAYOUTS["hybrid"])
+    assert (dist._mesh_spec.data, dist._mesh_spec.feature) == (4, 2)
+    assert dist._pad_rows == 2
+    assert _structure(dist) == _structure(serial)
+
+
+# ----------------------------------------------------- collective overlap
+def test_overlap_on_off_byte_parity(cpu_mesh_devices):
+    """Double-buffered histogram collectives re-order LAUNCHES, not math:
+    the model dump (config echo aside) is byte-identical and the measured
+    psum byte totals agree exactly — hist_db0 + hist_db1 carry the same
+    payload the single hist psum did."""
+    ses = get_session()
+    X, y = _data(n=640, f=12, seed=1)
+
+    def run(overlap):
+        ses.configure(enabled=False)
+        ses.reset()
+        bst = _train(
+            X, y,
+            dict(LAYOUTS["data"], leaf_batch=4, telemetry=True,
+                 overlap_collectives=overlap),
+            rounds=4,
+        )
+        tel = bst.telemetry()
+        meas = sum(
+            e["collective_measured"]["psum_bytes"]
+            for e in tel["events"] if e["event"] == "iteration"
+        )
+        ses.configure(enabled=False)
+        ses.reset()
+        return bst, meas
+
+    try:
+        off, meas_off = run("off")
+        on, meas_on = run("on")
+    finally:
+        ses.configure(enabled=False)
+        ses.reset()
+    assert off._grower_params.overlap_collectives is False
+    assert on._grower_params.overlap_collectives is True
+    strip = lambda b: "\n".join(
+        l for l in b.model_to_string().splitlines()
+        if "overlap_collectives" not in l
+    )
+    assert strip(on) == strip(off)
+    assert meas_on == meas_off > 0
+
+
+def test_overlap_auto_stays_off_at_leaf_batch_one(cpu_mesh_devices):
+    """auto gating: leaf_batch=1's serial loop has nothing to overlap
+    with, so the trace keeps its pre-overlap key (no retrace risk)."""
+    X, y = _data()
+    bst = _train(X, y, LAYOUTS["data"])
+    assert bst._grower_params.overlap_collectives is False
+
+
+# ----------------------------------------------------------- retrace guard
+@pytest.mark.parametrize("layout", sorted(LAYOUTS))
+def test_zero_retrace_after_warmup(layout, cpu_mesh_devices):
+    """Each layout's grow path compiles during warmup and never again for
+    identical shapes — the perf contract's retrace invariant, per spec.
+    (The label counts per-booster: every booster builds its own shard_map
+    closure, so the warm check continues the SAME booster.)"""
+    X, y = _data()
+    bst = _train(X, y, LAYOUTS[layout], rounds=3)  # warmup
+    warm = dict(lgb.compile_counts_by_label())
+    for _ in range(3):
+        bst.update()
+    assert dict(lgb.compile_counts_by_label()) == warm, (
+        f"{layout} layout retraced after warmup"
+    )
+
+
+# ------------------------------------------------------------ kill/resume
+def test_checkpoint_resume_under_mesh_layout(tmp_path, cpu_mesh_devices):
+    """Kill-and-resume under a mesh spec: the resumed run re-forms the
+    same layout from config and continues byte-identically."""
+    X, y = _data()
+    ckdir = str(tmp_path / "ck")
+    p = _params(
+        checkpoint_dir=ckdir, checkpoint_interval=4, deterministic=True,
+        **LAYOUTS["data"],
+    )
+
+    baseline = lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=10)
+    ref = baseline.model_to_string()
+
+    lgb.train(p, lgb.Dataset(X, y, params=p), num_boost_round=8)  # "killed"
+    resumed = lgb.train(
+        p, lgb.Dataset(X, y, params=p), num_boost_round=10,
+        resume_from=ckdir,
+    )
+    assert resumed._mesh_spec == baseline._mesh_spec
+    assert (resumed._mesh_spec.data, resumed._mesh_spec.feature) == (8, 1)
+    assert resumed.current_iteration() == 10
+    assert resumed.model_to_string() == ref

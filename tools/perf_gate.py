@@ -201,6 +201,52 @@ def collect() -> Dict[str, float]:
             delta = count - labels_before.get(label, 0)
             if delta:
                 metrics[f"retrace/quant_data_parallel/{label}"] = float(delta)
+
+        # -- scenario 4: hybrid (data×feature) 2-D mesh layout — the
+        # named-mesh scale-out path (parallel/mesh.py).  10 features on 8
+        # devices factorizes to a (4, 2) mesh: histogram/count psums over
+        # 'data' on half-width feature slices, winner election over
+        # 'feature'.  Pins the 2-D layout's retrace count and its
+        # analytic-vs-measured collective bytes into the contract.
+        ses.reset()
+        labels_before = compile_counts_by_label()
+        t0 = time.perf_counter()
+        hyb = lgb.train(
+            {**base, "tree_learner": "data", "mesh_layout": "hybrid"},
+            lgb.Dataset(X, label=y, params=base),
+            num_boost_round=3,
+        )
+        metrics["wall/hybrid_train_s"] = round(time.perf_counter() - t0, 3)
+        spec = getattr(hyb, "_mesh_spec", None)
+        assert spec is not None and spec.feature > 1, (
+            "hybrid scenario did not form a 2-D mesh"
+        )
+        labels_after = compile_counts_by_label()
+        for label, count in sorted(labels_after.items()):
+            delta = count - labels_before.get(label, 0)
+            if delta:
+                metrics[f"retrace/hybrid/{label}"] = float(delta)
+        iters = [
+            e for e in ses.events if e.get("event") == "iteration"
+        ]
+        analytic = sum(
+            float(e["collective"]["psum_bytes"])
+            for e in iters
+            if "collective" in e
+        )
+        measured = sum(
+            float(e["collective_measured"]["psum_bytes"])
+            for e in iters
+            if "collective_measured" in e
+        )
+        # named to ride the existing policy prefixes: analytic exact,
+        # measured with the scalar-psum slack
+        if analytic:
+            metrics["collective/analytic_hybrid_bytes"] = analytic
+        if measured:
+            metrics["collective/measured_hybrid_psum_bytes"] = round(
+                measured, 1
+            )
     else:  # pragma: no cover - CI always has the virtual mesh
         print(
             f"perf_gate: only {ndev} cpu devices; skipping the "
