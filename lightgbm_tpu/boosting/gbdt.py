@@ -17,8 +17,10 @@ Per-iteration device work (all jitted, scores stay in HBM):
 
 from __future__ import annotations
 
+import functools
 import math
 import time
+import warnings
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -36,16 +38,18 @@ from ..obs.jit import compile_count as _obs_compile_count
 from ..obs.registry import get_session
 from ..objectives import ObjectiveFunction, create_objective
 from ..resilience import NumericsError, chaos
+from ..obs.jit import instrumented_jit
 from ..ops.grower import (
     GrowerParams,
     fetch_tree_arrays,
     grow_tree,
-    pack_tree_arrays,
+    pack_tree_arrays_donated,
     unpack_tree_arrays,
 )
 from ..predict import (
     BinTreeBatch,
     StreamingPredictor,
+    _add_tree_to_score_impl,
     add_tree_to_score,
     stack_bin_trees,
     stack_real_trees,
@@ -54,6 +58,54 @@ from ..tree import Tree
 
 _EPS = 1e-15
 _MODEL_VERSION = "v4"
+
+
+@functools.partial(instrumented_jit, donate_argnums=(0,))
+def _apply_tree_score(
+    score: jnp.ndarray,  # [K, N] f32 (donated: rebound by every caller)
+    leaf_value: jnp.ndarray,  # [L] f32, ALREADY shrunk
+    leaf_id: jnp.ndarray,  # [N] i32
+    kk: jnp.ndarray,  # scalar i32 class row
+) -> jnp.ndarray:
+    """Train-score update (one gather, reference UpdateScore :501) as a
+    donated entry: the old score cache goes back to the allocator instead
+    of coexisting with its successor for a full [K, N] f32."""
+    return score.at[kk].add(leaf_value[leaf_id])
+
+
+@functools.partial(instrumented_jit, donate_argnums=(0,))
+def _apply_tree_valid_score(
+    score: jnp.ndarray,  # [K, N] f32 (donated)
+    bins: jnp.ndarray,  # [N, F_used]
+    nan_bins: jnp.ndarray,  # [F_used]
+    split_feature: jnp.ndarray,  # [L-1]
+    split_bin: jnp.ndarray,
+    default_left: jnp.ndarray,
+    left_child: jnp.ndarray,
+    right_child: jnp.ndarray,
+    leaf_value: jnp.ndarray,  # [L] ALREADY shrunk
+    split_is_cat: jnp.ndarray,  # [L-1] bool
+    cat_mask: jnp.ndarray,  # [L-1, Bm] bool
+    kk: jnp.ndarray,  # scalar i32 class row
+) -> jnp.ndarray:
+    """Valid-score update: bin-space walk of the new tree added into row
+    ``kk`` of the donated [K, N] score cache (one entry instead of a
+    slice/walk/set chain, so the whole old cache is donated — not just the
+    [N] row the walk reads)."""
+    new_row = _add_tree_to_score_impl(
+        score[kk],
+        bins,
+        nan_bins,
+        split_feature,
+        split_bin,
+        default_left,
+        left_child,
+        right_child,
+        leaf_value,
+        split_is_cat,
+        cat_mask,
+    )
+    return score.at[kk].set(new_row)
 
 
 def _ceil_pow2(x: int) -> int:
@@ -379,7 +431,16 @@ class Booster:
                             )
                         )
                     get_session().sync(self._score)
-                ints_d, floats_d = pack_tree_arrays(ta)
+                # ta is dead after the pack (only .shape metadata is read
+                # below): donation retires its ~18 buffers at dispatch
+                # instead of Python GC.  The concatenated outputs can never
+                # alias the inputs, so jax warns "not usable" on the one
+                # trace — expected here, silenced to keep training quiet.
+                with warnings.catch_warnings():
+                    warnings.filterwarnings(
+                        "ignore", message="Some donated buffers were not usable"
+                    )
+                    ints_d, floats_d = pack_tree_arrays_donated(ta)
                 ints_d.copy_to_host_async()
                 floats_d.copy_to_host_async()
                 pend.append(
@@ -2018,24 +2079,26 @@ class Booster:
                         )
                 else:
                     shrunk = leaf_value * self._shrinkage_rate
-                    # train score update: one gather (reference UpdateScore :501)
-                    self._score = self._score.at[kk].add(shrunk[leaf_id])
+                    # train score update: one gather (reference UpdateScore
+                    # :501); the donated entry retires the old score cache
+                    self._score = _apply_tree_score(
+                        self._score, shrunk, leaf_id, jnp.int32(kk)
+                    )
                     # valid score updates: bin-space walk of the new tree
                     for entry in self._valid:
-                        entry.score = entry.score.at[kk].set(
-                            add_tree_to_score(
-                                entry.score[kk],
-                                entry.bins,
-                                self._nan_bins,
-                                ta.split_feature,
-                                ta.split_bin,
-                                ta.default_left,
-                                ta.left_child,
-                                ta.right_child,
-                                shrunk,
-                                ta.split_is_cat,
-                                ta.cat_mask,
-                            )
+                        entry.score = _apply_tree_valid_score(
+                            entry.score,
+                            entry.bins,
+                            self._nan_bins,
+                            ta.split_feature,
+                            ta.split_bin,
+                            ta.default_left,
+                            ta.left_child,
+                            ta.right_child,
+                            shrunk,
+                            ta.split_is_cat,
+                            ta.cat_mask,
+                            jnp.int32(kk),
                         )
                 if abs(init_scores[kk]) > _EPS:
                     tree.add_bias(init_scores[kk])

@@ -10,6 +10,8 @@ they become build-time errors instead.  Run it as
     python -m lightgbm_tpu.lint [--baseline lint_baseline.json] [paths...]
     python -m lightgbm_tpu.lint --changed-only   # dev-loop fast mode
     python -m lightgbm_tpu.lint --json           # incl. per-rule timings
+    python -m lightgbm_tpu.lint --ir             # + GL011-GL015 jaxpr audit
+    python -m lightgbm_tpu.lint --format=github  # ::error annotations
 
 or through the pytest gate (tests/test_lint.py) and the hard CI gate at
 the top of tools/run_tests.sh.  Rules:
@@ -31,6 +33,23 @@ GL009  retrace hazards: scalar-annotated jit params outside
        ``static_argnames``, callbacks without ``ordered=True``
 GL010  host-divergent value (process_index / time / os.environ /
        unseeded RNG) gating a branch that executes a collective
+-----  --------------------------------------------------------------
+       IR-grade rules (``--ir``): ``lint.ir`` traces the real
+       jit/shard_map entries to jaxprs under an abstract-input config
+       matrix (``jax.make_jaxpr`` only — no device execution) and
+       ``rules_ir`` audits the traced facts
+GL011  traced collective incongruent with the sanctioned timed
+       wrappers, the entry's declared mesh axes, the analytic
+       ``mesh_psum_bytes_per_iteration`` payload model, or the GL007
+       AST site model (incl. entries that fail to trace)
+GL012  64-bit aval in a hot entry — directly, or the moment
+       ``enable_x64`` flips on (the dtype-pin invariance contract)
+GL013  per-iteration carried state rebound without ``donate_argnums``
+       (wasted-HBM bytes reported per argument)
+GL014  pallas kernel's static VMEM working set (2x operand blocks +
+       scratch) exceeds the 16 MiB v5e per-core arena
+GL015  host callback compiled into a hot entry outside the sanctioned
+       obs.collectives wrappers (per-iteration device->host round trip)
 =====  ==============================================================
 
 GL007–GL010 share one SPMD index (``callgraph.SpmdIndex``): a

@@ -4,6 +4,13 @@ Exit status 0 when the tree is clean against the baseline (no new
 findings, no stale baseline entries); 1 otherwise.  ``--write-baseline``
 regenerates the baseline from the current findings with TODO
 justifications for review.
+
+``--ir`` additionally traces the real jit/shard_map entries to jaxprs
+(lint.ir config matrix, CPU-only abstract tracing) and runs the
+GL011-GL015 IR audits; with ``--changed-only`` the IR matrix is scoped
+to entries whose transitive module closure intersects the changed
+files (CI runs the full matrix).  ``--format=github`` emits
+``::error file=...,line=...::`` annotations for both passes.
 """
 
 from __future__ import annotations
@@ -77,6 +84,28 @@ def main(argv=None) -> int:
         "--json", action="store_true", help="machine-readable output"
     )
     parser.add_argument(
+        "--format",
+        choices=("text", "github"),
+        default="text",
+        help="finding output format: 'github' prints ::error "
+        "file=...,line=... workflow annotations",
+    )
+    parser.add_argument(
+        "--ir",
+        action="store_true",
+        help="also trace the jit/shard_map entry matrix to jaxprs and "
+        "run the GL011-GL015 IR audits (imports the package; still "
+        "CPU-only abstract tracing, no device execution)",
+    )
+    parser.add_argument(
+        "--ir-entries",
+        nargs="+",
+        metavar="PREFIX",
+        default=None,
+        help="with --ir: trace only entries whose name starts with one "
+        "of these prefixes (e.g. grow/ pallas/histogram)",
+    )
+    parser.add_argument(
         "--changed-only",
         action="store_true",
         help="dev-loop fast mode: report only findings in files git sees "
@@ -101,6 +130,7 @@ def main(argv=None) -> int:
         baseline = cand if cand.exists() else None
 
     only_paths = list(args.paths)
+    ir_changed_modules = None
     if args.changed_only:
         changed = _git_changed_files()
         if changed is None:
@@ -122,10 +152,22 @@ def main(argv=None) -> int:
                 )
                 return 0
             only_paths.extend(changed)
+            if args.ir:
+                # entries are scoped to the package-relative closure
+                ir_changed_modules = [
+                    c[len(pkg_prefix):] for c in changed
+                ]
 
     t0 = time.monotonic()
     c0 = time.process_time()
-    result = run_lint(PKG_ROOT, baseline=baseline, only_paths=only_paths)
+    result = run_lint(
+        PKG_ROOT,
+        baseline=baseline,
+        only_paths=only_paths,
+        ir=args.ir,
+        ir_entry_filter=args.ir_entries,
+        ir_changed_modules=ir_changed_modules,
+    )
     elapsed = time.monotonic() - t0
     cpu = time.process_time() - c0
 
@@ -156,14 +198,27 @@ def main(argv=None) -> int:
         )
         return 0 if result.ok else 1
 
-    for f in result.new:
-        print(f.render())
-        print(f"    fix: {f.hint}")
-    for e in result.stale:
-        print(
-            f"stale baseline entry (no longer fires — remove it): "
-            f"{e['rule']} {e['path']} ident={e['ident']!r}"
-        )
+    if args.format == "github":
+        for f in result.new:
+            print(
+                f"::error file={f.path},line={f.line}::"
+                f"{f.rule} {f.message}"
+            )
+        for e in result.stale:
+            print(
+                f"::error file={e['path']}::stale baseline entry "
+                f"(no longer fires — remove it): {e['rule']} "
+                f"ident={e['ident']}"
+            )
+    else:
+        for f in result.new:
+            print(f.render())
+            print(f"    fix: {f.hint}")
+        for e in result.stale:
+            print(
+                f"stale baseline entry (no longer fires — remove it): "
+                f"{e['rule']} {e['path']} ident={e['ident']!r}"
+            )
     n_base = len(result.findings) - len(result.new)
     print(
         f"graftlint: {len(result.findings)} finding(s) "

@@ -99,7 +99,51 @@ RULES: Dict[str, Tuple[str, str]] = {
         "gate from replicated data (psummed stats, static config) so every "
         "process takes the same path",
     ),
+    # ---- IR-grade rules (lint.ir traces the real entries to jaxprs;
+    # rules_ir.py audits the traced facts; run with --ir)
+    "GL011": (
+        "traced collective incongruent with the sanctioned wrappers, the "
+        "entry's mesh axes, the analytic payload model, or the GL007 AST "
+        "site model (or the entry failed to trace at all)",
+        "route the collective through obs.collectives.timed_* on a "
+        "declared mesh axis, and keep mesh_psum_bytes_per_iteration in "
+        "sync with what the jaxpr actually moves",
+    ),
+    "GL012": (
+        "64-bit aval traced in a hot entry (directly, or the moment "
+        "enable_x64 flips on)",
+        "pin the dtype at the producing op (dtype=jnp.float32 / "
+        "jnp.int32 on arange, random.uniform, asarray) so the entry is "
+        "invariant to the x64 flag",
+    ),
+    "GL013": (
+        "per-iteration carried state rebound without donate_argnums",
+        "declare donate_argnums on the instrumented_jit entry for every "
+        "dead-after-call carried buffer so XLA reuses (or at least "
+        "frees) the input allocation instead of doubling the HBM "
+        "footprint",
+    ),
+    "GL014": (
+        "pallas kernel's static VMEM working set (2x operand blocks + "
+        "scratch) exceeds the per-core budget",
+        "shrink the block shapes / grid so the double-buffered working "
+        "set plus scratch fits the 16 MiB v5e per-core VMEM arena",
+    ),
+    "GL015": (
+        "host callback compiled into a hot (per-iteration) entry outside "
+        "the sanctioned obs.collectives wrappers",
+        "drop the callback from the compiled hot path (aggregate on "
+        "device, fetch after the loop) or route it through the timed "
+        "obs.collectives wrappers so the transfer is measured and "
+        "gated",
+    ),
 }
+
+# rules produced by the IR pass (rules_ir.py): their baseline entries are
+# only checked for staleness when the FULL entry matrix was traced
+IR_RULE_CODES = frozenset(
+    {"GL011", "GL012", "GL013", "GL014", "GL015"}
+)
 
 _SUPPRESS_RE = re.compile(
     r"#\s*graftlint:\s*disable(?:=(?P<codes>[A-Z0-9,\s]+))?"
@@ -379,6 +423,9 @@ def run_lint(
     root: Path,
     baseline: Optional[Path] = None,
     only_paths: Sequence[str] = (),
+    ir: bool = False,
+    ir_entry_filter: Optional[Sequence[str]] = None,
+    ir_changed_modules: Optional[Sequence[str]] = None,
 ) -> LintResult:
     """Scan the package at ``root`` and diff against ``baseline``.
 
@@ -388,6 +435,14 @@ def run_lint(
     Baseline STALE detection is restricted to the same prefixes, so a
     filtered run (``--changed-only``, explicit paths) never misreads
     untouched entries as stale.
+
+    ``ir=True`` additionally traces the lint.ir entry matrix and runs
+    the GL011-GL015 jaxpr audits (this IMPORTS the package — see the
+    ir.py docstring).  ``ir_entry_filter`` (name prefixes) and
+    ``ir_changed_modules`` (package-relative paths) scope which entries
+    are traced; when either scopes the matrix down, IR-rule baseline
+    entries are exempt from stale detection (an untraced entry cannot
+    re-fire its baselined findings).
     """
     import time
 
@@ -403,6 +458,22 @@ def run_lint(
             timings[code] = timings.get(code, 0.0) + (
                 time.monotonic() - t0
             )
+    ir_ran_full = False
+    if ir:
+        from . import rules_ir
+
+        ir_findings, ir_timings, trace_s = rules_ir.run_ir_rules(
+            project,
+            entry_filter=ir_entry_filter,
+            changed_modules=ir_changed_modules,
+        )
+        findings.extend(ir_findings)
+        for code, t in ir_timings.items():
+            timings[code] = timings.get(code, 0.0) + t
+        timings["ir_trace"] = trace_s
+        ir_ran_full = (
+            not ir_entry_filter and ir_changed_modules is None
+        )
     # suppressions, dedup, stable order
     seen = set()
     kept: List[Finding] = []
@@ -433,5 +504,9 @@ def run_lint(
         for e in entries
         if in_scope(e["path"])
         and (e["rule"], e["path"], e["ident"]) not in fired
+        # IR-rule entries can only be judged stale by a FULL matrix run:
+        # with the IR pass off (or scoped down) an entry simply was not
+        # given the chance to fire
+        and (ir_ran_full or e["rule"] not in IR_RULE_CODES)
     ]
     return LintResult(findings=kept, new=new, stale=stale, timings=timings)

@@ -164,6 +164,9 @@ class _InstrumentedJit:
 
     def __init__(self, fun, label: str, jit_kwargs: Dict[str, Any]) -> None:
         self._label = label
+        # introspectable by the lint IR pass (GL013 donation audit) and any
+        # other tooling that needs the entry's declared jit contract
+        self.jit_kwargs: Dict[str, Any] = dict(jit_kwargs)
 
         @functools.wraps(fun)
         def _traced(*args: Any, **kwargs: Any):
@@ -209,10 +212,39 @@ class _InstrumentedJit:
             _label_analyses.setdefault(self._label, {})
             _tls.suppress = True
             try:
-                compiled = self._jit.lower(*args, **kwargs).compile()
+                lowered = self._jit.lower(*args, **kwargs)
+                compiled = lowered.compile()
             finally:
                 _tls.suppress = False
             record_executable(self._label, compiled)
+            self._record_donated(lowered)
+        except Exception:
+            pass
+
+    def _record_donated(self, lowered: Any) -> None:
+        """Gauge ``memory/<label>/donated_bytes``: HBM the entry hands back
+        to the allocator per call (``args_info`` donated flags x aval
+        bytes).  Lowering-level, so it is exact even on backends where the
+        runtime ignores donation (CPU)."""
+        try:
+            total = 0
+            for info in jax.tree_util.tree_leaves(lowered.args_info):
+                if not getattr(info, "donated", False):
+                    continue
+                shape = getattr(info, "shape", None)
+                dtype = getattr(info, "dtype", None)
+                if shape is None or not hasattr(dtype, "itemsize"):
+                    continue
+                n = 1
+                for d in shape:
+                    n *= int(d)
+                total += n * int(dtype.itemsize)
+            if not total:  # only donating entries contribute a gauge
+                return
+            name = f"memory/{self._label}/donated_bytes"
+            prior = _label_analyses.setdefault(self._label, {})
+            prior[name] = max(prior.get(name, 0.0), float(total))
+            get_session().set_gauge_max(name, float(total))
         except Exception:
             pass
 

@@ -599,8 +599,7 @@ def _set_cand(
     ])
 
 
-@instrumented_jit
-def pack_tree_arrays(ta: "TreeArrays"):
+def _pack_tree_arrays_impl(ta: "TreeArrays"):
     """Pack a TreeArrays into (ints, floats) flat vectors so the host can
     fetch a whole tree in two transfers instead of ~14 (each transfer is a
     full round-trip on remote-attached TPUs)."""
@@ -631,6 +630,22 @@ def pack_tree_arrays(ta: "TreeArrays"):
         ]
     )
     return ints, floats
+
+
+# plain variant: the main training path still reads the TreeArrays after the
+# fetch (leaf_value for the score update, split_* for the valid walk), so its
+# buffers must survive the pack
+pack_tree_arrays = instrumented_jit(
+    _pack_tree_arrays_impl, label="pack_tree_arrays"
+)
+# donating variant for callers whose TreeArrays is dead after packing (the
+# pipelined dispatcher hands the tree off and never touches it again): the
+# ~14 per-tree buffers go back to the allocator instead of idling until GC
+pack_tree_arrays_donated = instrumented_jit(
+    _pack_tree_arrays_impl,
+    label="pack_tree_arrays_donated",
+    donate_argnums=(0,),
+)
 
 
 def unpack_tree_arrays(ints, floats, nn: int, L: int) -> "TreeArrays":

@@ -60,8 +60,10 @@ def quantize_gradients(
     hi = hess / h_scale
     if stochastic:
         kg, kh = jax.random.split(rng)
-        rg = jax.random.uniform(kg, grad.shape)
-        rh = jax.random.uniform(kh, hess.shape)
+        # dtype pinned: the default float dtype is f64 under enable_x64,
+        # which would silently widen the whole rounding chain (GL012)
+        rg = jax.random.uniform(kg, grad.shape, dtype=jnp.float32)
+        rh = jax.random.uniform(kh, hess.shape, dtype=jnp.float32)
     else:
         rg = jnp.float32(0.5)
         rh = jnp.float32(0.5)
@@ -134,7 +136,7 @@ def renew_leaf_values(
         sum_g = timed_psum(sum_g, axis_name, site="quant", measure=measure)
         sum_h = timed_psum(sum_h, axis_name, site="quant", measure=measure)
     out = leaf_output(sum_g, sum_h, lambda_l1, lambda_l2, max_delta_step)
-    active = jnp.arange(num_leaves) < num_leaves_used
+    active = jnp.arange(num_leaves, dtype=jnp.int32) < num_leaves_used
     return jnp.where(active & (num_leaves_used > 1), out, 0.0).astype(
         jnp.float32
     )

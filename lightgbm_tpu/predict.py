@@ -287,9 +287,8 @@ def _stacked_bins_leaves_impl(batch: BinTreeBatch, nan_bins: jnp.ndarray, bins: 
     return _predict_bins_leaves_impl(batch, bins, nan_bins)
 
 
-@functools.partial(instrumented_jit, donate_argnums=(0,))
-def add_tree_to_score(
-    score_k: jnp.ndarray,  # [N] f32 (donated)
+def _add_tree_to_score_impl(
+    score_k: jnp.ndarray,  # [N] f32 (donated in the jitted wrappers)
     bins: jnp.ndarray,  # [N, F_used]
     nan_bins: jnp.ndarray,  # [F_used]
     split_feature: jnp.ndarray,  # [L-1]
@@ -326,6 +325,13 @@ def add_tree_to_score(
 
     nodes = lax.while_loop(cond, body, jnp.zeros((n,), jnp.int32))
     return score_k + leaf_value[~nodes]
+
+
+# standalone entry (valid-score updates call it once per tree with a dead
+# score row: the old buffer is donated back to the allocator)
+add_tree_to_score = instrumented_jit(
+    _add_tree_to_score_impl, label="add_tree_to_score", donate_argnums=(0,)
+)
 
 
 # ---------------------------------------------------------------------------

@@ -36,6 +36,7 @@ from lightgbm_tpu.lint import (
     run_lint,
     write_baseline,
 )
+from lightgbm_tpu.lint.core import IR_RULE_CODES
 
 REPO = Path(__file__).resolve().parents[1]
 PKG = REPO / "lightgbm_tpu"
@@ -959,7 +960,8 @@ def test_cli_exit_codes():
     """``python -m lightgbm_tpu.lint`` is the CI entry point: exit 0
     against the committed baseline, exit 1 when the baseline is empty (all
     21 accepted exceptions become NEW findings); ``--json`` reports a
-    wall-time entry per shipped rule."""
+    wall-time entry per shipped AST rule (IR rules are timed only under
+    ``--ir`` — see tests/test_lint_ir.py)."""
     ok = subprocess.run(
         [sys.executable, "-m", "lightgbm_tpu.lint",
          "--baseline", str(REPO / "lint_baseline.json")],
@@ -976,7 +978,7 @@ def test_cli_exit_codes():
     assert bad.returncode == 1
     payload = json.loads(bad.stdout)
     assert payload["new"], "expected the baselined findings to surface"
-    assert set(payload["rule_timings_s"]) == set(RULES)
+    assert set(payload["rule_timings_s"]) == set(RULES) - IR_RULE_CODES
     assert all(t >= 0 for t in payload["rule_timings_s"].values())
 
 
@@ -993,8 +995,10 @@ def test_cli_changed_only_smoke():
 
 
 def test_rule_table_is_complete():
-    """Every rule has a summary and an actionable autofix hint, and the ten
-    shipped codes are exactly the documented set."""
-    assert set(RULES) == {f"GL{i:03d}" for i in range(1, 11)}
+    """Every rule has a summary and an actionable autofix hint, and the
+    fifteen shipped codes (ten AST + five IR) are exactly the documented
+    set."""
+    assert set(RULES) == {f"GL{i:03d}" for i in range(1, 16)}
+    assert IR_RULE_CODES == {f"GL{i:03d}" for i in range(11, 16)}
     for code, (summary, hint) in RULES.items():
         assert summary and hint, code

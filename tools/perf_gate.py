@@ -137,6 +137,11 @@ def collect() -> Dict[str, float]:
             metrics[name] = float(value)
         if name.startswith("memory/") and name.endswith("/temp_bytes"):
             metrics[name] = float(value)
+        # GL013 donation wiring: per-entry HBM handed back to the allocator
+        # (lowering-level args_info, exact on CPU too) — frozen so a lost
+        # donate_argnums shows up as a hard contract diff
+        if name.startswith("memory/") and name.endswith("/donated_bytes"):
+            metrics[name] = float(value)
 
     # -- scenario 2: 8-device data-parallel dryrun, measured collectives
     ndev = len(jax.devices("cpu"))

@@ -23,6 +23,14 @@ rc=0
 echo "=== graftlint (python -m lightgbm_tpu.lint --baseline lint_baseline.json) ==="
 python -m lightgbm_tpu.lint --baseline lint_baseline.json || rc=$?
 
+# graftlint IR gate: trace the real jit/shard_map entry matrix to jaxprs
+# (abstract CPU tracing, no execution) and audit collectives, dtype
+# promotion, donation and Pallas VMEM budgets (GL011-GL015).  Also a
+# hard gate, full matrix in CI (--changed-only scopes it in the dev
+# loop); budgeted <30 s on top of the AST pass.
+echo "=== graftlint IR (python -m lightgbm_tpu.lint --ir --baseline lint_baseline.json) ==="
+python -m lightgbm_tpu.lint --ir --baseline lint_baseline.json || rc=$?
+
 chunks=(
   "tests/test_a* tests/test_b* tests/test_c*"
   "tests/test_d* tests/test_e* tests/test_f* tests/test_g* tests/test_h* tests/test_i* tests/test_l*"
