@@ -545,7 +545,111 @@ def mesh_layout_sweep() -> dict:
     return out
 
 
+def serve_sweep() -> dict:
+    """Offered-load sweep of the serving plane (``lgb.serve``).
+
+    For each offered load (requests/sec of fixed-size requests) a paced
+    client drives the micro-batcher for a few seconds; we record achieved
+    request p50/p99 latency (measured at the caller, enqueue->result),
+    achieved rows/sec throughput, and the batcher's fill/flush/miss
+    counters.  The trade this quantifies: at low load every request rides
+    its own deadline flush (latency ~= deadline), at high load batches
+    fill before the deadline and throughput approaches the bucket-ladder
+    ceiling.  Runs standalone via ``python bench.py --serve-sweep``.
+    """
+    import threading
+
+    import lightgbm_tpu as lgb
+
+    n_rows = int(os.environ.get("BENCH_SERVE_ROWS", 50_000))
+    n_features = 28
+    n_trees = int(os.environ.get("BENCH_SERVE_TREES", 20))
+    req_rows = int(os.environ.get("BENCH_SERVE_REQ_ROWS", 8))
+    duration_s = float(os.environ.get("BENCH_SERVE_SECS", 3.0))
+    loads = [
+        int(v)
+        for v in os.environ.get(
+            "BENCH_SERVE_LOADS", "50,200,1000,4000"
+        ).split(",")
+        if v.strip()
+    ]
+    deadline_ms = float(os.environ.get("BENCH_SERVE_DEADLINE_MS", 5.0))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", 4096))
+
+    X, y = _make_data(n_rows, n_features)
+    params = dict(_PARAMS, num_leaves=63)
+    booster = lgb.train(params, lgb.Dataset(X, y, params=params), n_trees)
+    rng = np.random.default_rng(7)
+    Xq = rng.normal(size=(req_rows, n_features)).astype(np.float32)
+
+    out = {
+        "req_rows": req_rows,
+        "duration_s": duration_s,
+        "deadline_ms": deadline_ms,
+        "max_batch": max_batch,
+        "n_trees": len(booster.models_),
+        "loads": {},
+    }
+    server = lgb.serve(
+        booster, deadline_ms=deadline_ms, max_batch=max_batch, port=0
+    )
+    try:
+        for load in loads:
+            # paced open-loop client: one request every 1/load seconds,
+            # latency measured enqueue->result at the caller
+            lat_lock = threading.Lock()
+            latencies: list = []
+            pending: list = []
+            interval = 1.0 / load
+            t_end = time.perf_counter() + duration_s
+
+            def reap(fut, t0):
+                fut.result(timeout=60.0)
+                with lat_lock:
+                    latencies.append((time.perf_counter() - t0) * 1e3)
+
+            t_next = time.perf_counter()
+            n_sent = 0
+            while time.perf_counter() < t_end:
+                t0 = time.perf_counter()
+                fut = server.predict_async(Xq)
+                th = threading.Thread(target=reap, args=(fut, t0))
+                th.start()
+                pending.append(th)
+                n_sent += 1
+                t_next += interval
+                sleep = t_next - time.perf_counter()
+                if sleep > 0:
+                    time.sleep(sleep)
+            for th in pending:
+                th.join(timeout=60.0)
+            lat = sorted(latencies)
+
+            def pct(q):
+                return round(lat[min(len(lat) - 1, int(q * (len(lat) - 1)))], 3)
+
+            stats = server.stats()
+            out["loads"][str(load)] = {
+                "offered_rps": load,
+                "achieved_rps": round(n_sent / duration_s, 1),
+                "rows_per_sec": round(n_sent * req_rows / duration_s, 1),
+                "p50_ms": pct(0.50) if lat else None,
+                "p99_ms": pct(0.99) if lat else None,
+                "batch_fill": round(stats["batch_fill"], 4),
+                "deadline_miss_rate": round(stats["deadline_miss_rate"], 4),
+            }
+    finally:
+        server.stop()
+    return out
+
+
 def main() -> None:
+    if "--serve-sweep" in sys.argv:
+        # standalone, CPU-pinned like --mesh-sweep: the sweep measures the
+        # batching/latency trade, not kernel speed
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps({"serve_sweep": serve_sweep()}))
+        return
     if "--mesh-sweep" in sys.argv:
         # standalone: 8 virtual CPU devices, CPU pinned before backend init
         flags = os.environ.get("XLA_FLAGS", "")

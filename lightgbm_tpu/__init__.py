@@ -52,6 +52,7 @@ from .resilience import (
     save_checkpoint,
 )
 from .parser import register_parser
+from .serving import ModelRegistry, RefreshLoop, ServingServer, serve
 from .utils.log import register_logger, unregister_logger
 from .utils.timer import global_timer
 
@@ -85,6 +86,10 @@ __all__ = [
     "compile_count",
     "compile_counts_by_label",
     "NumericsError",
+    "serve",
+    "ServingServer",
+    "ModelRegistry",
+    "RefreshLoop",
     "checkpoint_callback",
     "save_checkpoint",
     "restore_checkpoint",

@@ -2500,14 +2500,32 @@ class Booster:
         start_iteration: int = 0,
         num_iteration: Optional[int] = None,
         kinds=("value",),
+        chunk: Optional[int] = None,
     ) -> int:
         """AOT-lower and cache the streaming engine's bucket-ladder
         executables so the first predict() pays no compile (pred_aot_compile
-        runs this at Booster load).  Returns the number of executables
-        compiled."""
+        runs this at Booster load).  ``chunk`` overrides the config's
+        ``pred_chunk_rows`` ladder top (the serving registry warms at its
+        ``serve_max_batch``).  Returns the number of executables compiled."""
         t0, t1 = self._tree_range(start_iteration, num_iteration)
         if t1 <= t0 or not self.models_:
             return 0
+        knobs = self._predict_knobs({})
+        if chunk is None:
+            chunk = knobs["chunk"]
+        return self._stream_engine().warmup(
+            t0,
+            t1,
+            space=self._predict_space(t0, t1),
+            chunk=max(256, int(chunk)),
+            shard_devices=knobs["shard_devices"],
+            kinds=kinds,
+        )
+
+    def _predict_space(self, t0: int, t1: int) -> str:
+        """Which walker space predict() will use for this tree range: exact
+        bin-space when the training BinMappers are present and every tree
+        has a bin-space form, else real-value space."""
         use_bins = (
             self.train_set is not None
             and self.train_set.bin_mappers
@@ -2515,15 +2533,7 @@ class Booster:
                 r.get("no_bin_form") for r in self._bin_records[t0:t1]
             )
         )
-        knobs = self._predict_knobs({})
-        return self._stream_engine().warmup(
-            t0,
-            t1,
-            space="bin" if use_bins else "real",
-            chunk=max(256, knobs["chunk"]),
-            shard_devices=knobs["shard_devices"],
-            kinds=kinds,
-        )
+        return "bin" if use_bins else "real"
 
     def _real_walk_suspects(self, X: np.ndarray, t0: int, t1: int) -> np.ndarray:
         """Row indices whose f32 walk could disagree with the reference's

@@ -499,6 +499,19 @@ class Config:
     pred_shard_devices: int = 1
     pred_aot_compile: bool = False
 
+    # Serving (lightgbm_tpu/serving/): lgb.serve() micro-batcher + registry.
+    # serve_deadline_ms bounds how long a request may wait for coalescing
+    # before its batch flushes; serve_max_batch caps coalesced rows per
+    # dispatch (and is the registry's warmed ladder chunk, so every flush
+    # hits an AOT bucket); serve_memory_budget_mb bounds the registry's
+    # estimated device-table residency (0 = unlimited, LRU-evicts beyond);
+    # serve_port binds the HTTP front end (/predict + /metrics + /healthz;
+    # 0 disables, -1 binds an ephemeral port and reports it).
+    serve_deadline_ms: float = 5.0
+    serve_max_batch: int = 4096
+    serve_memory_budget_mb: float = 0.0
+    serve_port: int = 0
+
     # Objective
     objective_seed: int = 5
     num_class: int = 1
@@ -647,6 +660,18 @@ class Config:
         if not (0 <= self.obs_export_port <= 65535):
             raise ValueError(
                 "obs_export_port must be in [0, 65535] (0 disables)"
+            )
+        if self.serve_deadline_ms <= 0:
+            raise ValueError("serve_deadline_ms must be > 0")
+        if self.serve_max_batch < 1:
+            raise ValueError("serve_max_batch must be >= 1")
+        if self.serve_memory_budget_mb < 0:
+            raise ValueError(
+                "serve_memory_budget_mb must be >= 0 (0 = unlimited)"
+            )
+        if not (-1 <= self.serve_port <= 65535):
+            raise ValueError(
+                "serve_port must be in [-1, 65535] (0 disables, -1 ephemeral)"
             )
         if self.flight_capacity < 32:
             raise ValueError(

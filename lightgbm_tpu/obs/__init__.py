@@ -51,6 +51,7 @@ from .export import (  # noqa: F401
     health_snapshot,
     prometheus_snapshot,
     sanitize_metric_name,
+    set_serving_provider,
 )
 from .flight import (  # noqa: F401
     FlightRecorder,
@@ -89,6 +90,7 @@ __all__ = [
     "health_snapshot",
     "prometheus_snapshot",
     "sanitize_metric_name",
+    "set_serving_provider",
     "instrumented_jit",
     "note_compile",
     "note_executable",

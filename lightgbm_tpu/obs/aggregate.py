@@ -38,10 +38,20 @@ def host_snapshot(ses: Optional[TelemetrySession] = None) -> Dict[str, Any]:
     ]
     import jax
 
+    # exclude the DERIVED gauges a previous rollup folded back into the
+    # session: the session is a process-global singleton, so a long-lived
+    # process that trains repeatedly (serving refresh loops, sweeps, test
+    # suites) would otherwise re-aggregate agg/* into agg/agg/* — gauge
+    # count triples per rollup.  Filtering keeps rollup idempotent.
+    gauges = {
+        name: v
+        for name, v in ses.gauges.items()
+        if not name.startswith(("agg/", "straggler/"))
+    }
     return {
         "process": int(jax.process_index()),
         "counters": dict(ses.counters),
-        "gauges": dict(ses.gauges),
+        "gauges": gauges,
         "iter_wall_ms": iter_walls,
     }
 

@@ -14,6 +14,13 @@ hung scrape never blocks training and the thread dies with the process):
 * ``GET /metrics``  — Prometheus text format
 * ``GET /healthz``  — the ``Booster.health()`` JSON document
 
+The serving plane (``lightgbm_tpu/serving``) colocates its HTTP/JSON
+front end on the same endpoint by passing extra ``routes`` (method/path
+handlers, e.g. ``POST /predict``), and registers a serving-snapshot
+provider (:func:`set_serving_provider`) so ``health_snapshot`` grows a
+``serving`` block and the ``lgbtpu_serve_*`` gauges ride the normal
+gauge flattening.
+
 Everything here is host-only code operating on already-recorded telemetry
 — no tracer reads, no device syncs (GL003/GL010-clean by construction).
 """
@@ -33,6 +40,28 @@ from .registry import TelemetrySession, _jsonable, get_session
 METRIC_PREFIX = "lgbtpu_"
 
 _NAME_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+# Optional provider of the health document's "serving" block, registered
+# by the serving plane while a ServingServer is live (obs must not import
+# serving — the dependency points the other way).
+_serving_provider: Optional[Callable[[], Dict[str, Any]]] = None
+
+
+def set_serving_provider(
+    fn: Optional[Callable[[], Dict[str, Any]]]
+) -> Optional[Callable[[], Dict[str, Any]]]:
+    """Register (or clear, with ``None``) the serving-snapshot provider.
+
+    Returns the previous provider so a short-lived server (drills, tests)
+    can restore it on stop instead of clobbering a longer-lived one."""
+    global _serving_provider
+    prev = _serving_provider
+    _serving_provider = fn
+    return prev
+
+
+def get_serving_provider() -> Optional[Callable[[], Dict[str, Any]]]:
+    return _serving_provider
 
 
 def sanitize_metric_name(name: str) -> str:
@@ -110,7 +139,13 @@ def health_snapshot(
         gauges = dict(ses.gauges)
     alerts = watchdog.active_alerts() if watchdog is not None else []
     status = watchdog.status() if watchdog is not None else "ok"
-    return _jsonable(
+    serving: Optional[Dict[str, Any]] = None
+    if _serving_provider is not None:
+        try:
+            serving = _serving_provider()
+        except Exception:
+            serving = None
+    doc = _jsonable(
         {
             "schema": "lgbtpu.health.v1",
             "status": status,
@@ -130,10 +165,32 @@ def health_snapshot(
             },
         }
     )
+    if serving is not None:
+        doc["serving"] = _jsonable(serving)
+    return doc
 
 
 class _Handler(BaseHTTPRequestHandler):
     exporter: "MetricsExporter"
+
+    def _respond(self, status: int, ctype: str, body: bytes) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _dispatch_route(self, method: str, path: str, body: bytes) -> bool:
+        route = self.exporter._routes.get((method, path))
+        if route is None:
+            return False
+        try:
+            status, ctype, out = route(body)
+        except Exception as e:
+            status, ctype = 500, "application/json"
+            out = json.dumps({"error": str(e)}).encode("utf-8")
+        self._respond(status, ctype, out)
+        return True
 
     def do_GET(self):  # noqa: N802 - http.server API
         path = self.path.split("?", 1)[0]
@@ -145,15 +202,21 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/healthz":
             body = json.dumps(self.exporter._health() or {}).encode("utf-8")
             ctype = "application/json"
+        elif self._dispatch_route("GET", path, b""):
+            return
         else:
             self.send_response(404)
             self.end_headers()
             return
-        self.send_response(200)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        self._respond(200, ctype, body)
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        path = self.path.split("?", 1)[0]
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        if not self._dispatch_route("POST", path, body):
+            self.send_response(404)
+            self.end_headers()
 
     def log_message(self, fmt, *args):  # silence per-request stderr spam
         pass
@@ -171,12 +234,24 @@ class MetricsExporter:
         port: int,
         host: str = "127.0.0.1",
         health_provider: Optional[Callable[[], Dict[str, Any]]] = None,
+        routes: Optional[
+            Dict[Any, Callable[[bytes], Any]]
+        ] = None,
     ) -> None:
         self._requested_port = int(port)
         self._host = host
         self._health_provider = health_provider
+        # extra (method, path) -> fn(body) -> (status, ctype, bytes)
+        # handlers, consulted after the built-in /metrics and /healthz —
+        # how the serving plane colocates POST /predict on this endpoint
+        self._routes: Dict[Any, Callable[[bytes], Any]] = dict(routes or {})
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    def add_route(
+        self, method: str, path: str, fn: Callable[[bytes], Any]
+    ) -> None:
+        self._routes[(method, path)] = fn
 
     def _health(self) -> Optional[Dict[str, Any]]:
         if self._health_provider is None:

@@ -40,6 +40,7 @@ FLIGHT_SCHEMA = "lgbtpu.flight.v1"
 MIN_CAPACITY = 32
 DEFAULT_CAPACITY = 256
 _MAX_ALERTS = 128
+_MAX_STICKY = 64
 
 
 def _atomic_write_text(path: str, text: str) -> None:
@@ -82,6 +83,9 @@ class FlightRecorder:
         self._alerts: Deque[Dict[str, Any]] = collections.deque(
             maxlen=_MAX_ALERTS
         )
+        self._sticky: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=_MAX_STICKY
+        )
         self.active = True
         self.fault_dir = ""
         self.run_info: Dict[str, Any] = {}
@@ -115,6 +119,7 @@ class FlightRecorder:
         with self._lock:
             self._events.clear()
             self._alerts.clear()
+            self._sticky.clear()
             self.last_checkpoint = ""
             self.last_dump_path = ""
             self.dump_count = 0
@@ -140,6 +145,18 @@ class FlightRecorder:
             self._alerts.append(alert)
             self._events.append(alert)
 
+    def note_sticky(self, event: Dict[str, Any]) -> None:
+        """Record a rare, high-value lifecycle event (model swap, refresh
+        promotion) that must survive ring eviction: kept in a separate
+        bounded deque so a flood of per-batch events can never push the
+        swap history out of a dump, and mirrored into the ring so dumps
+        still show it in chronological context."""
+        if not self.active:
+            return
+        with self._lock:
+            self._sticky.append(event)
+            self._events.append(event)
+
     def note_checkpoint(self, path: str) -> None:
         if not self.active:
             return
@@ -154,6 +171,10 @@ class FlightRecorder:
         with self._lock:
             return list(self._alerts)
 
+    def sticky_events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._sticky)
+
     # -------------------------------------------------------------- dumps
     def snapshot(self, reason: str = "") -> Dict[str, Any]:
         """JSON-serializable snapshot of the ring + live telemetry tables."""
@@ -163,6 +184,7 @@ class FlightRecorder:
         with self._lock:
             events = list(self._events)
             alerts = list(self._alerts)
+            sticky = list(self._sticky)
             snap = {
                 "schema": FLIGHT_SCHEMA,
                 "reason": reason,
@@ -178,6 +200,7 @@ class FlightRecorder:
         snap["gauges"] = dict(ses.gauges)
         snap["events"] = events
         snap["alerts"] = alerts
+        snap["sticky_events"] = sticky
         return _jsonable(snap)
 
     def dump(self, reason: str, directory: Optional[str] = None) -> str:

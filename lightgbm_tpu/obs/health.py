@@ -26,6 +26,10 @@ on/off switch to keep the Config surface small):
 ``straggler``       per-host iteration-wall skew (max/mean) exceeds bound
 ``hbm``             device bytes-in-use grew well past the run's baseline
                     (leak / fragmentation watch)
+``serve_deadline``  serving-plane deadline-miss rate exceeded its ceiling
+                    (driven by the micro-batcher's windowed stats via
+                    :meth:`observe_serving`, not the training iteration
+                    cadence)
 ==================  ========================================================
 """
 
@@ -57,6 +61,8 @@ class HealthWatchdog:
         straggler_skew_ceiling: float = 1.5,
         hbm_growth_factor: float = 1.5,
         hbm_growth_floor_bytes: float = 64 * 1024 * 1024,
+        deadline_miss_ceiling: float = 0.25,
+        deadline_miss_min_requests: int = 16,
     ) -> None:
         self.warmup_iters = int(warmup_iters)
         self.cooldown_iters = int(cooldown_iters)
@@ -68,6 +74,8 @@ class HealthWatchdog:
         self.straggler_skew_ceiling = float(straggler_skew_ceiling)
         self.hbm_growth_factor = float(hbm_growth_factor)
         self.hbm_growth_floor_bytes = float(hbm_growth_floor_bytes)
+        self.deadline_miss_ceiling = float(deadline_miss_ceiling)
+        self.deadline_miss_min_requests = int(deadline_miss_min_requests)
         self._wall_ema: Optional[float] = None
         self._hbm_baseline: Optional[float] = None
         self._seen = 0
@@ -218,6 +226,42 @@ class HealthWatchdog:
                     float(in_use), bound,
                 )
 
+        if out:
+            flight = get_flight()
+            for alert in out:
+                ses.inc("alerts_total")
+                ses.inc(f"alerts/{alert['rule']}")
+                ses.record_alert(alert)
+                flight.note_alert(alert)
+        return out
+
+    def observe_serving(
+        self,
+        event: Dict[str, Any],
+        ses: Optional[TelemetrySession] = None,
+    ) -> List[Dict[str, Any]]:
+        """Evaluate the serving rules against one micro-batcher stats
+        window.  The serving plane has no boosting iterations, so the
+        batcher's dispatched-batch count stands in for ``iter`` in the
+        cooldown/activity bookkeeping (same monotonic role: one tick per
+        unit of work)."""
+        ses = ses or get_session()
+        it = int(event.get("iter", self._last_iter + 1))
+        self._last_iter = max(self._last_iter, it)
+        out: List[Dict[str, Any]] = []
+        miss = event.get("deadline_miss_rate")
+        requests = int(event.get("requests", 0))
+        if (
+            miss is not None
+            and requests >= self.deadline_miss_min_requests
+            and miss > self.deadline_miss_ceiling
+        ):
+            self._emit(
+                out, it, "serve_deadline", SEV_WARN,
+                f"serving deadline-miss rate {miss:.3f} > "
+                f"{self.deadline_miss_ceiling:g} over {requests} requests",
+                float(miss), self.deadline_miss_ceiling,
+            )
         if out:
             flight = get_flight()
             for alert in out:

@@ -146,6 +146,16 @@ class TelemetrySession:
         with self._lock:
             self.gauges[name] = value
 
+    def update_gauges(self, values: Dict[str, float]) -> None:
+        """Set many gauges under one lock acquisition (the serving plane
+        publishes its whole latency window atomically so a concurrent
+        /metrics scrape never sees p50 from one window and p99 from the
+        next)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.gauges.update(values)
+
     def set_gauge_max(self, name: str, value: float) -> None:
         """Monotone-max gauge (HBM watermarks, worst-case executable cost
         across ladder buckets: re-recording never lowers the reading)."""

@@ -486,7 +486,11 @@ def _packed_bins_leaves_impl(forest: PackedBinForest, bins: jnp.ndarray, *, base
 
 
 # executables are shared ACROSS boosters (like jit's global cache): the key
-# is shapes + statics only, tables arrive as call arguments
+# is shapes + statics only, tables arrive as call arguments.  A scoped
+# engine (serving registry) prepends its scope string so two co-resident
+# models never collide on a key even at identical table shapes, and so a
+# retired model's executables can be evicted without touching its
+# neighbours' (`evict_exec_scope`).
 _EXEC_CACHE: Dict[Any, Any] = {}
 _COMPILE_COUNT = 0
 
@@ -495,6 +499,18 @@ def streaming_compile_count() -> int:
     """Total bucket executables compiled this process (test hook: asserting
     this stays flat across varying batch sizes proves zero recompiles)."""
     return _COMPILE_COUNT
+
+
+def evict_exec_scope(scope: str) -> int:
+    """Drop every cached executable compiled under `scope` (serving registry
+    retirement after drain).  Returns how many entries were evicted.  The
+    unscoped (scope=None) shared cache is never touched."""
+    if not scope:
+        return 0
+    dead = [k for k in _EXEC_CACHE if k[0] == scope]
+    for k in dead:
+        del _EXEC_CACHE[k]
+    return len(dead)
 
 
 def _shape_key(tree):
@@ -524,8 +540,13 @@ class StreamingPredictor:
     device mesh (pjit data axis), tables replicated.
     """
 
-    def __init__(self, booster):
+    def __init__(self, booster, scope: Optional[str] = None):
         self._b = booster
+        # scope=None (default) keeps the process-global shared cache and the
+        # frozen `predict/stream/{variant}` labels; a registry-owned engine
+        # passes its model identity so cache keys and retrace labels become
+        # per-model (`predict/stream/{scope}/{variant}`)
+        self._scope = scope
         self.last_stats: Dict[str, Any] = {}
 
     # ------------------------------------------------------------- tables
@@ -556,6 +577,7 @@ class StreamingPredictor:
     def _get_exec(self, variant, kind, tables, statics, bucket, width, dtype, ndev):
         global _COMPILE_COUNT
         key = (
+            self._scope,
             variant,
             kind,
             bucket,
@@ -565,11 +587,16 @@ class StreamingPredictor:
             tuple(sorted(statics.items())),
             _shape_key(tables),
         )
+        label = (
+            f"predict/stream/{self._scope}/{variant}"
+            if self._scope
+            else f"predict/stream/{variant}"
+        )
         hit = _EXEC_CACHE.get(key)
         if hit is not None:
             # device_accounting may have turned on after the miss that
             # compiled this bucket; note_executable dedups per object
-            note_executable(f"predict/stream/{variant}", hit)
+            note_executable(label, hit)
             return hit
         impl = {
             ("packed", "value"): _packed_bins_pertree_impl,
@@ -602,9 +629,7 @@ class StreamingPredictor:
         # labeled per table variant so suspect re-walk ("real") compiles are
         # separable in compile_counts_by_label(); the lower().compile() below
         # traces exactly once, which instrumented_jit counts at trace time
-        fn = instrumented_jit(
-            impl, label=f"predict/stream/{variant}", **jit_kwargs
-        )
+        fn = instrumented_jit(impl, label=label, **jit_kwargs)
         avals = tuple(
             jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t
@@ -614,7 +639,7 @@ class StreamingPredictor:
         compiled = fn.lower(*avals).compile()
         _EXEC_CACHE[key] = compiled
         _COMPILE_COUNT += 1
-        note_executable(f"predict/stream/{variant}", compiled)
+        note_executable(label, compiled)
         return compiled
 
     def warmup(

@@ -68,6 +68,18 @@ def force_pallas_raise(at_iteration: int = 0) -> None:
     arm("force_pallas_raise", int(at_iteration))
 
 
+def kill_during_warmup(at_step: int = 1) -> None:
+    """Abort a serving-registry ladder warmup at bucket ``at_step``.
+
+    Models the warmup worker dying mid-ladder during a hot-swap (the
+    injected-exception stand-in for a SIGKILL, same precedent as
+    ``force_pallas_raise`` for Mosaic failures — a literal SIGKILL would
+    take the serving process with it, which is exactly what the swap path
+    must never let a *warmup* failure do).  The registry's hot_swap must
+    leave the old generation serving and dump the flight ring."""
+    arm("kill_during_warmup", int(at_step))
+
+
 # ---------------------------------------------------------------- consults
 
 
@@ -78,6 +90,17 @@ def on_iteration(iteration: int) -> None:
     k = _ARMED.get("kill_at_iteration")
     if k is not None and iteration >= k:
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_kill_warmup(scope: str, step: int) -> None:
+    """Consulted between ladder buckets in the serving registry's warmup."""
+    if not _ARMED:
+        return
+    k = _ARMED.get("kill_during_warmup")
+    if k is not None and step >= k:
+        raise InjectedFault(
+            f"injected warmup kill for {scope} at ladder step {step}"
+        )
 
 
 def maybe_poison_gradients(grad, hess, iteration: int) -> Tuple[Any, Any]:
@@ -163,7 +186,9 @@ def flight_dump_drill_degradation(workdir: str) -> str:
     return _assert_flight_dump(workdir, "degradation")
 
 
-def _assert_flight_dump(workdir: str, reason_prefix: str) -> str:
+def _assert_flight_dump(
+    workdir: str, reason_prefix: str, require_iterations: bool = True
+) -> str:
     """Shared dump validity assertions for the drills above."""
     import json
 
@@ -175,19 +200,138 @@ def _assert_flight_dump(workdir: str, reason_prefix: str) -> str:
         doc = json.load(f)
     assert doc["schema"] == FLIGHT_SCHEMA, doc.get("schema")
     assert doc["reason"].startswith(reason_prefix), doc["reason"]
-    n_iter_events = sum(
-        1 for e in doc["events"] if e.get("event") == "iteration"
-    )
-    # the contract is "last >= 32 iteration events OR every iteration the
-    # run got through" — these drills die early, so all iterations so far
-    # must be present
-    assert n_iter_events >= min(32, 1), doc["n_events"]
+    if require_iterations:
+        n_iter_events = sum(
+            1 for e in doc["events"] if e.get("event") == "iteration"
+        )
+        # the contract is "last >= 32 iteration events OR every iteration
+        # the run got through" — these drills die early, so all iterations
+        # so far must be present
+        assert n_iter_events >= min(32, 1), doc["n_events"]
     if reason_prefix == "numerics":
         assert any(
             a.get("rule") == "numerics" and a.get("severity") == "critical"
             for a in doc["alerts"]
         ), f"numerics alert missing from dump alerts: {doc['alerts']}"
     return dumps[-1]
+
+
+def _serving_drill_fixture(workdir: str, n_trees: int = 3):
+    """Shared setup for the serving drills: two tiny models (same shape,
+    different data so their outputs differ) and a live ServingServer over
+    the first, with the flight recorder's fault_dir pointed at workdir."""
+    import numpy as np
+
+    from .. import engine
+    from ..dataset import Dataset
+    from ..obs.flight import get_flight
+    from ..serving import serve
+
+    params = {"objective": "regression", "num_leaves": 7, "verbosity": -1}
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(600, 6))
+    b1 = engine.train(params, Dataset(X, X[:, 0] + 0.1 * X[:, 1]), n_trees)
+    b2 = engine.train(params, Dataset(X, X[:, 1] - 0.3 * X[:, 2]), n_trees)
+    # after the trains: each train run resets the ring and re-points
+    # fault_dir (to "" here — no checkpoint dir), so configure last
+    get_flight().configure(fault_dir=workdir)
+    server = serve(
+        {"drill": b1}, deadline_ms=2.0, max_batch=512, port=0
+    )
+    return server, b1, b2, rng
+
+
+def swap_under_load_drill(workdir: str) -> str:
+    """Drill: hot-swap while concurrent requests are in flight.
+
+    Every response must match one model version bit-exactly (no mixed
+    outputs), the swap must land a sticky flight event, and an explicit
+    post-swap dump into ``workdir`` must validate.  Returns the dump path.
+    """
+    import threading
+    import time
+
+    import numpy as np
+
+    from ..obs.flight import get_flight
+
+    server, b1, b2, rng = _serving_drill_fixture(workdir)
+    try:
+        Xq = rng.normal(size=(64, 6))
+        p1, p2 = b1.predict(Xq), b2.predict(Xq)
+        futures, stop = [], threading.Event()
+
+        def client():
+            # paced + bounded so the swap-long window doesn't bury the
+            # batcher under an unbounded future backlog
+            for _ in range(300):
+                if stop.is_set():
+                    break
+                futures.append(server.predict_async(Xq))
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        for t in threads:
+            t.start()
+        server.swap("drill", b2)
+        stop.set()
+        for t in threads:
+            t.join()
+        mixed = 0
+        for fut in futures:
+            vals = fut.result(timeout=30.0).values
+            if not (np.array_equal(vals, p1) or np.array_equal(vals, p2)):
+                mixed += 1
+        assert mixed == 0, f"{mixed} responses mixed model generations"
+        assert any(
+            e.get("event") == "serve_model_swap"
+            for e in get_flight().sticky_events()
+        ), "swap left no sticky flight event"
+        get_flight().dump("swap_under_load")
+    finally:
+        server.stop()
+    return _assert_flight_dump(
+        workdir, "swap_under_load", require_iterations=False
+    )
+
+
+def kill_during_warmup_drill(workdir: str) -> str:
+    """Drill: a warmup death mid-hot-swap must not take down serving.
+
+    Arms ``kill_during_warmup`` and attempts a swap: the swap must fail
+    with :class:`InjectedFault`, the OLD generation must keep serving
+    (bit-exact against the old model), and the registry must have dumped
+    a valid ``swap_warmup_failure`` flight ring into ``workdir``.
+    Returns the dump path.
+    """
+    import numpy as np
+
+    server, b1, b2, rng = _serving_drill_fixture(workdir)
+    try:
+        Xq = rng.normal(size=(32, 6))
+        kill_during_warmup(1)
+        try:
+            try:
+                server.swap("drill", b2)
+            except InjectedFault:
+                pass
+            else:
+                raise AssertionError(
+                    "kill_during_warmup did not abort the swap"
+                )
+        finally:
+            disarm("kill_during_warmup")
+        served = server.predict(Xq, timeout=30.0)
+        assert np.array_equal(served, b1.predict(Xq)), (
+            "old generation is not serving bit-exactly after failed swap"
+        )
+        snap = server.serving_snapshot()
+        assert snap["models"][0]["version"] == 1, snap["models"]
+    finally:
+        server.stop()
+    return _assert_flight_dump(
+        workdir, "swap_warmup_failure", require_iterations=False
+    )
 
 
 def maybe_raise_pallas(where: str, iteration: Optional[int] = None) -> None:

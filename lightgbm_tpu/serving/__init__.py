@@ -1,0 +1,29 @@
+"""Low-latency serving plane: micro-batching, model registry, hot-swap.
+
+The request-facing half of the production story (ROADMAP item 3):
+
+* :class:`MicroBatcher` — async coalescing of small predict requests
+  into warm bucket-ladder chunks under a latency deadline;
+* :class:`ModelRegistry` — co-resident models with AOT-warmed ladders,
+  per-model executable-cache scoping, LRU eviction under a device-memory
+  budget, and atomic generation-counted hot-swap;
+* :class:`RefreshLoop` — metric-gated continual refresh (refit/extend on
+  accumulated traffic, promote via hot-swap, atomic artifacts);
+* :class:`ServingServer` / :func:`serve` — the ``lgb.serve()`` wiring
+  plus the HTTP/JSON front end colocated with the obs exporter.
+"""
+
+from .batcher import MicroBatcher, ServeResponse  # noqa: F401
+from .refresh import RefreshLoop  # noqa: F401
+from .registry import ModelEntry, ModelRegistry  # noqa: F401
+from .server import ServingServer, serve  # noqa: F401
+
+__all__ = [
+    "MicroBatcher",
+    "ServeResponse",
+    "ModelEntry",
+    "ModelRegistry",
+    "RefreshLoop",
+    "ServingServer",
+    "serve",
+]

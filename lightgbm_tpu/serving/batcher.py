@@ -1,0 +1,338 @@
+"""Async micro-batcher: coalesce small predict requests into ladder chunks.
+
+The serving plane's latency/throughput trade lives here.  Requests (any
+row count, one feature-space matrix each) enter a queue; a single worker
+thread coalesces them into batches under two flush triggers:
+
+* **bucket-full** — accumulated rows reached ``max_batch`` (adding the
+  next request would overflow it);
+* **deadline** — the oldest queued request has waited ``deadline_ms``.
+
+Every dispatched matrix is padded to a ``bucket_rows`` ladder bucket (and
+batches larger than the chunk are sliced into chunk-sized plans), so the
+downstream ``StreamingPredictor`` only ever sees row counts it AOT-warmed
+— zero recompiles after warmup, by construction.  Tree walks and the
+output transform are row-local, so coalescing, padding and slicing are
+bit-identical per row to calling ``Booster.predict`` on each request
+alone (asserted in tests/test_serving.py).
+
+A whole request always lands in ONE dispatch call: the dispatcher
+acquires a single registry entry per call, so no request can ever observe
+mixed-model outputs across a hot-swap.
+
+Deadline-miss accounting: a request "missed" when its queue wait exceeded
+the deadline plus a small scheduling slack — under healthy load the
+deadline flush fires within the slack, so misses measure real overload
+(the worker busy with the previous dispatch), not the coalescing wait
+itself.  The windowed miss rate drives the ``serve_deadline`` watchdog
+rule.
+
+Host-only threading code: no jax imports, no device syncs.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..obs.flight import get_flight
+from ..obs.registry import get_session
+from ..predict import LADDER_MIN, bucket_rows
+
+# Plans: (padded_matrix, live_rows) pairs — one dispatch call predicts
+# them all under a single model acquisition and returns the concatenated
+# live-row predictions plus an info dict (model id/version/generation).
+DispatchFn = Callable[[List[Tuple[np.ndarray, int]]], Tuple[np.ndarray, Dict[str, Any]]]
+
+_STATS_WINDOW = 1024  # requests per latency window
+
+
+class ServeResponse(NamedTuple):
+    """One request's predictions plus the model identity that served it."""
+
+    values: np.ndarray
+    info: Dict[str, Any]
+
+
+class _Request(NamedTuple):
+    X: np.ndarray
+    future: Future
+    t_enqueue: float
+
+
+class _Stop:
+    pass
+
+
+_STOP = _Stop()
+
+
+class MicroBatcher:
+    """Single-model async coalescer feeding a warm bucket ladder."""
+
+    def __init__(
+        self,
+        dispatch: DispatchFn,
+        *,
+        deadline_ms: float = 5.0,
+        max_batch: int = 4096,
+        name: str = "default",
+        on_window: Optional[Callable[[Dict[str, Any]], None]] = None,
+    ) -> None:
+        if deadline_ms <= 0:
+            raise ValueError("deadline_ms must be > 0")
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self._dispatch = dispatch
+        self.deadline_s = float(deadline_ms) / 1e3
+        self.max_batch = int(max_batch)
+        # ladder chunk: bucket_rows floors at LADDER_MIN, so the effective
+        # ladder top is at least that even for tiny max_batch settings
+        self.chunk = max(LADDER_MIN, self.max_batch)
+        # misses measure overload, not the coalescing wait: healthy
+        # deadline flushes land within this slack of the deadline
+        self.miss_slack_s = max(0.5 * self.deadline_s, 2e-3)
+        self.name = name
+        self._on_window = on_window
+        self._queue: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._latencies_ms: collections.deque = collections.deque(
+            maxlen=_STATS_WINDOW
+        )
+        self._miss_window: collections.deque = collections.deque(
+            maxlen=_STATS_WINDOW
+        )
+        self._fill_window: collections.deque = collections.deque(maxlen=256)
+        self.counters: Dict[str, int] = {
+            "requests": 0,
+            "rows": 0,
+            "batches": 0,
+            "deadline_flush": 0,
+            "full_flush": 0,
+            "deadline_miss": 0,
+            "errors": 0,
+        }
+        self._carry: Optional[_Request] = None  # overflow request -> next batch head
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._run, name=f"lgbtpu-serve-{name}", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- client
+    def submit(self, X: np.ndarray) -> "Future":
+        """Enqueue one request; the Future resolves to a ServeResponse."""
+        if not self._running:
+            raise RuntimeError(f"batcher '{self.name}' is stopped")
+        X = np.asarray(X)
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError(
+                f"expected a non-empty [rows, features] matrix, got shape "
+                f"{X.shape}"
+            )
+        fut: Future = Future()
+        self._queue.put(_Request(X, fut, time.perf_counter()))
+        return fut
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Drain the queue, dispatch what remains, stop the worker."""
+        if not self._running:
+            return
+        self._running = False
+        self._queue.put(_STOP)
+        self._thread.join(timeout=timeout)
+
+    # ------------------------------------------------------------- worker
+    def _run(self) -> None:
+        while True:
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                first = self._queue.get()
+            if isinstance(first, _Stop):
+                break
+            batch = [first]
+            rows = first.X.shape[0]
+            deadline = first.t_enqueue + self.deadline_s
+            reason = "deadline"
+            while rows < self.max_batch:
+                remaining = deadline - time.perf_counter()
+                try:
+                    if remaining <= 0:
+                        # deadline already passed (e.g. backlog while the
+                        # worker dispatched): don't wait, but DO drain
+                        # whatever is queued right now — under overload
+                        # this coalesces the backlog into full buckets
+                        # instead of thrashing one-request dispatches
+                        nxt = self._queue.get_nowait()
+                    else:
+                        nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if isinstance(nxt, _Stop):
+                    # flush what we have, then exit
+                    self._flush(batch, rows, "stop")
+                    batch = []
+                    break
+                if rows + nxt.X.shape[0] > self.max_batch:
+                    # keep whole requests in one batch (hot-swap atomicity);
+                    # carry it over as the next batch's head and flush full
+                    self._carry = nxt
+                    reason = "full"
+                    break
+                batch.append(nxt)
+                rows += nxt.X.shape[0]
+            else:
+                reason = "full"
+            if not batch:
+                break
+            self._flush(batch, rows, reason)
+        # resolve anything still queued after stop
+        if self._carry is not None:
+            carry, self._carry = self._carry, None
+            self._flush([carry], carry.X.shape[0], "stop")
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not isinstance(item, _Stop):
+                item.future.set_exception(
+                    RuntimeError(f"batcher '{self.name}' stopped")
+                )
+
+    def _flush(self, batch: List[_Request], rows: int, reason: str) -> None:
+        t_disp = time.perf_counter()
+        X = (
+            batch[0].X
+            if len(batch) == 1
+            else np.concatenate([r.X for r in batch], axis=0)
+        )
+        # slice into ladder plans: every dispatched matrix is a warm bucket
+        # (<= chunk coalesced batches produce exactly one plan)
+        plans: List[Tuple[np.ndarray, int]] = []
+        for lo in range(0, rows, self.chunk):
+            live = min(self.chunk, rows - lo)
+            bucket = bucket_rows(live, self.chunk)
+            mat = X[lo : lo + live]
+            if bucket > live:
+                padded = np.zeros((bucket, X.shape[1]), dtype=X.dtype)
+                padded[:live] = mat
+                mat = padded
+            plans.append((mat, live))
+        try:
+            preds, info = self._dispatch(plans)
+        except Exception as e:
+            with self._lock:
+                self.counters["errors"] += 1
+            for r in batch:
+                r.future.set_exception(e)
+            return
+        lo = 0
+        for r in batch:
+            n = r.X.shape[0]
+            r.future.set_result(ServeResponse(preds[lo : lo + n], info))
+            lo += n
+        t_done = time.perf_counter()
+        bucket_total = sum(m.shape[0] for m, _ in plans)
+        with self._lock:
+            for r in batch:
+                self._latencies_ms.append((t_done - r.t_enqueue) * 1e3)
+                missed = (
+                    t_disp - r.t_enqueue
+                    > self.deadline_s + self.miss_slack_s
+                )
+                self._miss_window.append(1 if missed else 0)
+                if missed:
+                    self.counters["deadline_miss"] += 1
+            self._fill_window.append(rows / max(1, bucket_total))
+            self.counters["requests"] += len(batch)
+            self.counters["rows"] += rows
+            self.counters["batches"] += 1
+            self.counters[
+                "full_flush" if reason == "full" else "deadline_flush"
+            ] += 1
+            window = self._stats_locked()
+        self._publish(window, rows, bucket_total, reason, len(batch))
+
+    # -------------------------------------------------------------- stats
+    def _stats_locked(self) -> Dict[str, Any]:
+        lat = sorted(self._latencies_ms)
+        misses = list(self._miss_window)
+        fills = list(self._fill_window)
+
+        def pct(p: float) -> float:
+            if not lat:
+                return 0.0
+            return lat[min(len(lat) - 1, int(p * (len(lat) - 1)))]
+
+        return {
+            "name": self.name,
+            "p50_ms": pct(0.50),
+            "p99_ms": pct(0.99),
+            "batch_fill": (sum(fills) / len(fills)) if fills else 0.0,
+            "deadline_miss_rate": (
+                sum(misses) / len(misses) if misses else 0.0
+            ),
+            "window_requests": len(lat),
+            **dict(self.counters),
+        }
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return self._stats_locked()
+
+    def _publish(
+        self,
+        window: Dict[str, Any],
+        rows: int,
+        bucket_total: int,
+        reason: str,
+        n_requests: int,
+    ) -> None:
+        ses = get_session()
+        if ses.enabled:
+            ses.update_gauges(
+                {
+                    "serve/p50_ms": window["p50_ms"],
+                    "serve/p99_ms": window["p99_ms"],
+                    "serve/batch_fill": window["batch_fill"],
+                    "serve/deadline_miss_rate": window["deadline_miss_rate"],
+                }
+            )
+            ses.inc("serve/requests_total", n_requests)
+            ses.inc("serve/rows_total", rows)
+            ses.inc("serve/batches_total")
+            ses.inc(f"serve/{reason}_flush_total")
+        get_flight().note_event(
+            {
+                "event": "serve_batch",
+                "batcher": self.name,
+                "requests": n_requests,
+                "rows": rows,
+                "bucket_rows": bucket_total,
+                "reason": reason,
+            }
+        )
+        if self._on_window is not None:
+            try:
+                self._on_window(
+                    {
+                        "event": "serve_window",
+                        "iter": window["batches"],
+                        "requests": window["window_requests"],
+                        "deadline_miss_rate": window["deadline_miss_rate"],
+                        "p99_ms": window["p99_ms"],
+                        "batcher": self.name,
+                    }
+                )
+            except Exception:
+                pass

@@ -1,0 +1,226 @@
+"""Metric-gated continual refresh: refit on traffic, promote via hot-swap.
+
+The loop accumulates observed traffic ``(X, y)`` pairs (the serving
+front end feeds them in as labels arrive), and on each cycle:
+
+1. snapshots the buffer (observation continues concurrently);
+2. builds a candidate from the LIVE model — ``Booster.refit`` (leaf-value
+   refresh keeping tree structure, the cheap path) or an ``init_model``
+   training continuation (``mode="extend"``, byte-exact per PR 7);
+3. gates promotion on a held-in metric over the accumulated batch: the
+   candidate must not score worse than the live model by more than
+   ``tolerance``;
+4. on promotion, writes the durable artifact via the atomic
+   ``save_model`` (tmp+fsync+rename — a kill mid-save never corrupts the
+   previous artifact), then cuts over through the registry's hot-swap so
+   in-flight requests keep their generation.
+
+Every promotion/rejection lands in the flight recorder's sticky deque and
+the ``serve/promotions_*`` counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs.flight import get_flight
+from ..obs.registry import get_session
+from .registry import ModelRegistry
+
+
+def _score(booster, X: np.ndarray, y: np.ndarray, metric: str) -> float:
+    """Lower-is-better score of ``booster`` on ``(X, y)``."""
+    preds = np.asarray(booster.predict(X))
+    y = np.asarray(y, dtype=np.float64)
+    if metric == "l2":
+        return float(np.mean((preds - y) ** 2))
+    if metric == "l1":
+        return float(np.mean(np.abs(preds - y)))
+    if metric == "binary_logloss":
+        p = np.clip(preds, 1e-15, 1 - 1e-15)
+        return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+    if metric == "binary_error":
+        return float(np.mean((preds > 0.5).astype(np.float64) != y))
+    raise ValueError(
+        f"unknown refresh metric '{metric}' "
+        "(expected l2, l1, binary_logloss, binary_error, or a callable)"
+    )
+
+
+class RefreshLoop:
+    """Accumulate traffic, refit the live model, promote when not worse."""
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        model_id: str,
+        *,
+        min_rows: int = 256,
+        decay_rate: float = 0.9,
+        metric: Any = "l2",
+        tolerance: float = 0.0,
+        save_path: str = "",
+        mode: str = "refit",
+        extend_rounds: int = 10,
+        interval_s: float = 0.0,
+    ) -> None:
+        if mode not in ("refit", "extend"):
+            raise ValueError("mode must be 'refit' or 'extend'")
+        self.registry = registry
+        self.model_id = model_id
+        self.min_rows = int(min_rows)
+        self.decay_rate = float(decay_rate)
+        self.metric = metric
+        self.tolerance = float(tolerance)
+        self.save_path = save_path
+        self.mode = mode
+        self.extend_rounds = int(extend_rounds)
+        self.interval_s = float(interval_s)
+        self._lock = threading.Lock()
+        self._buf_X: List[np.ndarray] = []
+        self._buf_y: List[np.ndarray] = []
+        self._buf_rows = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+        self.promotions = 0
+        self.rejections = 0
+        self.last_report: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------- traffic
+    def observe(self, X: np.ndarray, y: np.ndarray) -> None:
+        """Feed labeled traffic into the refresh buffer."""
+        X = np.asarray(X)
+        if X.ndim == 1:
+            X = X[None, :]
+        y = np.asarray(y).reshape(-1)
+        if X.shape[0] != y.shape[0]:
+            raise ValueError(
+                f"rows/labels mismatch: {X.shape[0]} vs {y.shape[0]}"
+            )
+        with self._lock:
+            self._buf_X.append(X)
+            self._buf_y.append(y)
+            self._buf_rows += X.shape[0]
+
+    def buffered_rows(self) -> int:
+        with self._lock:
+            return self._buf_rows
+
+    def _take_buffer(self) -> Tuple[Optional[np.ndarray], Optional[np.ndarray]]:
+        with self._lock:
+            if self._buf_rows < self.min_rows:
+                return None, None
+            X = np.concatenate(self._buf_X, axis=0)
+            y = np.concatenate(self._buf_y, axis=0)
+            self._buf_X, self._buf_y, self._buf_rows = [], [], 0
+            return X, y
+
+    # -------------------------------------------------------------- cycle
+    def run_once(self) -> Dict[str, Any]:
+        """One refresh cycle; returns a report dict (also kept as
+        ``last_report``)."""
+        X, y = self._take_buffer()
+        if X is None:
+            report = {
+                "promoted": False,
+                "reason": "insufficient_rows",
+                "buffered_rows": self.buffered_rows(),
+                "min_rows": self.min_rows,
+            }
+            self.last_report = report
+            return report
+        base = self.registry.booster(self.model_id)
+        if self.mode == "refit":
+            candidate = base.refit(X, y, decay_rate=self.decay_rate)
+        else:
+            from .. import engine
+            from ..dataset import Dataset
+
+            candidate = engine.train(
+                dict(base.params),
+                Dataset(X, y),
+                num_boost_round=self.extend_rounds,
+                init_model=base,
+            )
+        if callable(self.metric):
+            metric_name = getattr(self.metric, "__name__", "custom")
+            base_score = float(self.metric(base, X, y))
+            cand_score = float(self.metric(candidate, X, y))
+        else:
+            metric_name = self.metric
+            base_score = _score(base, X, y, self.metric)
+            cand_score = _score(candidate, X, y, self.metric)
+        promote = cand_score <= base_score + self.tolerance
+        report = {
+            "promoted": promote,
+            "mode": self.mode,
+            "rows": int(X.shape[0]),
+            "metric": metric_name,
+            "base_score": base_score,
+            "candidate_score": cand_score,
+            "tolerance": self.tolerance,
+        }
+        ses = get_session()
+        if promote:
+            if self.save_path:
+                candidate.save_model(self.save_path)
+                report["artifact"] = self.save_path
+            entry = self.registry.hot_swap(self.model_id, candidate)
+            report["version"] = entry.version
+            report["generation"] = entry.generation
+            self.promotions += 1
+            if ses.enabled:
+                ses.inc("serve/promotions_total")
+                ses.set_gauge(
+                    "serve/last_promotion_gain", base_score - cand_score
+                )
+            get_flight().note_sticky(
+                {"event": "serve_promotion", "model_id": self.model_id, **report}
+            )
+        else:
+            self.rejections += 1
+            if ses.enabled:
+                ses.inc("serve/promotions_rejected_total")
+            get_flight().note_sticky(
+                {
+                    "event": "serve_promotion_rejected",
+                    "model_id": self.model_id,
+                    **report,
+                }
+            )
+        self.last_report = report
+        return report
+
+    # --------------------------------------------------------- background
+    def start(self) -> None:
+        """Run :meth:`run_once` every ``interval_s`` seconds until stop."""
+        if self.interval_s <= 0:
+            raise ValueError("start() requires interval_s > 0")
+        if self._thread is not None:
+            return
+        self._stop_event.clear()
+
+        def loop():
+            while not self._stop_event.wait(self.interval_s):
+                try:
+                    self.run_once()
+                except Exception:
+                    # a failed cycle (e.g. injected swap fault) must not
+                    # kill the refresh thread; the registry already dumped
+                    if get_session().enabled:
+                        get_session().inc("serve/refresh_errors_total")
+
+        self._thread = threading.Thread(
+            target=loop, name=f"lgbtpu-refresh-{self.model_id}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=10.0)

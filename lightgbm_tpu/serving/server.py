@@ -1,0 +1,285 @@
+"""``lgb.serve()``: the request-facing wiring for the serving plane.
+
+``ServingServer`` composes the pieces built in this package — a
+``ModelRegistry`` of AOT-warmed models, one ``MicroBatcher`` per model,
+the serving health-watchdog rules, and (optionally) an HTTP/JSON front
+end colocated on the obs ``MetricsExporter`` endpoint:
+
+* ``GET  /metrics``  — Prometheus text, including ``lgbtpu_serve_*``
+* ``GET  /healthz``  — health doc with the ``serving`` block
+* ``GET  /models``   — registry listing (id, version, generation)
+* ``POST /predict``  — ``{"rows": [[...]], "model": "id"?}`` →
+  ``{"predictions": [...], "model_id", "version", "generation"}``
+
+Ports: ``serve_port > 0`` binds that port, ``-1`` binds an ephemeral one
+(reported via ``.url``), ``0`` disables HTTP — the in-process
+``predict``/``predict_async`` API works either way.
+
+``serve()`` enables the telemetry session if the caller has not already
+configured it: the observable serving plane is the point.
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from ..config import Config
+from ..obs.export import (
+    MetricsExporter,
+    get_serving_provider,
+    health_snapshot,
+    set_serving_provider,
+)
+from ..obs.health import HealthWatchdog
+from ..obs.registry import get_session
+from .batcher import MicroBatcher, ServeResponse
+from .refresh import RefreshLoop
+from .registry import ModelRegistry
+
+
+def _normalize_boosters(boosters) -> Dict[str, Any]:
+    """Accept one Booster, a list, or an {id: Booster} dict."""
+    if isinstance(boosters, dict):
+        if not boosters:
+            raise ValueError("serve() needs at least one model")
+        return dict(boosters)
+    if isinstance(boosters, (list, tuple)):
+        if not boosters:
+            raise ValueError("serve() needs at least one model")
+        return {f"model{i}": b for i, b in enumerate(boosters)}
+    return {"default": boosters}
+
+
+class ServingServer:
+    """Live serving plane over one or more Boosters."""
+
+    def __init__(
+        self,
+        boosters,
+        params: Optional[Dict[str, Any]] = None,
+        *,
+        deadline_ms: Optional[float] = None,
+        max_batch: Optional[int] = None,
+        memory_budget_mb: Optional[float] = None,
+        port: Optional[int] = None,
+        host: str = "127.0.0.1",
+        raw_score: bool = False,
+        watchdog: Optional[HealthWatchdog] = None,
+    ) -> None:
+        cfg = Config.from_params(params)
+        self.deadline_ms = float(
+            deadline_ms if deadline_ms is not None else cfg.serve_deadline_ms
+        )
+        self.max_batch = int(
+            max_batch if max_batch is not None else cfg.serve_max_batch
+        )
+        budget_mb = float(
+            memory_budget_mb
+            if memory_budget_mb is not None
+            else cfg.serve_memory_budget_mb
+        )
+        self._port_req = int(port if port is not None else cfg.serve_port)
+        self.raw_score = bool(raw_score)
+        ses = get_session()
+        if not ses.enabled:
+            ses.configure(enabled=True)
+        self.registry = ModelRegistry(
+            chunk=self.max_batch,
+            memory_budget_bytes=int(budget_mb * (1 << 20)),
+        )
+        self._watchdog = watchdog or HealthWatchdog()
+        self._batchers: Dict[str, MicroBatcher] = {}
+        models = _normalize_boosters(boosters)
+        self.default_model = next(iter(models))
+        for model_id, booster in models.items():
+            self.registry.load(model_id, booster)
+            self._batchers[model_id] = self._make_batcher(model_id)
+        # capture the bound method once (a fresh bound-method object per
+        # access would defeat the identity check in stop)
+        self._provider_fn = self.serving_snapshot
+        self._prev_provider = set_serving_provider(self._provider_fn)
+        self._exporter: Optional[MetricsExporter] = None
+        if self._port_req != 0:
+            self._exporter = MetricsExporter(
+                max(0, self._port_req),
+                host=host,
+                health_provider=self.health,
+                routes={
+                    ("POST", "/predict"): self._http_predict,
+                    ("GET", "/models"): self._http_models,
+                },
+            )
+            self._exporter.start()
+        self._stopped = False
+
+    def _make_batcher(self, model_id: str) -> MicroBatcher:
+        def dispatch(plans):
+            return self.registry.dispatch(
+                model_id, plans, raw_score=self.raw_score
+            )
+
+        return MicroBatcher(
+            dispatch,
+            deadline_ms=self.deadline_ms,
+            max_batch=self.max_batch,
+            name=model_id,
+            on_window=self._on_window,
+        )
+
+    def _on_window(self, event: Dict[str, Any]) -> None:
+        self._watchdog.observe_serving(event)
+
+    # ------------------------------------------------------------- predict
+    def _batcher(self, model_id: Optional[str]) -> MicroBatcher:
+        mid = model_id or self.default_model
+        batcher = self._batchers.get(mid)
+        if batcher is None:
+            raise KeyError(f"model '{mid}' is not being served")
+        return batcher
+
+    def predict_async(
+        self, X, model_id: Optional[str] = None
+    ) -> "Future[ServeResponse]":
+        """Enqueue one request; resolves to (values, model-identity info)."""
+        return self._batcher(model_id).submit(X)
+
+    def predict(
+        self,
+        X,
+        model_id: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> np.ndarray:
+        """Blocking micro-batched predict — bit-identical per row to
+        ``Booster.predict(X)`` on the serving model."""
+        return self.predict_async(X, model_id).result(timeout=timeout).values
+
+    # ------------------------------------------------------------ lifecycle
+    def swap(self, model_id: str, booster) -> Dict[str, Any]:
+        """Warm + atomically cut over ``model_id`` to a new Booster."""
+        entry = self.registry.hot_swap(model_id, booster)
+        return entry.describe()
+
+    def load(self, model_id: str, booster) -> Dict[str, Any]:
+        """Add a new co-resident model (own batcher, own warmed ladder)."""
+        entry = self.registry.load(model_id, booster)
+        self._batchers[model_id] = self._make_batcher(model_id)
+        return entry.describe()
+
+    def refresh_loop(self, model_id: Optional[str] = None, **kwargs) -> RefreshLoop:
+        """A RefreshLoop bound to this server's registry."""
+        return RefreshLoop(
+            self.registry, model_id or self.default_model, **kwargs
+        )
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        for batcher in self._batchers.values():
+            batcher.stop()
+        # restore the previous provider, but only if the registration is
+        # still ours — a newer server may have taken over since
+        if get_serving_provider() is self._provider_fn:
+            set_serving_provider(self._prev_provider)
+        if self._exporter is not None:
+            self._exporter.stop()
+        self.registry.close()
+
+    def __enter__(self) -> "ServingServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- observe
+    @property
+    def url(self) -> str:
+        return self._exporter.url if self._exporter is not None else ""
+
+    @property
+    def port(self) -> int:
+        return self._exporter.port if self._exporter is not None else 0
+
+    def serving_snapshot(self) -> Dict[str, Any]:
+        """The health document's ``serving`` block."""
+        return {
+            "models": self.registry.models(),
+            "generation": self.registry.generation(),
+            "resident_bytes": self.registry.resident_bytes(),
+            "deadline_ms": self.deadline_ms,
+            "max_batch": self.max_batch,
+            "batchers": {
+                mid: b.stats() for mid, b in self._batchers.items()
+            },
+        }
+
+    def health(self) -> Dict[str, Any]:
+        return health_snapshot(self._watchdog)
+
+    def stats(self, model_id: Optional[str] = None) -> Dict[str, Any]:
+        return self._batcher(model_id).stats()
+
+    # ---------------------------------------------------------------- http
+    def _http_predict(self, body: bytes):
+        try:
+            doc = json.loads(body.decode("utf-8"))
+            rows = np.asarray(doc["rows"], dtype=np.float64)
+        except Exception as e:
+            return (
+                400,
+                "application/json",
+                json.dumps({"error": f"bad request: {e}"}).encode("utf-8"),
+            )
+        try:
+            resp = self.predict_async(rows, doc.get("model")).result(
+                timeout=30.0
+            )
+        except KeyError as e:
+            return (
+                404,
+                "application/json",
+                json.dumps({"error": str(e)}).encode("utf-8"),
+            )
+        out = {
+            "predictions": np.asarray(resp.values).tolist(),
+            **resp.info,
+        }
+        return (
+            200,
+            "application/json",
+            json.dumps(out).encode("utf-8"),
+        )
+
+    def _http_models(self, body: bytes):
+        return (
+            200,
+            "application/json",
+            json.dumps(
+                {
+                    "models": self.registry.models(),
+                    "generation": self.registry.generation(),
+                }
+            ).encode("utf-8"),
+        )
+
+
+def serve(
+    boosters: Union[Any, List[Any], Dict[str, Any]],
+    params: Optional[Dict[str, Any]] = None,
+    **kwargs,
+) -> ServingServer:
+    """Start the async micro-batching serving plane over ``boosters``.
+
+    ``boosters`` is one Booster, a list, or an ``{id: Booster}`` dict.
+    Knobs come from ``params`` (``serve_deadline_ms``, ``serve_max_batch``,
+    ``serve_memory_budget_mb``, ``serve_port``) or keyword overrides
+    (``deadline_ms``, ``max_batch``, ``memory_budget_mb``, ``port``).
+    Every model's bucket ladder is AOT-warmed before the call returns, so
+    the first request pays no compile.  Use as a context manager or call
+    ``.stop()``.
+    """
+    return ServingServer(boosters, params, **kwargs)

@@ -129,6 +129,29 @@ def test_global_rollup_single_process_folds_gauges():
         ses.reset()
 
 
+def test_global_rollup_is_idempotent_across_repeated_fits():
+    # the session is process-global: a long-lived process that trains
+    # repeatedly (serving refresh loops, sweeps) rolls up many times.
+    # Derived agg/* gauges must not be re-aggregated into agg/agg/* —
+    # that blowup triples the gauge count per fit.
+    from lightgbm_tpu.obs.aggregate import global_rollup
+
+    ses = get_session().configure(enabled=True)
+    ses.reset()
+    try:
+        ses.set_gauge("bagging_rows", 123.0)
+        global_rollup(ses)
+        n_after_first = len(ses.gauges)
+        for _ in range(3):
+            global_rollup(ses)
+        assert len(ses.gauges) == n_after_first, sorted(ses.gauges)
+        assert not any(name.startswith("agg/agg/") for name in ses.gauges)
+        assert ses.gauges["agg/bagging_rows/mean"] == 123.0
+    finally:
+        ses.configure(enabled=False)
+        ses.reset()
+
+
 # --------------------------------------- measured collectives (8-device mesh)
 def test_measured_psum_bytes_match_analytic_8dev(cpu_mesh_devices):
     """tree_learner=data dryrun on the 8-virtual-device mesh: the timed-psum

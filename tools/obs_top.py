@@ -241,6 +241,41 @@ def render_frame(state: TopState, width: int = 78, color: bool = True) -> str:
     if gauge_rows:
         lines.append(c(DIM, "  gauges"))
         lines.extend(gauge_rows)
+    serving = (state.health or {}).get("serving")
+    if serving:
+        gen = serving.get("generation", 0)
+        lines.append(
+            c(DIM, "  serving")
+            + f"  gen {gen}"
+            + f"  models {len(serving.get('models') or [])}"
+            + f"  resident {_fmt_bytes(float(serving.get('resident_bytes', 0)))}"
+        )
+        batchers = serving.get("batchers") or {}
+        if batchers:
+            lines.append(
+                c(
+                    DIM,
+                    "    model             p50ms    p99ms   fill  miss%     reqs",
+                )
+            )
+            for mid in sorted(batchers):
+                b = batchers[mid]
+                lines.append(
+                    f"    {mid:<16}{b.get('p50_ms', 0.0):>8.2f}"
+                    f"{b.get('p99_ms', 0.0):>9.2f}"
+                    f"{b.get('batch_fill', 0.0):>7.2f}"
+                    f"{100.0 * b.get('deadline_miss_rate', 0.0):>6.1f}"
+                    f"{int(b.get('requests', 0)):>9}"
+                )
+    elif state.gauge("serve/p50_ms") is not None:
+        # metrics-only source: flat serve gauges, no per-model breakdown
+        lines.append(
+            c(DIM, "  serving")
+            + f"  p50 {state.gauge('serve/p50_ms') or 0.0:.2f}ms"
+            + f"  p99 {state.gauge('serve/p99_ms') or 0.0:.2f}ms"
+            + f"  fill {state.gauge('serve/batch_fill') or 0.0:.2f}"
+            + f"  miss {100.0 * (state.gauge('serve/deadline_miss_rate') or 0.0):.1f}%"
+        )
     lines.append(
         c(DIM, f"  alerts (last {len(state.alerts)})")
         if state.alerts

@@ -128,6 +128,47 @@ for tool_args in ([ "tools/telemetry_summary.py", "--flight"] + dumps,
 print("live-obs smoke: flight dumps + offline tools OK")
 PYEOF
 
+# serving smoke: lgb.serve() over a 3-tree model must coalesce concurrent
+# mixed-size requests bit-identically to Booster.predict, publish
+# lgbtpu_serve_* on /metrics and the serving block on /healthz, survive
+# one hot-swap with full parity on the new version, and tear down clean.
+echo "=== serving smoke (lgb.serve: mixed-size parity + /metrics + hot-swap) ==="
+python - <<'PYEOF' || rc=$?
+import json
+import urllib.request
+
+import numpy as np
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(500, 6))
+params = {"objective": "regression", "num_leaves": 7, "verbosity": -1}
+b1 = lgb.train(params, lgb.Dataset(X, X[:, 0] + 0.1 * X[:, 1]), 3)
+b2 = lgb.train(params, lgb.Dataset(X, X[:, 1] - 0.3 * X[:, 2]), 3)
+queries = {n: rng.normal(size=(n, 6)) for n in (1, 7, 64, 300, 700)}
+r1 = {n: b1.predict(q) for n, q in queries.items()}
+r2 = {n: b2.predict(q) for n, q in queries.items()}
+
+server = lgb.serve(b1, deadline_ms=3.0, max_batch=512, port=-1)
+try:
+    futs = [(n, server.predict_async(q)) for n, q in list(queries.items()) * 3]
+    for n, f in futs:
+        assert np.array_equal(f.result(timeout=30.0).values, r1[n]), n
+    text = urllib.request.urlopen(server.url + "/metrics", timeout=5).read().decode()
+    serve_lines = [l for l in text.splitlines() if l.startswith("lgbtpu_serve_")]
+    assert serve_lines, "no lgbtpu_serve_* series on /metrics"
+    hz = json.loads(urllib.request.urlopen(server.url + "/healthz", timeout=5).read())
+    assert hz["serving"]["models"][0]["model_id"] == "default"
+    info = server.swap("default", b2)
+    assert info["version"] == 2
+    for n, q in queries.items():
+        assert np.array_equal(server.predict(q, timeout=30.0), r2[n]), n
+    print("serving smoke: parity + metrics + hot-swap OK "
+          f"({len(serve_lines)} serve series)")
+finally:
+    server.stop()
+PYEOF
+
 # perf-contract gate: collect the deterministic telemetry slice (retraces
 # by label, analytic+measured collective bytes, executable FLOPs/temp HBM)
 # and diff it against the committed contract.  HARD gate — any drift in a
