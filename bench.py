@@ -643,7 +643,154 @@ def serve_sweep() -> dict:
     return out
 
 
+def _tensor_flop_model(n_rows: int, n_trees: int, depth: int, f: int) -> dict:
+    """Analytic MAC counts for the three tensor-forest contractions.
+
+    The matmul engine trades the walker's D gather rounds per tree for
+    dense int8/f32 contractions sized for a systolic MXU: per row it is
+    deliberately FLOP-inflated (every node of every tree is evaluated),
+    which is the right trade exactly when the hardware's matmul
+    throughput dwarfs its gather throughput.  These counts feed the
+    BENCH_NOTES roofline argument."""
+    p_tree = (1 << depth) - 1
+    lp = 1 << depth
+    p = n_trees * p_tree
+    sel_macs = 2 * n_rows * f * p        # hi/lo digit matmuls, int8 -> i32
+    route_macs = n_rows * n_trees * p_tree * lp  # path-sign scoring, int8
+    leaf_macs = n_rows * n_trees * lp    # one-hot . leaf values, f32
+    return {
+        "select_int8_macs": int(sel_macs),
+        "route_int8_macs": int(route_macs),
+        "leaf_f32_macs": int(leaf_macs),
+        "total_macs": int(sel_macs + route_macs + leaf_macs),
+        "macs_per_row": int((sel_macs + route_macs + leaf_macs) // n_rows),
+        # the walker's per-row work for comparison: D node visits per tree,
+        # each a handful of gathers + compares (no dense math)
+        "walker_node_visits_per_row": int(n_trees * depth),
+    }
+
+
+def pred_engine_sweep() -> dict:
+    """Walker vs matmul prediction-engine A/B (``--pred-engine-sweep``).
+
+    Grid: rows x depth x trees (env-tunable, defaults 64k/1M rows,
+    depth {4,6}, trees {50,200,500}).  One model per depth is trained
+    small and its trees replicated to each target count (same trick as
+    the headline predict bench), so every cell predicts through the
+    exact streaming path a user would hit.  Each cell runs both engines
+    on identical inputs: warmup predict (ladder compiles) then one timed
+    predict, recording rows/sec, the phase breakdown (bin / device
+    contract-or-walk / host), recompiles in the timed run, and byte
+    parity between the two engines' outputs.  The analytic MXU FLOP
+    model for each shape rides along for the BENCH_NOTES roofline
+    analysis — on CPU fallback the matmul engine's FLOP inflation is
+    expected to show as a slowdown; the model quantifies the MXU
+    throughput at which the trade inverts."""
+    import lightgbm_tpu as lgb
+
+    row_grid = [
+        int(v)
+        for v in os.environ.get(
+            "BENCH_PRED_ROWS", "64000,1000000"
+        ).split(",")
+        if v.strip()
+    ]
+    tree_grid = [
+        int(v)
+        for v in os.environ.get("BENCH_PRED_TREES", "50,200,500").split(",")
+        if v.strip()
+    ]
+    depth_grid = [
+        int(v)
+        for v in os.environ.get("BENCH_PRED_DEPTHS", "4,6").split(",")
+        if v.strip()
+    ]
+    train_rows = int(os.environ.get("BENCH_PRED_TRAIN_ROWS", 100_000))
+    n_features = 28
+    max_rows = max(row_grid)
+    X, y = _make_data(max(max_rows, train_rows), n_features)
+
+    out = {
+        "train_rows": train_rows,
+        "n_features": n_features,
+        "cells": [],
+    }
+    for depth in depth_grid:
+        params = dict(
+            _PARAMS,
+            num_leaves=1 << depth,
+            max_depth=depth,
+            max_bin=255,
+        )
+        base = lgb.train(
+            params,
+            lgb.Dataset(X[:train_rows], y[:train_rows], params=params),
+            25,
+        )
+        orig_models = list(base.models_)
+        orig_recs = list(base._bin_records)
+        for n_trees in tree_grid:
+            while len(base.models_) < n_trees:
+                base.models_.extend(orig_models)
+                base._bin_records.extend(orig_recs)
+            del base.models_[n_trees:]
+            del base._bin_records[n_trees:]
+            base._bump_model_version()
+            for n_rows in row_grid:
+                Xp = X[:n_rows]
+                cell = {
+                    "depth": depth,
+                    "trees": n_trees,
+                    "rows": n_rows,
+                    "flop_model": _tensor_flop_model(
+                        n_rows, n_trees, depth, n_features
+                    ),
+                }
+                preds = {}
+                for eng in ("walk", "matmul"):
+                    base.predict(Xp, pred_engine=eng)  # ladder warmup
+                    c0 = lgb.compile_count()
+                    t0 = time.perf_counter()
+                    preds[eng] = np.asarray(
+                        base.predict(Xp, pred_engine=eng)
+                    )
+                    dt = time.perf_counter() - t0
+                    stats = dict(base.last_predict_stats)
+                    cell[eng] = {
+                        "rows_per_sec": round(n_rows / dt),
+                        "wall_ms": round(dt * 1e3, 1),
+                        "engine_resolved": stats.get("engine", "walk"),
+                        "recompiles_timed": lgb.compile_count() - c0,
+                        "phases_ms": {
+                            "bin": round(float(stats.get("bin_ms", 0.0)), 1),
+                            "device": round(
+                                float(stats.get("walk_ms", 0.0)), 1
+                            ),
+                            "host": round(float(stats.get("host_ms", 0.0)), 1),
+                            "transfer": round(
+                                float(stats.get("transfer_ms", 0.0)), 1
+                            ),
+                        },
+                    }
+                cell["byte_identical"] = bool(
+                    preds["walk"].tobytes() == preds["matmul"].tobytes()
+                )
+                cell["matmul_speedup"] = round(
+                    cell["matmul"]["rows_per_sec"]
+                    / max(1, cell["walk"]["rows_per_sec"]),
+                    3,
+                )
+                out["cells"].append(cell)
+    return out
+
+
 def main() -> None:
+    if "--pred-engine-sweep" in sys.argv:
+        # standalone, CPU-pinned like --serve-sweep: cross-engine parity
+        # and phase shape, plus the analytic MXU model for the roofline
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps({"pred_engine_sweep": pred_engine_sweep()}))
+        return
     if "--serve-sweep" in sys.argv:
         # standalone, CPU-pinned like --mesh-sweep: the sweep measures the
         # batching/latency trade, not kernel speed

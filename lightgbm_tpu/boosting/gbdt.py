@@ -2403,7 +2403,18 @@ class Booster:
         ) and self._early_stop_type(k) != "none"
         knobs = self._predict_knobs(kwargs)
         if use_bins:
-            if not pred_leaf and not es_requested:
+            # resolve the prediction engine up front: a matmul/auto request
+            # that resolves to the tensor engine skips the Pallas walk fast
+            # path (the contractions ARE the MXU path); a walker resolution
+            # keeps the existing routing byte-for-byte
+            resolved_engine, _ = self._stream_engine().resolve_engine(
+                knobs["engine"], "bin", t0, t1
+            )
+            if (
+                resolved_engine == "walk"
+                and not pred_leaf
+                and not es_requested
+            ):
                 # fast path: Pallas forest-walk kernel (the fork's
                 # tree_avx512 batch predictor, TPU-shaped) with device-side
                 # binning — falls back to the streaming XLA engine off-TPU
@@ -2468,6 +2479,9 @@ class Booster:
             "shard_devices": int(
                 kwargs.get("pred_shard_devices", cfg.pred_shard_devices)
             ),
+            "engine": str(
+                kwargs.get("pred_engine", getattr(cfg, "pred_engine", "walk"))
+            ),
         }
 
     def _stream_engine(self) -> StreamingPredictor:
@@ -2501,16 +2515,21 @@ class Booster:
         num_iteration: Optional[int] = None,
         kinds=("value",),
         chunk: Optional[int] = None,
+        pred_engine: Optional[str] = None,
     ) -> int:
         """AOT-lower and cache the streaming engine's bucket-ladder
         executables so the first predict() pays no compile (pred_aot_compile
         runs this at Booster load).  ``chunk`` overrides the config's
         ``pred_chunk_rows`` ladder top (the serving registry warms at its
-        ``serve_max_batch``).  Returns the number of executables compiled."""
+        ``serve_max_batch``); ``pred_engine`` overrides the config's engine
+        (the registry warms at the serve-level engine).  Returns the number
+        of executables compiled."""
         t0, t1 = self._tree_range(start_iteration, num_iteration)
         if t1 <= t0 or not self.models_:
             return 0
-        knobs = self._predict_knobs({})
+        knobs = self._predict_knobs(
+            {} if pred_engine is None else {"pred_engine": pred_engine}
+        )
         if chunk is None:
             chunk = knobs["chunk"]
         return self._stream_engine().warmup(
@@ -2520,6 +2539,7 @@ class Booster:
             chunk=max(256, int(chunk)),
             shard_devices=knobs["shard_devices"],
             kinds=kinds,
+            engine=knobs["engine"],
         )
 
     def _predict_space(self, t0: int, t1: int) -> str:

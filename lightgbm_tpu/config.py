@@ -498,6 +498,14 @@ class Config:
     pred_num_buffers: int = 2
     pred_shard_devices: int = 1
     pred_aot_compile: bool = False
+    # prediction engine: 'walk' = level-synchronous gather walker;
+    # 'matmul' = tensor-forest contractions (ops/tensor_forest.py) for
+    # forests in the serving sweet spot (<= 64 leaves, depth <= 8, numeric
+    # splits inside the packed-bin envelope), falling back to the walker
+    # with a telemetry event when ineligible; 'auto' = matmul only when
+    # eligible AND the compile-time parity probe matches the walker
+    # byte-for-byte
+    pred_engine: str = "walk"
 
     # Serving (lightgbm_tpu/serving/): lgb.serve() micro-batcher + registry.
     # serve_deadline_ms bounds how long a request may wait for coalescing
@@ -634,6 +642,10 @@ class Config:
             raise ValueError("leaf_batch must be >= 1")
         if self.grow_fused not in ("auto", "on", "off"):
             raise ValueError("grow_fused must be one of 'auto', 'on', 'off'")
+        if self.pred_engine not in ("walk", "matmul", "auto"):
+            raise ValueError(
+                "pred_engine must be one of 'walk', 'matmul', 'auto'"
+            )
         if self.hist_acc not in ("auto", "int8", "bf16"):
             raise ValueError("hist_acc must be one of 'auto', 'int8', 'bf16'")
         if self.mesh_layout not in ("auto", "data", "feature", "hybrid"):

@@ -470,6 +470,7 @@ def build_entry_specs() -> List[EntrySpec]:
 
     from ..ops import grower as grower_mod
     from ..ops import quantize as quantize_mod
+    from ..ops import tensor_forest as tf_mod
     from ..ops.pallas import histogram as ph_mod
     from ..ops.pallas import seg as seg_mod
     from .. import predict as predict_mod
@@ -712,6 +713,55 @@ def build_entry_specs() -> List[EntrySpec]:
                 root_modules=("predict.py",),
             )
         )
+
+    # ---- tensor-forest (pred_engine=matmul) contraction entries: the
+    # direct compiler impls plus the streaming variant pulled out of the
+    # engine's own dispatch table (_STREAM_IMPLS), so the audited callable
+    # is exactly what the bucket ladder AOT-compiles.  Geometry mirrors the
+    # eligibility sweet spot at gate scale: depth 3, 8 trees.
+    TF_DEPTH = 3
+    TF_PTREE = (1 << TF_DEPTH) - 1
+    TF_LP = 1 << TF_DEPTH
+
+    def build_tensor(fn_getter):
+        def build():
+            forest = tf_mod.TensorForest(
+                sel=_sds((F, T * TF_PTREE), jnp.int8),
+                thr=_sds((T * TF_PTREE,), i32),
+                nanb=_sds((T * TF_PTREE,), i32),
+                dleft=_sds((T * TF_PTREE,), jnp.bool_),
+                routes=_sds((TF_PTREE, TF_LP), jnp.int8),
+                leaf_val=_sds((T, TF_LP), f32),
+                leaf_idx=_sds((T, TF_LP), i32),
+            )
+            args = (forest, _sds((N, F), i32))
+            return fn_getter(), args, {}
+
+        return build
+
+    for kind in ("pertree", "leaves"):
+        specs.append(
+            EntrySpec(
+                name=f"predict/tensor_{kind}",
+                build=build_tensor(
+                    lambda k=kind: getattr(tf_mod, f"_tensor_bins_{k}_impl")
+                ),
+                anchor=_anchor(tf_mod, f"_tensor_bins_{kind}_impl"),
+                x64_strict=True,
+                root_modules=("ops/tensor_forest.py",),
+            )
+        )
+    specs.append(
+        EntrySpec(
+            name="predict/tensor_stream",
+            build=build_tensor(
+                lambda: predict_mod._STREAM_IMPLS[("tensor", "value")]
+            ),
+            anchor=_anchor(predict_mod, "_STREAM_IMPLS"),
+            x64_strict=True,
+            root_modules=("predict.py", "ops/tensor_forest.py"),
+        )
+    )
 
     def build_add_tree():
         fn = predict_mod.add_tree_to_score

@@ -1,0 +1,376 @@
+"""Tree-to-MXU compiled inference: forests as dense contractions.
+
+The streaming walker (predict.py) advances a [rows, trees] node-index
+matrix one level per ``lax.while_loop`` step — two gathers and a compare
+per level.  TPUs punish exactly those data-dependent gathers while the
+MXUs idle; Hummingbird (Nakandala et al., OSDI 2020) showed small/medium
+trees compile into GEMM pipelines that beat pointer chasing on tensor
+hardware.  This module is that compiler for the bin-space forest: each
+tree is padded to the forest's perfect depth D and the whole forest
+evaluates as three contractions with ZERO data-dependent control flow:
+
+1. **feature select** (int8 MXU): ``X_sel = bins @ S`` where ``S`` is the
+   {0,1} one-hot of each perfect node's split feature.  Exactness uses
+   the 2-digit base-128 trick from the histogram-v2 int8 accumulator
+   (ops/quantize.py / ops/pallas/seg.py): ``bins`` splits into hi/lo
+   int8 digits, each contracts with ``preferred_element_type=int32``,
+   and ``X_sel = 128*hi@S + lo@S`` recombines exactly in i32 — every
+   per-node operand is the exact integer bin, not an approximation.
+2. **path composition** (int8 MXU): per-node compare bits become signs
+   ``sgn = 2*go_left - 1`` in {-1,+1}; ``routes`` holds each perfect
+   leaf's ancestor directions in {-1,0,+1} (shared across trees — the
+   perfect topology only depends on D); ``score = sgn @ routes`` in i32
+   hits D exactly for the one leaf consistent with all D decisions.
+3. **leaf select** (f32): ``out = onehot(score == D) @ leaf_values``.
+   This one stays f32 on purpose: products are exactly ±0.0 or the
+   stored leaf value, so the result is byte-identical to the walker's
+   gather.  A bf16 contraction here would round leaf values and break
+   the byte-parity contract (``Precision.HIGHEST`` pins true f32 on
+   MXU — DEFAULT would run bf16 passes).
+
+The per-node decision is the walker's, verbatim, evaluated for ALL
+perfect nodes at once::
+
+    go_left = (x <= thr) | (default_left & (nan_bin >= 0) & (x == nan_bin))
+
+Padding rules (belt and braces): filler internal nodes always route
+left (``thr`` above any recombinable bin value), and every real leaf's
+value/index is replicated across ALL perfect leaves of its subtree —
+so the selected perfect leaf carries the right answer even though only
+the leftmost one is ever selected.
+
+Eligibility mirrors ``packed_reject_reason``: the serving sweet spot is
+<= 64 leaves, actual depth <= 8 (the perfect layout costs 2^D), a few
+hundred trees, numeric-only splits with thresholds inside the packed-bin
+envelope.  Anything else stays on the walker (predict.py resolves the
+engine and emits the fallback telemetry).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+# eligibility envelope: the perfect layout costs 2^D slots per tree and
+# the select matrix F x T*(2^D - 1) int8 bytes — these caps bound it at
+# 512 * 512 * 255 ≈ 67 MB worst case while covering the serving sweet
+# spot the issue names (<= 64 leaves, <= a few hundred trees)
+TF_MAX_DEPTH = 8
+TF_MAX_LEAVES = 64
+TF_MAX_TREES = 512
+TF_MAX_F = 512  # mirrors predict._PACK_F
+TF_MAX_BIN = 512  # thresholds/NaN bins inside the packed envelope (_PACK_THR)
+_DIGIT_ENVELOPE = 1 << 14  # 2 base-128 int8 digits recombine exactly below this
+_ALWAYS_LEFT = (1 << 20) - 1  # filler threshold: above any recombined bin
+
+
+class TensorForest(NamedTuple):
+    """Forest compiled to perfect-depth-D tensor form.
+
+    P = T * (2^D - 1) perfect internal nodes (tree-major), Lp = 2^D
+    perfect leaves per tree.  ``routes`` is shared across trees."""
+
+    sel: jnp.ndarray  # [F, P] int8 one-hot of each node's split feature
+    thr: jnp.ndarray  # [P] i32 split bin (filler: _ALWAYS_LEFT)
+    nanb: jnp.ndarray  # [P] i32 NaN bin of the node's feature (-1 = none)
+    dleft: jnp.ndarray  # [P] bool default-left
+    routes: jnp.ndarray  # [2^D - 1, 2^D] int8 ancestor directions {-1,0,+1}
+    leaf_val: jnp.ndarray  # [T, 2^D] f32, replicated over padded subtrees
+    leaf_idx: jnp.ndarray  # [T, 2^D] i32 original leaf index, replicated
+
+
+def _record_depth(record: dict) -> int:
+    """Actual depth (internal decisions on the deepest root->leaf path)."""
+    lc = np.asarray(record["left_child"], np.int64)
+    rc = np.asarray(record["right_child"], np.int64)
+    if lc.size == 0:
+        return 0
+    depth = 0
+    stack = [(0, 0)]
+    while stack:
+        node, lvl = stack.pop()
+        if node < 0:
+            depth = max(depth, lvl)
+        else:
+            stack.append((int(lc[node]), lvl + 1))
+            stack.append((int(rc[node]), lvl + 1))
+    return depth
+
+
+def tensor_reject_reason(
+    records: List[Optional[dict]],
+    nan_bins: np.ndarray,
+    num_features: int,
+    max_bin: Optional[int] = None,
+) -> Optional[str]:
+    """None when the tensor engine covers this forest exactly, else why
+    not (the `packed_reject_reason` idiom: the caller falls back to the
+    walker and surfaces the reason through telemetry)."""
+    if not records:
+        return "no trees in range"
+    if len(records) > TF_MAX_TREES:
+        return f"{len(records)} trees > {TF_MAX_TREES}"
+    if num_features > TF_MAX_F:
+        return f"{num_features} bin columns > {TF_MAX_F}"
+    if max_bin is not None and int(max_bin) > _DIGIT_ENVELOPE:
+        return (
+            f"bin width {int(max_bin)} exceeds the 2-digit int8 envelope "
+            f"({_DIGIT_ENVELOPE})"
+        )
+    nan_bins = np.asarray(nan_bins)
+    if nan_bins.size and int(np.max(nan_bins)) >= TF_MAX_BIN:
+        return f"a NaN bin >= {TF_MAX_BIN}"
+    for r in records:
+        if r is None or r.get("no_bin_form"):
+            return "a tree has no bin-space record"
+        sic = r.get("split_is_cat")
+        if sic is not None and np.any(np.asarray(sic)):
+            return "categorical splits"
+        if len(r["leaf_value"]) > TF_MAX_LEAVES:
+            return f"{len(r['leaf_value'])} leaves > {TF_MAX_LEAVES}"
+        sf = r["split_feature"]
+        if len(sf) and int(np.max(np.asarray(r["split_bin"]))) >= TF_MAX_BIN:
+            return f"a split threshold bin >= {TF_MAX_BIN}"
+        d = _record_depth(r)
+        if d > TF_MAX_DEPTH:
+            return f"tree depth {d} > {TF_MAX_DEPTH}"
+    return None
+
+
+def _perfect_routes(depth: int) -> np.ndarray:
+    """[2^D - 1, 2^D] ancestor-direction matrix: routes[q, L] = +1 when
+    leaf L lies in heap node q's left subtree, -1 right, 0 not an
+    ancestor.  sgn @ routes == D selects the unique consistent leaf."""
+    ptree = (1 << depth) - 1
+    lp = 1 << depth
+    routes = np.zeros((ptree, lp), np.int8)
+    for leaf in range(lp):
+        for lvl in range(depth):
+            q = (1 << lvl) - 1 + (leaf >> (depth - lvl))
+            went_right = (leaf >> (depth - 1 - lvl)) & 1
+            routes[q, leaf] = -1 if went_right else 1
+    return routes
+
+
+def build_tensor_forest(
+    records: List[dict], nan_bins: np.ndarray, num_features: int
+) -> TensorForest:
+    """Compile bin-space records into tensor form; the caller checked
+    ``tensor_reject_reason``.  Host-side numpy only."""
+    t = len(records)
+    depth = max(1, max(_record_depth(r) for r in records))
+    ptree = (1 << depth) - 1
+    lp = 1 << depth
+    p_total = t * ptree
+    nanb_by_f = np.asarray(nan_bins, np.int64)
+
+    feat = np.zeros(p_total, np.int64)
+    thr = np.full(p_total, _ALWAYS_LEFT, np.int32)
+    nanb = np.full(p_total, -1, np.int32)
+    dleft = np.zeros(p_total, bool)
+    leaf_val = np.zeros((t, lp), np.float32)
+    leaf_idx = np.zeros((t, lp), np.int32)
+
+    for i, r in enumerate(records):
+        lv = np.asarray(r["leaf_value"], np.float32)
+        sf = np.asarray(r["split_feature"], np.int64)
+        if len(sf) == 0:
+            # single-leaf tree: every perfect leaf carries leaf 0
+            leaf_val[i, :] = lv[0] if lv.size else 0.0
+            continue
+        sb = np.asarray(r["split_bin"], np.int64)
+        dl = np.asarray(r["default_left"], bool)
+        lc = np.asarray(r["left_child"], np.int64)
+        rc = np.asarray(r["right_child"], np.int64)
+        stack = [(0, 0, 0)]  # (node-or-~leaf, heap slot, level)
+        while stack:
+            node, q, lvl = stack.pop()
+            if node < 0:
+                leaf = ~node
+                lo = (q - ((1 << lvl) - 1)) << (depth - lvl)
+                hi = lo + (1 << (depth - lvl))
+                leaf_val[i, lo:hi] = lv[leaf]
+                leaf_idx[i, lo:hi] = leaf
+                continue
+            p = i * ptree + q
+            f = int(sf[node])
+            feat[p] = f
+            thr[p] = sb[node]
+            dleft[p] = dl[node]
+            nanb[p] = nanb_by_f[f] if f < nanb_by_f.size else -1
+            stack.append((int(lc[node]), 2 * q + 1, lvl + 1))
+            stack.append((int(rc[node]), 2 * q + 2, lvl + 1))
+
+    sel = np.zeros((num_features, p_total), np.int8)
+    sel[feat, np.arange(p_total)] = 1
+    return TensorForest(
+        sel=jnp.asarray(sel),
+        thr=jnp.asarray(thr),
+        nanb=jnp.asarray(nanb),
+        dleft=jnp.asarray(dleft),
+        routes=jnp.asarray(_perfect_routes(depth)),
+        leaf_val=jnp.asarray(leaf_val),
+        leaf_idx=jnp.asarray(leaf_idx),
+    )
+
+
+def _forest_depth(forest: TensorForest) -> int:
+    """Static D back out of the routes shape (2^D - 1 perfect nodes)."""
+    return int(forest.routes.shape[0] + 1).bit_length() - 1
+
+
+def _tensor_scores(forest: TensorForest, bins: jnp.ndarray) -> jnp.ndarray:
+    """[N, T, 2^D] i32 path scores; == D selects the reached leaf."""
+    # contraction 1: exact feature select via 2-digit base-128 int8 MXU
+    # dots (the quantize.py digit-sum trick) recombined in i32
+    hi = (bins >> 7).astype(jnp.int8)
+    lo = (bins & 127).astype(jnp.int8)
+    dn = (((1,), (0,)), ((), ()))
+    xsel = (
+        lax.dot_general(hi, forest.sel, dn, preferred_element_type=jnp.int32)
+        * 128
+        + lax.dot_general(lo, forest.sel, dn, preferred_element_type=jnp.int32)
+    )  # [N, P] the exact bin value at each perfect node's feature
+    gl = (xsel <= forest.thr[None, :]) | (
+        forest.dleft[None, :]
+        & (forest.nanb[None, :] >= 0)
+        & (xsel == forest.nanb[None, :])
+    )
+    sgn = jnp.where(gl, jnp.int8(1), jnp.int8(-1))
+    # contraction 2: per-leaf agreement count with the ancestor directions
+    n = bins.shape[0]
+    t, lp = forest.leaf_val.shape
+    ptree = forest.routes.shape[0]
+    score = lax.dot_general(
+        sgn.reshape(n * t, ptree),
+        forest.routes,
+        dn,
+        preferred_element_type=jnp.int32,
+    )
+    return score.reshape(n, t, lp)
+
+
+def _tensor_bins_pertree_impl(
+    forest: TensorForest, bins: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-tree leaf outputs [N, T] f32 — byte-identical to the walker's
+    gather (engine-facing order: tables first, data chunk last)."""
+    score = _tensor_scores(forest, bins)
+    onehot = (score == _forest_depth(forest)).astype(jnp.float32)
+    # contraction 3: one-hot x leaf values.  HIGHEST pins true f32 on the
+    # MXU; every product is exactly ±0.0 or the stored leaf value, so the
+    # sum is exact regardless of order
+    return jnp.einsum(
+        "ntl,tl->nt", onehot, forest.leaf_val,
+        precision=lax.Precision.HIGHEST,
+    )
+
+
+def _tensor_bins_leaves_impl(
+    forest: TensorForest, bins: jnp.ndarray
+) -> jnp.ndarray:
+    """Leaf index per (row, tree) [N, T] i32 (masked sum, not a gather)."""
+    score = _tensor_scores(forest, bins)
+    hit = score == _forest_depth(forest)
+    # dtype pinned: an unpinned integer sum widens to i64 under enable_x64
+    return jnp.sum(
+        jnp.where(hit, forest.leaf_idx[None, :, :], 0),
+        axis=-1,
+        dtype=jnp.int32,
+    )
+
+
+# --------------------------------------------------------------- host probe
+def _host_walk_values(records, nan_bins, bins):
+    """Reference numpy walk -> ([N, T] f32 values, [N, T] i32 leaves).
+    Decision rule identical to predict.py's bin walker."""
+    nan_bins = np.asarray(nan_bins, np.int64)
+    n = bins.shape[0]
+    vals = np.zeros((n, len(records)), np.float32)
+    leaves = np.zeros((n, len(records)), np.int32)
+    for i, r in enumerate(records):
+        lv = np.asarray(r["leaf_value"], np.float32)
+        sf = np.asarray(r["split_feature"], np.int64)
+        if len(sf) == 0:
+            vals[:, i] = lv[0] if lv.size else 0.0
+            continue
+        sb = np.asarray(r["split_bin"], np.int64)
+        dl = np.asarray(r["default_left"], bool)
+        lc = np.asarray(r["left_child"], np.int64)
+        rc = np.asarray(r["right_child"], np.int64)
+        nodes = np.zeros(n, np.int64)
+        while True:
+            live = nodes >= 0
+            if not live.any():
+                break
+            cur = np.where(live, nodes, 0)
+            f = sf[cur]
+            x = bins[np.arange(n), f]
+            nb = nan_bins[f]
+            go_left = (x <= sb[cur]) | (dl[cur] & (nb >= 0) & (x == nb))
+            nxt = np.where(go_left, lc[cur], rc[cur])
+            nodes = np.where(live, nxt, nodes)
+        leaf = ~nodes
+        vals[:, i] = lv[leaf]
+        leaves[:, i] = leaf
+    return vals, leaves
+
+
+def _host_tensor_values(forest: TensorForest, bins):
+    """Numpy mirror of the three contractions (exact integer + f32 masked
+    select — bitwise-identical to the device result by construction)."""
+    sel = np.asarray(forest.sel, np.int64)
+    thr = np.asarray(forest.thr, np.int64)
+    nanb = np.asarray(forest.nanb, np.int64)
+    dleft = np.asarray(forest.dleft)
+    routes = np.asarray(forest.routes, np.int64)
+    leaf_val = np.asarray(forest.leaf_val)
+    leaf_idx = np.asarray(forest.leaf_idx, np.int64)
+    hi, lo = bins >> 7, bins & 127
+    xsel = (hi @ sel) * 128 + lo @ sel
+    gl = (xsel <= thr) | (dleft & (nanb >= 0) & (xsel == nanb))
+    sgn = np.where(gl, 1, -1)
+    n = bins.shape[0]
+    t, lp = leaf_val.shape
+    depth = int(routes.shape[0] + 1).bit_length() - 1
+    score = (sgn.reshape(n * t, -1) @ routes).reshape(n, t, lp)
+    hit = score == depth
+    vals = np.where(hit, leaf_val[None], np.float32(0.0)).sum(
+        axis=-1, dtype=np.float32
+    )
+    leaves = np.where(hit, leaf_idx[None], 0).sum(axis=-1).astype(np.int32)
+    return vals, leaves
+
+
+def parity_probe_reason(
+    records: List[dict],
+    nan_bins: np.ndarray,
+    forest: TensorForest,
+    num_features: int,
+    max_bin: int,
+    rows: int = 64,
+) -> Optional[str]:
+    """Compile-time byte-parity probe for ``pred_engine=auto``: evaluate a
+    deterministic bin batch through a reference numpy walk AND the numpy
+    mirror of the tensor contractions; any value/leaf mismatch keeps the
+    walker.  Host-only — no device compiles, so warmed ladders stay flat."""
+    rng = np.random.default_rng(0xF0BE5)
+    span = max(2, int(max_bin))
+    bins = rng.integers(0, span, size=(rows, num_features), dtype=np.int64)
+    nb = np.asarray(nan_bins, np.int64)
+    for f in range(min(num_features, nb.size)):
+        if nb[f] >= 0:
+            # plant each feature's NaN bin so default-direction routing is
+            # exercised, not just the threshold compare
+            bins[f % rows, f] = nb[f]
+    ref_vals, ref_leaves = _host_walk_values(records, nb, bins)
+    got_vals, got_leaves = _host_tensor_values(forest, bins)
+    if ref_vals.tobytes() != got_vals.tobytes():
+        bad = int(np.sum(ref_vals != got_vals))
+        return f"parity probe failed: {bad} leaf values disagree"
+    if not np.array_equal(ref_leaves, got_leaves):
+        return "parity probe failed: leaf indices disagree"
+    return None

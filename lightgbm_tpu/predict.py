@@ -33,6 +33,13 @@ from jax import lax
 from .obs.device import sample_device_memory
 from .obs.jit import instrumented_jit, note_executable
 from .obs.registry import get_session
+from .ops.tensor_forest import (
+    _tensor_bins_leaves_impl,
+    _tensor_bins_pertree_impl,
+    build_tensor_forest,
+    parity_probe_reason,
+    tensor_reject_reason,
+)
 from .tree import (
     K_CATEGORICAL_MASK,
     K_DEFAULT_LEFT_MASK,
@@ -513,6 +520,21 @@ def evict_exec_scope(scope: str) -> int:
     return len(dead)
 
 
+# streaming-engine executable bodies by (variant, kind) — the lint IR
+# matrix traces the tensor entries straight out of this table so the
+# audited callable IS the one the engine AOT-compiles
+_STREAM_IMPLS = {
+    ("packed", "value"): _packed_bins_pertree_impl,
+    ("packed", "leaf"): _packed_bins_leaves_impl,
+    ("stacked", "value"): _stacked_bins_value_impl,
+    ("stacked", "leaf"): _stacked_bins_leaves_impl,
+    ("real", "value"): _predict_real_raw_impl,
+    ("real", "leaf"): _predict_real_leaves_impl,
+    ("tensor", "value"): _tensor_bins_pertree_impl,
+    ("tensor", "leaf"): _tensor_bins_leaves_impl,
+}
+
+
 def _shape_key(tree):
     return tuple(
         (a.shape, str(a.dtype)) for a in jax.tree_util.tree_leaves(tree)
@@ -550,16 +572,28 @@ class StreamingPredictor:
         self.last_stats: Dict[str, Any] = {}
 
     # ------------------------------------------------------------- tables
-    def _tables(self, space: str, t0: int, t1: int):
+    def _tables(self, space: str, t0: int, t1: int, engine: str = "walk"):
         """(variant, table_pytree, static_kwargs) for this tree range,
         cached in the booster's _stack_cache (same invalidation discipline
-        as the other stacks: any models_ mutation bumps _model_version)."""
+        as the other stacks: any models_ mutation bumps _model_version).
+        ``engine`` is the RESOLVED engine ("walk"/"matmul"): the caller
+        already ran `resolve_engine`, so "matmul" implies eligibility."""
         b = self._b
         if space == "real":
             return "real", (b._stacked_real(t0, t1),), {}
         recs = b._bin_records[t0:t1]
         nanb = np.asarray(b._nan_bins)
         width = b._bin_matrix_width()
+        if engine == "matmul":
+            key = ("tf", t0, t1, b._model_version)
+            if key not in b._stack_cache:
+                b._stack_cache = {
+                    kk: v
+                    for kk, v in b._stack_cache.items()
+                    if kk[0] != "tf"
+                }
+                b._stack_cache[key] = build_tensor_forest(recs, nanb, width)
+            return "tensor", (b._stack_cache[key],), {}
         if packed_reject_reason(recs, nanb, width) is None:
             key = ("pkbin", t0, t1, b._model_version)
             if key not in b._stack_cache:
@@ -572,6 +606,68 @@ class StreamingPredictor:
             forest, base = b._stack_cache[key]
             return "packed", (forest,), {"base": base}
         return "stacked", (b._stacked_bins(t0, t1), b._nan_bins), {}
+
+    # ------------------------------------------------------------- engine
+    def resolve_engine(self, engine: str, space: str, t0: int, t1: int):
+        """Resolve a ``pred_engine`` request to the engine that will run.
+
+        Returns ``(resolved, reject_reason)`` with resolved in
+        {"walk", "matmul"}.  "matmul"/"auto" requests check tensor-forest
+        eligibility (cached per model version); "auto" additionally runs
+        the host-side byte-parity probe vs the walker.  A fallback emits
+        ONE telemetry event + the `pred/engine_selected` gauge per model
+        version so the silent walker downgrade is visible in obs_top and
+        /metrics."""
+        if engine in (None, "", "walk"):
+            return "walk", None
+        b = self._b
+        key = ("tfrej", t0, t1, b._model_version, engine)
+        if key not in b._stack_cache:
+            b._stack_cache = {
+                kk: v for kk, v in b._stack_cache.items() if kk[0] != "tfrej"
+            }
+            b._stack_cache[key] = self._tensor_reject(engine, space, t0, t1)
+        reason = b._stack_cache[key]
+        ses = get_session()
+        if reason is None:
+            if ses.enabled:
+                ses.set_gauge("pred/engine_selected", 1.0)
+            return "matmul", None
+        warn_key = ("tfwarn", t0, t1, b._model_version, engine)
+        if warn_key not in b._stack_cache:
+            b._stack_cache[warn_key] = True
+            if ses.enabled:
+                ses.set_gauge("pred/engine_selected", 0.0)
+                ses.inc("pred/engine_fallback_total")
+                ses.record(
+                    {
+                        "event": "pred_engine_fallback",
+                        "requested": engine,
+                        "reason": reason,
+                        "trees": t1 - t0,
+                    }
+                )
+        return "walk", reason
+
+    def _tensor_reject(self, engine, space, t0, t1):
+        """Eligibility (+ auto's parity probe) — None or the reject reason."""
+        b = self._b
+        if space != "bin":
+            return "real-space model (no bin mappers)"
+        recs = b._bin_records[t0:t1]
+        nanb = np.asarray(b._nan_bins)
+        width = b._bin_matrix_width()
+        max_bin = getattr(b, "_max_bin_padded", None)
+        reason = tensor_reject_reason(recs, nanb, width, max_bin=max_bin)
+        if reason is not None or engine != "auto":
+            return reason
+        # auto: compile-time byte-parity probe against a reference walk
+        # (host numpy on both sides — no device executables, so warmed
+        # ladders stay flat)
+        _, (forest,), _ = self._tables(space, t0, t1, engine="matmul")
+        return parity_probe_reason(
+            recs, nanb, forest, width, max_bin or _PACK_THR
+        )
 
     # -------------------------------------------------------- executables
     def _get_exec(self, variant, kind, tables, statics, bucket, width, dtype, ndev):
@@ -598,14 +694,7 @@ class StreamingPredictor:
             # compiled this bucket; note_executable dedups per object
             note_executable(label, hit)
             return hit
-        impl = {
-            ("packed", "value"): _packed_bins_pertree_impl,
-            ("packed", "leaf"): _packed_bins_leaves_impl,
-            ("stacked", "value"): _stacked_bins_value_impl,
-            ("stacked", "leaf"): _stacked_bins_leaves_impl,
-            ("real", "value"): _predict_real_raw_impl,
-            ("real", "leaf"): _predict_real_leaves_impl,
-        }[(variant, kind)]
+        impl = _STREAM_IMPLS[(variant, kind)]
         if statics:
             # bind statics up front: pjit rejects kwargs when in_shardings
             # is set, and the cache key already carries their values
@@ -652,11 +741,18 @@ class StreamingPredictor:
         shard_devices: int = 1,
         width: Optional[int] = None,
         kinds=("value",),
+        engine: str = "walk",
     ) -> int:
         """AOT-lower and cache every ladder bucket executable for this model
         so the first request pays no compile.  Returns how many executables
-        this call actually compiled (0 = everything was already cached)."""
-        variant, tables, statics = self._tables(space, t0, t1)
+        this call actually compiled (0 = everything was already cached).
+
+        ``engine`` is the pred_engine request: it is resolved first, so an
+        ineligible forest never AOT-compiles the matmul ladder (warm time
+        and HBM would double for executables the model can't use).  When
+        matmul DOES resolve, the walker ladder is warmed alongside it —
+        the runtime fallback path stays compile-free through serving."""
+        resolved, _ = self.resolve_engine(engine, space, t0, t1)
         if width is None:
             width = (
                 self._b.max_feature_idx + 1
@@ -666,11 +762,15 @@ class StreamingPredictor:
         dtype = np.float32 if space == "real" else np.int32
         ndev = self._shard_count(shard_devices)
         before = _COMPILE_COUNT
-        for bucket in ladder_buckets(chunk):
-            for kind in kinds:
-                self._get_exec(
-                    variant, kind, tables, statics, bucket, width, dtype, ndev
-                )
+        engines = ("matmul", "walk") if resolved == "matmul" else ("walk",)
+        for eng in engines:
+            variant, tables, statics = self._tables(space, t0, t1, engine=eng)
+            for bucket in ladder_buckets(chunk):
+                for kind in kinds:
+                    self._get_exec(
+                        variant, kind, tables, statics, bucket, width,
+                        dtype, ndev,
+                    )
         return _COMPILE_COUNT - before
 
     @staticmethod
@@ -698,6 +798,7 @@ class StreamingPredictor:
         num_buffers: int = 2,
         shard_devices: int = 1,
         reduce_fn: Optional[Callable[[np.ndarray, int], np.ndarray]] = None,
+        engine: str = "walk",
     ) -> np.ndarray:
         """Stream X through the engine.  kind="value" yields per-tree leaf
         outputs as float64 [rows, T] blocks (bit-identical to the legacy
@@ -712,8 +813,10 @@ class StreamingPredictor:
         chunk = max(LADDER_MIN, int(chunk))
         num_buffers = max(1, int(num_buffers))
         ndev = self._shard_count(shard_devices)
+        resolved, _ = self.resolve_engine(engine, space, t0, t1)
         stats = {
             "path": "stream_" + space,
+            "engine": resolved,
             "rows": n,
             "chunks": 0,
             "buckets": [],
@@ -724,7 +827,7 @@ class StreamingPredictor:
             "host_ms": 0.0,
             "compiles": 0,
         }
-        variant, tables, statics = self._tables(space, t0, t1)
+        variant, tables, statics = self._tables(space, t0, t1, engine=resolved)
         suspects = kind == "value" and space == "real"
         if n == 0:
             # empty-input edge: no device work, correctly shaped output
@@ -858,9 +961,13 @@ class StreamingPredictor:
         sample_device_memory("predict")
         if ses.enabled:
             ses.inc("predict_chunks", stats["chunks"])
+            ses.set_gauge(
+                "pred/engine", 1.0 if resolved == "matmul" else 0.0
+            )
             ses.record({
                 "event": "predict",
                 "path": stats["path"],
+                "engine": resolved,
                 "rows": n,
                 "chunks": stats["chunks"],
                 "shard_devices": ndev,

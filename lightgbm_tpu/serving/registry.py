@@ -58,6 +58,7 @@ class ModelEntry:
         self.retired = False
         self.device_bytes = 0
         self.warm_compiles = 0
+        self.pred_engine = "walk"  # resolved at warm time
         self.last_used = time.monotonic()
 
     def describe(self) -> Dict[str, Any]:
@@ -69,6 +70,7 @@ class ModelEntry:
             "inflight": self.inflight,
             "device_bytes": self.device_bytes,
             "num_trees": len(self.booster.models_),
+            "pred_engine": self.pred_engine,
         }
 
 
@@ -82,11 +84,15 @@ class ModelRegistry:
         memory_budget_bytes: int = 0,
         num_buffers: int = 2,
         kinds=("value",),
+        pred_engine: Optional[str] = None,
     ) -> None:
         self.chunk = max(LADDER_MIN, int(chunk))
         self.memory_budget_bytes = int(memory_budget_bytes)
         self.num_buffers = int(num_buffers)
         self.kinds = tuple(kinds)
+        # serve-level pred_engine override; None defers to each booster's
+        # own config (lgb.serve(params={"pred_engine": ...}) lands here)
+        self.pred_engine = pred_engine
         self._lock = threading.RLock()
         self._live: Dict[str, ModelEntry] = {}
         self._generation = 0
@@ -206,6 +212,8 @@ class ModelRegistry:
         executables.  Returns the concatenated live-row predictions and
         the serving model's identity."""
         entry = self.acquire(model_id)
+        if self.pred_engine is not None:
+            predict_kwargs.setdefault("pred_engine", self.pred_engine)
         try:
             outs = [
                 np.asarray(
@@ -270,10 +278,22 @@ class ModelRegistry:
     # -------------------------------------------------------------- warmup
     def _warm(self, entry: ModelEntry) -> None:
         """AOT-warm the full ladder for this entry's scoped engine, then
-        prime the output transform with one dummy predict per bucket."""
+        prime the output transform with one dummy predict per bucket.
+
+        The booster's ``pred_engine`` resolves ONCE here: a matmul/auto
+        model that passes eligibility gets BOTH ladders warmed per scope
+        (tensor + walker fallback), an ineligible one skips the matmul
+        ladder entirely — warm time and HBM never double for executables
+        the model can't use."""
         b = entry.booster
         engine = StreamingPredictor(b, scope=entry.scope)
         b._stream = engine  # predict() now routes through the scoped engine
+        requested = self.pred_engine or getattr(b.config, "pred_engine", "walk")
+        t0, t1 = b._tree_range(0, None)
+        if t1 > t0 and b.models_:
+            entry.pred_engine = engine.resolve_engine(
+                requested, b._predict_space(t0, t1), t0, t1
+            )[0]
         compiles = 0
         n_features = max(1, b.max_feature_idx + 1)
         for step, bucket in enumerate(ladder_buckets(self.chunk)):
@@ -281,19 +301,24 @@ class ModelRegistry:
             # (models the warmup worker dying) — hot_swap must leave the
             # old generation serving and dump the flight ring
             chaos.maybe_kill_warmup(entry.scope, step)
-            compiles += b.compile_predict(chunk=bucket, kinds=self.kinds)
+            compiles += b.compile_predict(
+                chunk=bucket, kinds=self.kinds, pred_engine=requested
+            )
             # dummy predict at exactly this bucket's padded size: the
             # convert_output/average transforms are row-count-shaped jits
             b.predict(
                 np.zeros((bucket, n_features)),
                 pred_chunk_rows=self.chunk,
                 pred_num_buffers=self.num_buffers,
+                pred_engine=requested,
             )
         entry.warm_compiles = compiles
-        entry.device_bytes = self._table_bytes(engine, b)
+        entry.device_bytes = self._table_bytes(engine, b, requested)
 
     @staticmethod
-    def _table_bytes(engine: StreamingPredictor, booster) -> int:
+    def _table_bytes(
+        engine: StreamingPredictor, booster, requested: str = "walk"
+    ) -> int:
         """Estimated device residency: the stacked forest tables the
         streaming executables take as call arguments (compiled code and
         transient output buffers are not counted)."""
@@ -302,14 +327,20 @@ class ModelRegistry:
         t0, t1 = booster._tree_range(0, None)
         if t1 <= t0:
             return 0
-        _, tables, _ = engine._tables(booster._predict_space(t0, t1), t0, t1)
-        return int(
-            sum(
+        space = booster._predict_space(t0, t1)
+        resolved, _ = engine.resolve_engine(requested, space, t0, t1)
+        # a matmul resolution keeps BOTH engines' tables resident (the
+        # walker ladder is warmed as the compile-free fallback)
+        engines = ("matmul", "walk") if resolved == "matmul" else ("walk",)
+        total = 0
+        for eng in engines:
+            _, tables, _ = engine._tables(space, t0, t1, engine=eng)
+            total += sum(
                 a.nbytes
                 for a in jax.tree_util.tree_leaves(tables)
                 if hasattr(a, "nbytes")
             )
-        )
+        return int(total)
 
     # ------------------------------------------------------------ eviction
     def _evict_for_budget_locked(self, incoming_bytes: int) -> List[ModelEntry]:

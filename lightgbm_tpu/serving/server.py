@@ -90,6 +90,13 @@ class ServingServer:
         self.registry = ModelRegistry(
             chunk=self.max_batch,
             memory_budget_bytes=int(budget_mb * (1 << 20)),
+            # an explicit pred_engine in serve params overrides every
+            # booster's trained-in engine (validated by Config above)
+            pred_engine=(
+                cfg.pred_engine
+                if params and "pred_engine" in params
+                else None
+            ),
         )
         self._watchdog = watchdog or HealthWatchdog()
         self._batchers: Dict[str, MicroBatcher] = {}
@@ -276,8 +283,10 @@ def serve(
 
     ``boosters`` is one Booster, a list, or an ``{id: Booster}`` dict.
     Knobs come from ``params`` (``serve_deadline_ms``, ``serve_max_batch``,
-    ``serve_memory_budget_mb``, ``serve_port``) or keyword overrides
-    (``deadline_ms``, ``max_batch``, ``memory_budget_mb``, ``port``).
+    ``serve_memory_budget_mb``, ``serve_port``, ``pred_engine``) or keyword
+    overrides (``deadline_ms``, ``max_batch``, ``memory_budget_mb``,
+    ``port``).  A ``pred_engine`` in ``params`` overrides every served
+    booster's own engine at warm and dispatch time.
     Every model's bucket ladder is AOT-warmed before the call returns, so
     the first request pays no compile.  Use as a context manager or call
     ``.stop()``.

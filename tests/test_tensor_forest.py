@@ -1,0 +1,291 @@
+"""Tensor-forest prediction engine (pred_engine=matmul, ops/tensor_forest.py).
+
+Contracts under test:
+  * matmul output is BYTE-IDENTICAL to the walker for every output kind
+    (transformed values, raw scores, leaf indices), across remainder
+    chunks, NaN default-direction routing, and multiclass grouping;
+  * the eligibility matrix rejects exactly the forests the tensor layout
+    cannot represent (categoricals, depth > 8, > 64 leaves, wide bins,
+    too many trees/features) and every rejection falls back to the walker
+    with identical output plus ONE telemetry event + gauge;
+  * `auto` resolves through the compile-time parity probe;
+  * warm ladders never recompile (compile_counts_by_label stays flat) —
+    including through the serving plane (lgb.serve round-trip).
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs.jit import compile_counts_by_label
+from lightgbm_tpu.obs.registry import get_session
+from lightgbm_tpu.ops.tensor_forest import (
+    TF_MAX_BIN,
+    TF_MAX_DEPTH,
+    TF_MAX_F,
+    TF_MAX_LEAVES,
+    TF_MAX_TREES,
+    _host_tensor_values,
+    _host_walk_values,
+    build_tensor_forest,
+    tensor_reject_reason,
+)
+from lightgbm_tpu.predict import streaming_compile_count
+
+
+def _make_eligible(n=3000, f=12, seed=3, rounds=15, nan_frac=0.05, **extra):
+    """Binary model inside the tensor sweet spot (depth <= 4), with NaNs
+    planted so the default-direction term is exercised, not just <=."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=f)
+    y = ((X @ w + rng.normal(scale=0.5, size=n)) > 0).astype(np.float64)
+    if nan_frac:
+        X[rng.random((n, f)) < nan_frac] = np.nan
+    params = {
+        "objective": "binary",
+        "num_leaves": 16,
+        "max_depth": 4,
+        "min_data_in_leaf": 5,
+        "verbose": -1,
+        **extra,
+    }
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params), rounds)
+    return bst, X
+
+
+@pytest.fixture(scope="module")
+def eligible_model():
+    return _make_eligible()
+
+
+def _tiny_record(depth=1):
+    """Synthetic bin-space chain record of the given depth."""
+    d = max(1, depth)
+    return {
+        "split_feature": [0] * d,
+        "split_bin": [1] * d,
+        "default_left": [False] * d,
+        "left_child": [i + 1 for i in range(d - 1)] + [~(d - 1) - 1],
+        "right_child": [~i for i in range(d)],
+        "leaf_value": [0.1 * i for i in range(d + 1)],
+    }
+
+
+def test_eligibility_rejection_matrix():
+    nanb = np.full(4, -1, np.int64)
+    ok = [_tiny_record(), _tiny_record(3)]
+    assert tensor_reject_reason(ok, nanb, 4) is None
+    # each axis of the envelope, one violation at a time
+    cat = dict(_tiny_record(), split_is_cat=[True])
+    assert "categorical" in tensor_reject_reason([cat], nanb, 4)
+    deep = _tiny_record(TF_MAX_DEPTH + 1)
+    assert f"> {TF_MAX_DEPTH}" in tensor_reject_reason([deep], nanb, 4)
+    wide = dict(_tiny_record(), split_bin=[TF_MAX_BIN])
+    assert f">= {TF_MAX_BIN}" in tensor_reject_reason([wide], nanb, 4)
+    leafy = dict(_tiny_record(), leaf_value=[0.0] * (TF_MAX_LEAVES + 1))
+    assert f"> {TF_MAX_LEAVES}" in tensor_reject_reason([leafy], nanb, 4)
+    many = [_tiny_record()] * (TF_MAX_TREES + 1)
+    assert f"> {TF_MAX_TREES}" in tensor_reject_reason(many, nanb, 4)
+    assert f"> {TF_MAX_F}" in tensor_reject_reason(ok, nanb, TF_MAX_F + 1)
+    assert "NaN bin" in tensor_reject_reason(
+        ok, np.array([TF_MAX_BIN]), 4
+    )
+    assert "envelope" in tensor_reject_reason(ok, nanb, 4, max_bin=1 << 15)
+    assert "no bin-space record" in tensor_reject_reason(
+        [dict(_tiny_record(), no_bin_form=True)], nanb, 4
+    )
+    assert "no trees" in tensor_reject_reason([], nanb, 4)
+
+
+def test_compiler_matches_reference_walk_on_random_bins():
+    """build_tensor_forest + the contraction math reproduce a reference
+    numpy walk bit-for-bit on random bins (skewed trees, NaN bins)."""
+    rng = np.random.default_rng(11)
+    records = [_tiny_record(d) for d in (1, 2, 5, 8)]
+    nanb = np.array([3, -1, 0, 7], np.int64)
+    for r in records:
+        r["split_feature"] = list(
+            rng.integers(0, 4, size=len(r["split_feature"]))
+        )
+        r["split_bin"] = list(rng.integers(0, 32, size=len(r["split_bin"])))
+        r["default_left"] = list(rng.random(len(r["default_left"])) < 0.5)
+    assert tensor_reject_reason(records, nanb, 4) is None
+    forest = build_tensor_forest(records, nanb, 4)
+    bins = rng.integers(0, 40, size=(256, 4)).astype(np.int64)
+    ref_v, ref_l = _host_walk_values(records, nanb, bins)
+    got_v, got_l = _host_tensor_values(forest, bins)
+    assert ref_v.tobytes() == got_v.tobytes()
+    assert np.array_equal(ref_l, got_l)
+
+
+def test_matmul_byte_identical_all_kinds(eligible_model):
+    bst, X = eligible_model
+    walk = bst.predict(X)
+    assert bst.last_predict_stats["engine"] == "walk"
+    mm = bst.predict(X, pred_engine="matmul")
+    assert bst.last_predict_stats["engine"] == "matmul"
+    assert np.array_equal(walk, mm)
+    assert np.array_equal(
+        bst.predict(X, raw_score=True),
+        bst.predict(X, raw_score=True, pred_engine="matmul"),
+    )
+    leaf_w = bst.predict(X, pred_leaf=True)
+    leaf_m = bst.predict(X, pred_leaf=True, pred_engine="matmul")
+    assert leaf_m.dtype == np.int32
+    assert np.array_equal(leaf_w, leaf_m)
+    # remainder chunks ride the same bucket ladder
+    for chunk in (512, 700, 2048):  # 3000 rows -> odd remainders
+        assert np.array_equal(
+            walk, bst.predict(X, pred_engine="matmul", pred_chunk_rows=chunk)
+        )
+    # `auto` resolves to matmul via the parity probe
+    assert np.array_equal(walk, bst.predict(X, pred_engine="auto"))
+    assert bst.last_predict_stats["engine"] == "matmul"
+
+
+def test_multiclass_grouping_byte_identical():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(2500, 10))
+    y = np.digitize(X[:, 0] + 0.3 * X[:, 1], [-0.5, 0.5]).astype(np.float64)
+    params = {
+        "objective": "multiclass",
+        "num_class": 3,
+        "num_leaves": 8,
+        "max_depth": 3,
+        "verbose": -1,
+    }
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params), 8)
+    walk = bst.predict(X)
+    mm = bst.predict(X, pred_engine="matmul", pred_chunk_rows=512)
+    assert walk.shape == (2500, 3)
+    assert np.array_equal(walk, mm)
+
+
+def test_real_space_falls_back_with_telemetry(eligible_model):
+    """Loaded-from-text boosters have no bin mappers: a matmul request
+    falls back to the real-space walker (suspect re-walk included) with
+    identical output and a visible fallback event + gauges."""
+    bst, X = eligible_model
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    ses = get_session()
+    ses.configure(enabled=True)
+    try:
+        n_events = len(
+            [e for e in ses.events if e.get("event") == "pred_engine_fallback"]
+        )
+        walk = loaded.predict(X, pred_chunk_rows=700)
+        mm = loaded.predict(X, pred_engine="matmul", pred_chunk_rows=700)
+        assert loaded.last_predict_stats["path"] == "stream_real"
+        assert loaded.last_predict_stats["engine"] == "walk"
+        assert np.array_equal(walk, mm)
+        events = [
+            e for e in ses.events if e.get("event") == "pred_engine_fallback"
+        ]
+        assert len(events) == n_events + 1  # deduped per model version
+        assert "real-space" in events[-1]["reason"]
+        assert ses.gauges.get("pred/engine_selected") == 0.0
+        assert ses.gauges.get("pred/engine") == 0.0
+        loaded.predict(X, pred_engine="matmul")  # repeat: still ONE event
+        assert (
+            len([
+                e
+                for e in ses.events
+                if e.get("event") == "pred_engine_fallback"
+            ])
+            == n_events + 1
+        )
+    finally:
+        ses.configure(enabled=False)
+
+
+def test_ineligible_forest_falls_back_byte_identical():
+    """Deep default-growth trees exceed the depth cap: matmul quietly
+    (but observably) serves walker output."""
+    rng = np.random.default_rng(13)
+    X = rng.normal(size=(3000, 8))
+    y = (X[:, 0] * X[:, 1] > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 63, "verbose": -1,
+              "min_data_in_leaf": 2, "telemetry": True}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params), 5)
+    walk = bst.predict(X)
+    mm = bst.predict(X, pred_engine="matmul")
+    assert bst.last_predict_stats["engine"] == "walk"
+    assert np.array_equal(walk, mm)
+    ses = get_session()
+    assert ses.counters.get("pred/engine_fallback_total", 0) >= 1
+
+
+def test_matmul_gauge_selected(eligible_model):
+    bst, X = eligible_model
+    ses = get_session()
+    ses.configure(enabled=True)
+    try:
+        bst.predict(X[:300], pred_engine="matmul")
+        assert ses.gauges.get("pred/engine") == 1.0
+        assert ses.gauges.get("pred/engine_selected") == 1.0
+        bst.predict(X[:300])
+        assert ses.gauges.get("pred/engine") == 0.0
+    finally:
+        ses.configure(enabled=False)
+
+
+def test_zero_recompiles_after_warmup(eligible_model):
+    bst, X = eligible_model
+    fresh = lgb.train(
+        {
+            "objective": "binary",
+            "num_leaves": 16,
+            "max_depth": 4,
+            "min_data_in_leaf": 5,
+            "verbose": -1,
+            "pred_engine": "matmul",
+        },
+        lgb.Dataset(X, label=(X[:, 0] > 0).astype(np.float64)),
+        num_boost_round=10,
+    )
+    warmed = fresh.compile_predict(kinds=("value", "leaf"))
+    assert warmed >= 0
+    assert fresh.compile_predict(kinds=("value", "leaf")) == 0  # idempotent
+    before = streaming_compile_count()
+    labels_before = dict(compile_counts_by_label())
+    for n in (1, 100, 256, 257, 1024, 3000):
+        out = fresh.predict(X[:n])
+        assert out.shape == (n,)
+        assert fresh.last_predict_stats["engine"] == "matmul"
+        assert fresh.last_predict_stats["compiles"] == 0
+        assert fresh.predict(X[:n], pred_leaf=True).shape[0] == n
+    assert streaming_compile_count() == before
+    after = compile_counts_by_label()
+    stream_labels = {
+        k: v for k, v in after.items() if k.startswith("predict/stream")
+    }
+    for k, v in stream_labels.items():
+        assert labels_before.get(k, 0) == v, f"label {k} retraced"
+    assert any("tensor" in k for k in stream_labels)
+
+
+def test_serving_roundtrip_matmul(eligible_model):
+    """lgb.serve with pred_engine=matmul: warmed at load, byte-identical
+    to direct predict, zero steady-state recompiles, engine visible in
+    the registry description."""
+    bst, X = eligible_model
+    server = lgb.serve(bst, params={"pred_engine": "matmul"})
+    try:
+        desc = server.registry.models()[0]
+        assert desc["pred_engine"] == "matmul"
+        ref = bst.predict(X[:500], pred_engine="matmul")
+        labels_before = dict(compile_counts_by_label())
+        got = server.predict(X[:500])
+        assert np.array_equal(ref, got)
+        for n in (1, 64, 333, 500):
+            assert np.array_equal(
+                bst.predict(X[:n], pred_engine="matmul"),
+                server.predict(X[:n]),
+            )
+        after = compile_counts_by_label()
+        for k, v in after.items():
+            if k.startswith("predict/stream"):
+                assert labels_before.get(k, 0) == v, f"label {k} retraced"
+    finally:
+        server.stop()

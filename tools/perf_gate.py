@@ -122,6 +122,10 @@ def collect() -> Dict[str, float]:
         num_boost_round=3,
     )
     booster.predict(X)
+    # tensor-forest engine on the same (eligible) model: pins the
+    # predict/stream/tensor retrace labels + the matmul executables'
+    # cost/memory accounting into the contract next to the walker's
+    booster.predict(X, pred_engine="matmul")
     metrics["wall/serial_train_s"] = round(time.perf_counter() - t0, 3)
     labels_after = compile_counts_by_label()
     for label, count in sorted(labels_after.items()):

@@ -169,6 +169,37 @@ finally:
     server.stop()
 PYEOF
 
+# tensor-forest smoke: the matmul prediction engine must be byte-identical
+# to the walker on a 3-iteration eligible model (values + leaf indices),
+# resolve via pred_engine=auto (the compile-time parity probe), and warm
+# its own retrace label next to the walker's.
+echo "=== tensor-forest smoke (pred_engine=matmul byte parity vs walker) ==="
+python - <<'PYEOF' || rc=$?
+import numpy as np
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(800, 8))
+X[rng.random(X.shape) < 0.05] = np.nan
+y = np.nan_to_num(X[:, 0]) + 0.3 * np.nan_to_num(X[:, 1])
+params = {"objective": "regression", "num_leaves": 15, "verbosity": -1}
+b = lgb.train(params, lgb.Dataset(X, y, params=params), 3)
+Xq = rng.normal(size=(700, 8))
+Xq[rng.random(Xq.shape) < 0.05] = np.nan
+walk = b.predict(Xq, pred_engine="walk")
+mm = b.predict(Xq, pred_engine="matmul")
+assert walk.tobytes() == mm.tobytes(), "matmul values diverged from walker"
+assert b.last_predict_stats.get("engine") == "matmul"
+auto = b.predict(Xq, pred_engine="auto")
+assert auto.tobytes() == walk.tobytes(), "auto engine diverged from walker"
+lw = b.predict(Xq, pred_leaf=True, pred_engine="walk")
+lm = b.predict(Xq, pred_leaf=True, pred_engine="matmul")
+assert np.array_equal(lw, lm), "matmul leaf indices diverged from walker"
+labels = lgb.compile_counts_by_label()
+assert any("tensor" in k for k in labels), sorted(labels)
+print("tensor-forest smoke: walker/matmul byte parity OK")
+PYEOF
+
 # perf-contract gate: collect the deterministic telemetry slice (retraces
 # by label, analytic+measured collective bytes, executable FLOPs/temp HBM)
 # and diff it against the committed contract.  HARD gate — any drift in a
