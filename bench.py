@@ -784,7 +784,154 @@ def pred_engine_sweep() -> dict:
     return out
 
 
+_INGEST_CELL_SCRIPT = r"""
+import json, os, resource, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+csv_path, chunk_rows = sys.argv[1], int(sys.argv[2])
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs.registry import get_session
+
+get_session().configure(enabled=True)
+params = {
+    "objective": "binary", "max_bin": 255, "verbosity": -1,
+    "bin_construct_sample_cnt": 50000, "data_random_seed": 1,
+    "ingest_chunk_rows": chunk_rows,
+}
+# settle the allocator baseline (interpreter + jax + a tiny construct)
+# so the reported delta isolates THIS construct's footprint; ru_maxrss
+# is process-lifetime-monotone, hence one fresh process per cell
+rng = np.random.default_rng(0)
+Xs = rng.normal(size=(256, 28))
+ys = (Xs[:, 0] > 0).astype(np.float64)
+lgb.Dataset(Xs, ys, params=params).construct()
+base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+t0 = time.perf_counter()
+ds = lgb.Dataset(csv_path, params=params).construct()
+wall = time.perf_counter() - t0
+peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+n = int(ds.bins.shape[0])
+print(json.dumps({
+    "rows": n,
+    "wall_s": round(wall, 2),
+    "rows_per_sec": round(n / wall),
+    "peak_rss_bytes": int(peak),
+    "rss_delta_bytes": int(peak - base),
+    # 0 for the one-shot path: only stream_pack sets this gauge
+    "chunks_streamed": int(
+        get_session().gauges.get("ingest/chunks_total", 0.0)
+    ),
+}))
+"""
+
+
+def ingest_sweep() -> dict:
+    """Chunked-vs-one-shot ingest A/B (``--ingest-sweep``).
+
+    Writes a Higgs-shaped label+28-feature CSV once (1M rows by default,
+    generated chunk-wise so the bench itself stays lean), then builds a
+    Dataset from that file in a FRESH subprocess per cell — ``ru_maxrss``
+    is process-lifetime-monotone, so peak-RSS cells cannot share a
+    process.  One cell runs the one-shot loader (``ingest_chunk_rows=0``:
+    np.loadtxt materializes the full f64 matrix); the others stream the
+    same file through the two-pass chunked ingest at chunk sizes
+    {64k, 256k, 1M}.  Each cell reports wall, rows/s, lifetime peak RSS
+    and the delta over a settled baseline; the headline ratios compare
+    each chunked cell's RSS delta and wall against one-shot.  Byte parity
+    between the two paths is asserted in-suite (tests/test_ingest.py),
+    not here — the bench measures the memory/wall trade only."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    n_rows = int(os.environ.get("BENCH_INGEST_ROWS", 1_000_000))
+    n_features = 28
+    chunk_grid = [
+        int(v)
+        for v in os.environ.get(
+            "BENCH_INGEST_CHUNKS", "65536,262144,1000000"
+        ).split(",")
+        if v.strip()
+    ]
+    td = tempfile.mkdtemp(prefix="lgbtpu_ingest_bench_")
+    csv_path = os.path.join(td, "higgs_like.csv")
+    try:
+        rng = np.random.default_rng(42)
+        wvec = rng.normal(size=n_features)
+        with open(csv_path, "w") as fh:
+            done = 0
+            while done < n_rows:
+                m = min(100_000, n_rows - done)
+                Xc = rng.normal(size=(m, n_features))
+                yc = (
+                    Xc @ wvec * 0.5 + rng.normal(size=m) > 0
+                ).astype(np.float64)
+                np.savetxt(
+                    fh,
+                    np.column_stack([yc, Xc]),
+                    delimiter=",",
+                    fmt="%.5f",
+                )
+                done += m
+        csv_bytes = os.path.getsize(csv_path)
+
+        def run_cell(chunk_rows: int) -> dict:
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    _INGEST_CELL_SCRIPT,
+                    csv_path,
+                    str(chunk_rows),
+                ],
+                capture_output=True,
+                text=True,
+                timeout=1800,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"ingest cell chunk_rows={chunk_rows} failed:\n"
+                    + r.stderr[-4000:]
+                )
+            return json.loads(r.stdout.strip().splitlines()[-1])
+
+        out = {
+            "rows": n_rows,
+            "n_features": n_features,
+            "csv_bytes": int(csv_bytes),
+            "raw_f64_bytes": int(n_rows * n_features * 8),
+            "cells": [],
+        }
+        one_shot = run_cell(0)
+        out["cells"].append(dict(one_shot, mode="one_shot", chunk_rows=0))
+        for cr in chunk_grid:
+            cell = run_cell(cr)
+            cell.update(
+                mode="chunked",
+                chunk_rows=cr,
+                rss_reduction_vs_one_shot=round(
+                    one_shot["rss_delta_bytes"]
+                    / max(1, cell["rss_delta_bytes"]),
+                    2,
+                ),
+                wall_vs_one_shot=round(
+                    cell["wall_s"] / one_shot["wall_s"], 3
+                ),
+            )
+            out["cells"].append(cell)
+        return out
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+
+
 def main() -> None:
+    if "--ingest-sweep" in sys.argv:
+        # standalone, CPU-pinned: each cell is its own subprocess, so the
+        # parent only orchestrates and writes the CSV fixture
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps({"ingest_sweep": ingest_sweep()}))
+        return
     if "--pred-engine-sweep" in sys.argv:
         # standalone, CPU-pinned like --serve-sweep: cross-engine parity
         # and phase shape, plus the analytic MXU model for the roofline

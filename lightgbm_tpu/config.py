@@ -467,6 +467,12 @@ class Config:
     use_missing: bool = True
     zero_as_missing: bool = False
     feature_pre_filter: bool = True
+    # Out-of-core streaming ingest (lightgbm_tpu/ingest): chunk row count
+    # for two-pass Dataset construction (0 = one-shot in-core path; chunk
+    # iterables always stream), and an optional directory for np.memmap
+    # backing of the packed bin planes so even [N, P] bins stay off-heap
+    ingest_chunk_rows: int = 0
+    ingest_mmap_dir: str = ""
     pre_partition: bool = False
     two_round: bool = False
     header: bool = False
@@ -669,6 +675,10 @@ class Config:
             )
         if self.checkpoint_keep < 0:
             raise ValueError("checkpoint_keep must be >= 0 (0 keeps all)")
+        if self.ingest_chunk_rows < 0:
+            raise ValueError(
+                "ingest_chunk_rows must be >= 0 (0 = one-shot construction)"
+            )
         if not (0 <= self.obs_export_port <= 65535):
             raise ValueError(
                 "obs_export_port must be in [0, 65535] (0 disables)"

@@ -462,6 +462,11 @@ def _attach_sidecars(out: Dict[str, Any], path: str) -> Dict[str, Any]:
     return out
 
 
+def _is_libsvm_row(ln: str) -> bool:
+    toks = ln.replace(",", " ").split()
+    return len(toks) > 1 and ":" in toks[1]
+
+
 def _load_text_file(path: str, config: Config) -> Dict[str, Any]:
     """Parse a CSV/TSV/LibSVM training file (reference src/io/parser.cpp);
     LibSVM rows load into a CSR matrix (sparse path), dense CSV/TSV into a
@@ -536,9 +541,6 @@ def _load_text_file(path: str, config: Config) -> Dict[str, Any]:
     # scan a few rows: a leading label-only line is legal LibSVM (all-zero
     # sample), so one line is not enough to decide the format
     probe = [ln for ln in lines[skip:] if ln.strip()][:20]
-    def _is_libsvm_row(ln):
-        toks = ln.replace(",", " ").split()
-        return len(toks) > 1 and ":" in toks[1]
     if probe and any(_is_libsvm_row(ln) for ln in probe):
         return _parse_libsvm(lines[skip:], path)
     first = lines[0] if lines else ""
@@ -682,6 +684,8 @@ class Dataset:
             return self._construct_inner()
 
     def _construct_inner(self) -> "Dataset":
+        from .utils.timer import global_timer
+
         data = self._raw_data
         label = self._label
         if isinstance(data, (str, Path)) and _is_binary_dataset_file(str(data)):
@@ -711,6 +715,18 @@ class Dataset:
                 if val is not None:
                     self.set_field(name, val)
             return self
+        # ---- out-of-core streaming ingest (lightgbm_tpu/ingest): two-pass
+        # chunked construction whenever the data is chunk-iterable (the
+        # explicit out-of-core API) or ingest_chunk_rows is set.  Bins,
+        # bundle layout and the downstream model are byte-identical to the
+        # one-shot path; the raw float64 matrix never materializes.
+        streamed = self._maybe_construct_streamed(data, label)
+        if streamed is not None:
+            return streamed
+        if data is not None and not isinstance(data, (str, Path)):
+            from .ingest.sources import materialize_chunks
+
+            data = materialize_chunks(data)
         if isinstance(data, (str, Path)):
             loaded = _load_text_file(str(data), self.config)
             data = loaded["data"]
@@ -808,9 +824,11 @@ class Dataset:
                 sparse_csc = sparse_csc.copy()
                 sparse_csc.resize(n, self.num_total_features)
         elif sparse_csc is not None:
-            self._build_bin_mappers_sparse(sparse_csc, cat_idx)
+            with global_timer.timed("dataset/bin_fit"):
+                self._build_bin_mappers_sparse(sparse_csc, cat_idx)
         else:
-            self._build_bin_mappers(data, cat_idx)
+            with global_timer.timed("dataset/bin_fit"):
+                self._build_bin_mappers(data, cat_idx)
         self._sync_mappers_across_processes()
 
         # ---- EFB (reference dataset.cpp FindGroups): bundle mutually
@@ -820,7 +838,10 @@ class Dataset:
         # above so planes bin identically.
         if self.reference is None and self.config.enable_bundle \
                 and self._bundling_allowed():
-            self.bundle_layout = self._find_bundle_layout(data, sparse_csc, n)
+            with global_timer.timed("dataset/bundle"):
+                self.bundle_layout = self._find_bundle_layout(
+                    data, sparse_csc, n
+                )
         layout = self.bundle_layout
         if layout is not None:
             max_bins = max(layout.plane_bins)
@@ -862,21 +883,26 @@ class Dataset:
             # cv()'s fold slicing works; the dense float is still never built
             self.raw = None if self.free_raw_data else sparse_csc.tocsr()
         else:
-            if layout is not None:
-                binned = layout.pack_columns(
-                    n,
-                    lambda j: self.bin_mappers[j].values_to_bins(data[:, j]),
-                )
-                self.bins = binned.astype(dtype)
-            else:
-                cols = []
-                for j in self.used_features:
-                    cols.append(self.bin_mappers[j].values_to_bins(data[:, j]))
-                if cols:
-                    binned = np.stack(cols, axis=1)
+            with global_timer.timed("dataset/pack"):
+                if layout is not None:
+                    binned = layout.pack_columns(
+                        n,
+                        lambda j: self.bin_mappers[j].values_to_bins(
+                            data[:, j]
+                        ),
+                    )
+                    self.bins = binned.astype(dtype)
                 else:
-                    binned = np.zeros((n, 0), dtype=np.int32)
-                self.bins = binned.astype(dtype)
+                    cols = []
+                    for j in self.used_features:
+                        cols.append(
+                            self.bin_mappers[j].values_to_bins(data[:, j])
+                        )
+                    if cols:
+                        binned = np.stack(cols, axis=1)
+                    else:
+                        binned = np.zeros((n, 0), dtype=np.int32)
+                    self.bins = binned.astype(dtype)
             self.raw = (
                 data
                 if (self.config.linear_tree or not self.free_raw_data)
@@ -905,6 +931,193 @@ class Dataset:
         self._constructed = True
         if self.free_raw_data and not self.config.linear_tree:
             self._raw_data = None
+        return self
+
+    def _maybe_construct_streamed(self, data, label) -> Optional["Dataset"]:
+        """Route construction through the streaming ingest pipeline, or
+        return None for the one-shot path (knob unset, unstreamable
+        format, or a mode that needs the raw matrix anyway)."""
+        from .ingest.sources import (
+            StreamingUnsupported,
+            is_chunk_iterable,
+            make_chunk_source,
+        )
+
+        cfg = self.config
+        chunky = is_chunk_iterable(data)
+        if data is None or (not chunky and cfg.ingest_chunk_rows <= 0):
+            return None
+        if hasattr(data, "tocsc") and hasattr(data, "nnz"):
+            # sparse input bins column-wise from CSC without ever
+            # densifying — already out-of-core in the way that matters
+            return None
+        if cfg.linear_tree or not self.free_raw_data:
+            from .utils.log import log_warning
+
+            log_warning(
+                "streaming ingest frees the raw matrix after binning; "
+                "linear_tree / free_raw_data=false fall back to one-shot "
+                "construction"
+            )
+            return None
+        ref_maps = getattr(
+            self.reference, "arrow_categories", None
+        ) or getattr(self.reference, "pandas_categorical", None)
+        try:
+            source = make_chunk_source(data, cfg, ref_maps)
+        except StreamingUnsupported:
+            return None
+        if source is None:
+            return None
+        return self._construct_streamed(source, label)
+
+    def _construct_streamed(self, source, label) -> "Dataset":
+        """Two-pass out-of-core construction (lightgbm_tpu/ingest): pass 1
+        draws the one-shot path's exact seeded sample from chunks and fits
+        bin mappers + the EFB layout on it; pass 2 streams chunks through
+        binning into preallocated packed planes.  Under multi-process
+        ``pre_partition`` the sample is assembled GLOBALLY
+        (ingest/sharded.py), so every host fits identical mappers from its
+        row shard alone."""
+        from .ingest.pipeline import stream_pack
+        from .ingest.sources import ArrowChunkSource, PandasChunkSource
+        from .utils.timer import global_timer
+
+        cfg = self.config
+        n = source.n_rows
+        num_features = source.n_cols
+        self.num_total_features = num_features
+        self.parser_config_str = ""
+        self._ignore_set = set(source.ignore_features)
+        if isinstance(source, ArrowChunkSource):
+            self.arrow_categories = source.category_maps
+        elif isinstance(source, PandasChunkSource):
+            self.pandas_categorical = source.category_maps
+        if self._feature_name == "auto" and getattr(source, "names", None):
+            self._feature_name = source.names
+        if self._categorical_feature == "auto" and hasattr(source, "cats"):
+            self._categorical_feature = source.cats
+        if isinstance(self._feature_name, str):
+            self.feature_names = [f"Column_{i}" for i in range(num_features)]
+        else:
+            self.feature_names = [str(s) for s in self._feature_name]
+        cat_idx = self._resolve_categorical(num_features)
+
+        sharded = False
+        if cfg.pre_partition:
+            try:
+                import jax
+
+                sharded = jax.process_count() > 1
+            except Exception:  # pragma: no cover
+                sharded = False
+
+        if self.reference is not None:
+            ref = self.reference.construct()
+            self.bin_mappers = ref.bin_mappers
+            self.used_features = ref.used_features
+            self.bundle_layout = getattr(ref, "bundle_layout", None)
+            self.feature_names = ref.feature_names
+            self.num_total_features = ref.num_total_features
+        else:
+            with global_timer.timed("dataset/ingest/sample"):
+                if sharded:
+                    from .ingest.sharded import exchange_global_sample
+
+                    # mappers fit from the GLOBAL sample on every host:
+                    # no per-rank feature slicing, no mapper allgather,
+                    # and EFB layouts agree by construction
+                    self._ingest_global_mappers = True
+                    _gn, _off, sample = exchange_global_sample(source, cfg)
+                else:
+                    sample_cnt = min(n, cfg.bin_construct_sample_cnt)
+                    if sample_cnt < n:
+                        rng = np.random.default_rng(cfg.data_random_seed)
+                        rows = np.sort(
+                            rng.choice(n, size=sample_cnt, replace=False)
+                        )
+                    else:
+                        rows = np.arange(n, dtype=np.int64)
+                    sample = source.sample_rows(rows)
+            with global_timer.timed("dataset/ingest/bin_fit"):
+                self.bin_mappers = []
+                self.used_features = []
+                for j in range(num_features):
+                    self._add_mapper(j, sample[:, j], cat_idx)
+            if cfg.enable_bundle and self._bundling_allowed():
+                with global_timer.timed("dataset/ingest/bundle"):
+                    from .bundling import build_layout
+
+                    # nonzero scan over the SAMPLE matrix with the sample
+                    # count as the row universe — bit-identical to the
+                    # one-shot scan over full data mapped through
+                    # sample_rows (bundling.py maps nz to sample positions
+                    # and normalizes by the sample count either way)
+                    self.bundle_layout = build_layout(
+                        self.used_features,
+                        self.bin_mappers,
+                        lambda j: np.flatnonzero(sample[:, j]),
+                        sample.shape[0],
+                        sample_rows=None,
+                        max_conflict_rate=cfg.max_conflict_rate,
+                    )
+            del sample
+
+        layout = self.bundle_layout
+        if layout is not None:
+            max_bins = max(layout.plane_bins)
+            n_cols = layout.num_planes
+        else:
+            max_bins = max(
+                (m.num_bins for m in self.bin_mappers), default=1
+            )
+            n_cols = len(self.used_features)
+        dtype = np.uint8 if max_bins <= 256 else np.uint16
+        self._check_binned_footprint(n, n_cols, np.dtype(dtype).itemsize)
+        with global_timer.timed("dataset/ingest/pack"):
+            self.bins = stream_pack(
+                source, self.bin_mappers, self.used_features, layout,
+                dtype, cfg,
+            )
+        self.raw = None
+
+        fields = source.row_fields()
+        if label is None:
+            label = fields.get("label")
+        if self._group is None:
+            self._group = fields.get("group")
+        if self._weight is None:
+            self._weight = fields.get("weight")
+        if self._init_score is None:
+            self._init_score = fields.get("init_score")
+        if self._position is None:
+            self._position = fields.get("position")
+        if label is None:
+            raise ValueError("label is required to construct a Dataset")
+        label = _is_1d(np.asarray(label, dtype=np.float64))
+        if len(label) != n:
+            raise ValueError(f"label length {len(label)} != num rows {n}")
+        _check_label_finite(label)
+        weight = self._weight
+        if weight is not None:
+            weight = _is_1d(np.asarray(weight, dtype=np.float64))
+        init_score = self._init_score
+        if init_score is not None:
+            init_score = np.asarray(init_score, dtype=np.float64)
+        self.metadata = Metadata(
+            label=label, weight=weight, init_score=init_score
+        )
+        if self._group is not None:
+            self.metadata.set_query(np.asarray(self._group))
+        if self._position is not None:
+            pos = np.asarray(self._position)
+            if len(pos) != len(label):
+                raise ValueError(
+                    f"position length {len(pos)} != num_data {len(label)}"
+                )
+            self.metadata.position = pos
+        self._constructed = True
+        self._raw_data = None
         return self
 
     def _resolve_categorical(self, num_features: int) -> List[int]:
@@ -975,6 +1188,10 @@ class Dataset:
         """Under pre_partition + multi-process, the contiguous feature slice
         this rank bins (others arrive via the mapper allgather); None when
         every feature is local."""
+        if getattr(self, "_ingest_global_mappers", False):
+            # streamed sharded ingest fits every mapper from the GLOBAL
+            # sample (ingest/sharded.py): no per-rank feature slicing
+            return None
         if not self.config.pre_partition:
             return None
         try:
@@ -1069,6 +1286,10 @@ class Dataset:
         """EFB is skipped under multi-process pre_partition feeding: the
         conflict scan sees only local rows, so per-process layouts would
         disagree (the mapper allgather has no layout channel yet)."""
+        if getattr(self, "_ingest_global_mappers", False):
+            # streamed sharded ingest scans conflicts on the allgathered
+            # GLOBAL sample — identical layout on every process
+            return True
         if not self.config.pre_partition:
             return True
         try:

@@ -1,0 +1,49 @@
+"""Out-of-core streaming ingest: chunked two-pass Dataset construction.
+
+The reference's ``DatasetLoader`` is sample-then-bin by design —
+``GreedyFindBin`` fits bin boundaries from a ``bin_construct_sample_cnt``
+row *sample* (src/io/bin.cpp), yet the loader still materializes the whole
+raw matrix first.  This package removes that last O(num_rows x features)
+host allocation:
+
+* **pass 1** draws the one-shot path's exact seeded sample
+  (``rng.choice`` over the known row count — byte-identical sample rows)
+  from a chunked source and fits bin mappers + the EFB bundle layout on
+  the sample only;
+* **pass 2** streams chunks through ``BinMapper.values_to_bins`` straight
+  into the preallocated packed bin planes (optionally ``np.memmap``-backed
+  via ``ingest_mmap_dir``), a thread pool binning chunks in parallel.
+
+Peak host memory is O(max(chunk_rows, sample_cnt) x features) + the packed
+uint8/uint16 planes; the raw float64 matrix never exists.  The acceptance
+oracle is byte parity: a chunk-streamed Dataset produces bit-identical bin
+planes, bundle layout, and trained model dump versus the one-shot path on
+the same data and seed (tests/test_ingest.py).
+
+Sources (``sources.py``): chunked text/CSV, memory-mapped ``.npy``, Arrow
+record-batch slices, pandas frames, ``Sequence`` batches, plain ndarrays,
+and a user-facing ``Dataset(data=[chunk0, chunk1, ...])`` /
+``Dataset(data=callable)`` chunk-iterable path.  Sharded per-host ingest
+(``sharded.py``): under ``pre_partition`` each host reads only its row
+shard and the per-host sample blocks are allgathered (bit-exact f64 over
+the uint8 varlen channel, JSON summaries riding alongside as in
+``obs/aggregate.py``) so every host fits identical global bin mappers.
+"""
+
+from .pipeline import stream_pack
+from .sources import (
+    ChunkSource,
+    StreamingUnsupported,
+    is_chunk_iterable,
+    make_chunk_source,
+    materialize_chunks,
+)
+
+__all__ = [
+    "ChunkSource",
+    "StreamingUnsupported",
+    "is_chunk_iterable",
+    "make_chunk_source",
+    "materialize_chunks",
+    "stream_pack",
+]

@@ -1,0 +1,123 @@
+"""Pass 2 of streaming construction: parallel chunk binning into
+preallocated packed planes.
+
+``stream_pack`` walks a :class:`~.sources.ChunkSource` once and writes each
+chunk's packed bins into its row slice of one preallocated [N, P]
+uint8/uint16 matrix (optionally ``np.memmap``-backed).  Packing is per-row
+— ``pack_columns`` on a chunk equals the corresponding row slice of
+``pack_columns`` on the full matrix — so the result is byte-identical to
+the one-shot path.  Chunks bin on a thread pool (``num_threads``; binning
+is numpy, which releases the GIL) writing disjoint row slices; a bounded
+in-flight window keeps at most a few raw chunks alive at once.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from typing import List, Optional
+
+import numpy as np
+
+from ..obs.registry import get_session
+
+# raw chunks admitted beyond the worker count before the producer blocks;
+# bounds peak memory at ~(num_threads + _BACKLOG) chunks
+_BACKLOG = 2
+
+
+def peak_rss_bytes() -> int:
+    """This process's lifetime peak RSS (``ru_maxrss`` is KB on Linux)."""
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:  # pragma: no cover - non-POSIX
+        return 0
+
+
+def _alloc_bins(n: int, n_cols: int, dtype, mmap_dir: str) -> np.ndarray:
+    if not mmap_dir or n * max(1, n_cols) == 0:
+        return np.zeros((n, n_cols), dtype=dtype)
+    os.makedirs(mmap_dir, exist_ok=True)
+    fd, path = tempfile.mkstemp(
+        prefix="lgbtpu_bins_", suffix=".mmap", dir=mmap_dir
+    )
+    os.close(fd)
+    out = np.memmap(path, dtype=dtype, mode="w+", shape=(n, n_cols))
+    # unlink-after-map: the mapping stays valid and the blocks are
+    # reclaimed when the last reference drops, with nothing left behind
+    # even on a crash (ndarrays take no weakrefs, so no finalizer)
+    os.unlink(path)
+    return out
+
+
+def stream_pack(
+    source,
+    bin_mappers: List,
+    used_features: List[int],
+    layout,
+    dtype,
+    config,
+) -> np.ndarray:
+    """Bin + pack every chunk of ``source`` into one [n_rows, planes]
+    matrix; byte-identical to one-shot packing of the full matrix."""
+    n = source.n_rows
+    n_cols = layout.num_planes if layout is not None else len(used_features)
+    out = _alloc_bins(n, n_cols, dtype, config.ingest_mmap_dir)
+
+    def pack_chunk(start: int, chunk: np.ndarray) -> int:
+        m = chunk.shape[0]
+        if layout is not None:
+            block = layout.pack_columns(
+                m, lambda j: bin_mappers[j].values_to_bins(chunk[:, j])
+            )
+        elif used_features:
+            block = np.stack(
+                [
+                    bin_mappers[j].values_to_bins(chunk[:, j])
+                    for j in used_features
+                ],
+                axis=1,
+            )
+        else:
+            block = np.zeros((m, 0), dtype=np.int32)
+        out[start : start + m] = block
+        return m
+
+    threads = max(1, int(config.num_threads) or (os.cpu_count() or 1))
+    t0 = time.perf_counter()
+    chunks_total = 0
+    if threads == 1:
+        for s, c in source.chunks():
+            pack_chunk(s, c)
+            chunks_total += 1
+    else:
+        inflight = set()
+        with ThreadPoolExecutor(
+            max_workers=threads, thread_name_prefix="lgbtpu-ingest"
+        ) as ex:
+            for s, c in source.chunks():
+                inflight.add(ex.submit(pack_chunk, s, c))
+                chunks_total += 1
+                if len(inflight) > threads + _BACKLOG:
+                    done, inflight = wait(
+                        inflight, return_when=FIRST_COMPLETED
+                    )
+                    for f in done:
+                        f.result()
+            for f in inflight:
+                f.result()
+    elapsed = time.perf_counter() - t0
+    sess = get_session()
+    if sess.enabled:
+        sess.update_gauges(
+            {
+                "ingest/chunks_total": float(chunks_total),
+                "ingest/rows_per_sec": (n / elapsed) if elapsed > 0 else 0.0,
+                "ingest/peak_rss_bytes": float(peak_rss_bytes()),
+            }
+        )
+    return out

@@ -1,0 +1,88 @@
+"""Sharded per-host streaming ingest: one GLOBAL sample, identical mappers.
+
+Under ``pre_partition`` (``tree_learner=data``) each host reads only its
+row shard, so per-host samples would fit disagreeing bin mappers.  The
+one-shot path solves this with a per-feature mapper allgather
+(``_sync_mappers_across_processes``); the streamed path instead assembles
+the GLOBAL seeded sample on every host, after which mapper fitting — and,
+unlike the one-shot path, EFB bundling — is an identical local
+computation everywhere:
+
+* per-host shard summaries (row count + the sampling knobs every host
+  must agree on) ride as JSON-over-uint8 on
+  ``parallel.allgather_host_varlen`` — the same channel
+  ``obs/aggregate.py`` uses for telemetry snapshots;
+* the global sample rows are drawn from the summed row count with the
+  one-shot rng (`default_rng(data_random_seed).choice`) — byte-identical
+  to a single-host draw over the concatenated matrix;
+* each host gathers its owned sampled rows and the float64 blocks are
+  allgathered bit-exactly (8-byte payloads ride ``allgather_host_exact``
+  as uint32 pairs).  Rank shards own ascending global row ranges, so the
+  rank-order concatenation IS the row-sorted global sample.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Tuple
+
+import numpy as np
+
+
+def exchange_global_sample(source, config) -> Tuple[int, int, np.ndarray]:
+    """Returns ``(global_n, row_offset, sample)``: the shard's global row
+    offset and the [sample_cnt, F] sample matrix, identical on every host
+    and byte-identical to the one-shot single-host draw."""
+    import jax
+
+    from ..parallel import allgather_host_varlen
+
+    rank = jax.process_index()
+    summary = {
+        "process": int(rank),
+        "rows": int(source.n_rows),
+        "cols": int(source.n_cols),
+        "seed": int(config.data_random_seed),
+        "sample_cnt": int(config.bin_construct_sample_cnt),
+    }
+    payload = np.frombuffer(
+        json.dumps(summary, sort_keys=True).encode("utf-8"), dtype=np.uint8
+    )
+    gathered, counts = allgather_host_varlen(payload, return_counts=True)
+    summaries = []
+    off = 0
+    for c in counts:
+        c = int(c)
+        summaries.append(
+            json.loads(bytes(gathered[off : off + c]).decode("utf-8"))
+        )
+        off += c
+    for s in summaries:
+        for key in ("seed", "sample_cnt"):
+            if s[key] != summary[key]:
+                raise ValueError(
+                    f"sharded ingest {key} disagrees across hosts: "
+                    f"process {s['process']} has {s[key]}, "
+                    f"process {rank} has {summary[key]}"
+                )
+        if s["cols"] != summary["cols"]:
+            raise ValueError(
+                "sharded ingest shards disagree on feature count: "
+                f"process {s['process']} has {s['cols']} columns, "
+                f"process {rank} has {summary['cols']}"
+            )
+    rows_per_host = [int(s["rows"]) for s in summaries]  # process order
+    global_n = int(sum(rows_per_host))
+    offset = int(sum(rows_per_host[:rank]))
+
+    sample_cnt = min(global_n, int(config.bin_construct_sample_cnt))
+    if sample_cnt < global_n:
+        rng = np.random.default_rng(config.data_random_seed)
+        rows = np.sort(rng.choice(global_n, size=sample_cnt, replace=False))
+    else:
+        rows = np.arange(global_n, dtype=np.int64)
+    lo = np.searchsorted(rows, offset)
+    hi = np.searchsorted(rows, offset + source.n_rows)
+    local_block = source.sample_rows(np.asarray(rows[lo:hi]) - offset)
+    sample = allgather_host_varlen(np.ascontiguousarray(local_block))
+    return global_n, offset, sample

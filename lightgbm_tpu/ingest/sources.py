@@ -1,0 +1,516 @@
+"""Chunked row sources for streaming Dataset construction.
+
+Every source yields float64 FEATURE chunks ``(row_start, [m, n_cols])`` in
+row order and must be re-iterable: the two-pass pipeline reads the sample
+in pass 1 (``sample_rows`` — sources with random access gather directly;
+the rest replay their chunks) and streams every row in pass 2
+(``chunks()``).  Conversion to float64 happens per chunk, which is the
+whole point — it is elementwise, so the binned output is byte-identical
+to converting the full matrix at once, without ever holding that matrix.
+
+The text source additionally collects the one-shot text loader's per-row
+fields (label / weight_column / group_column plus the ``.query``/
+``.weight``/``.init``/``.position`` sidecars) while pass 2 streams by, and
+serves them from :meth:`row_fields` afterwards.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+# chunk granularity when a source must stream but the knob is unset
+# (chunk-iterable data with ingest_chunk_rows=0)
+DEFAULT_CHUNK_ROWS = 65536
+
+
+class StreamingUnsupported(Exception):
+    """Raised when a source cannot stream (LibSVM text, parser plugins,
+    sparse matrices); the caller falls back to the one-shot path."""
+
+
+class ChunkSource:
+    """Re-iterable chunked view over a row-major data source."""
+
+    n_rows: int = 0
+    n_cols: int = 0
+    # features forced trivial (weight/group/ignore columns); text sources
+    # resolve these up front so pass-1 mapper fitting can honor them
+    ignore_features: Tuple[int, ...] = ()
+
+    def chunks(self) -> Iterator[Tuple[int, np.ndarray]]:
+        raise NotImplementedError
+
+    def sample_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Gather ``rows`` (sorted global row indices) as an [k, n_cols]
+        float64 matrix.  Default: replay chunks and gather; random-access
+        sources override with a direct fancy index."""
+        rows = np.asarray(rows, dtype=np.int64)
+        out = np.empty((len(rows), self.n_cols), np.float64)
+        for start, chunk in self.chunks():
+            lo = np.searchsorted(rows, start)
+            hi = np.searchsorted(rows, start + len(chunk))
+            if lo < hi:
+                out[lo:hi] = chunk[rows[lo:hi] - start]
+        return out
+
+    def row_fields(self) -> Dict[str, Any]:
+        """Per-row fields discovered while streaming (text sources: label,
+        weight, group, sidecars).  Valid only after ``chunks()`` has been
+        fully consumed at least once."""
+        return {}
+
+
+class ArrayChunkSource(ChunkSource):
+    """ndarray / np.memmap source: slices convert to float64 per chunk."""
+
+    def __init__(self, arr, chunk_rows: int) -> None:
+        if getattr(arr, "ndim", None) != 2:
+            raise ValueError(f"data must be 2-D, got shape {getattr(arr, 'shape', None)}")
+        self._arr = arr
+        self._chunk_rows = max(1, int(chunk_rows))
+        self.n_rows, self.n_cols = arr.shape
+
+    def chunks(self):
+        for s in range(0, self.n_rows, self._chunk_rows):
+            e = min(self.n_rows, s + self._chunk_rows)
+            yield s, np.asarray(self._arr[s:e], dtype=np.float64)
+
+    def sample_rows(self, rows):
+        rows = np.asarray(rows, dtype=np.int64)
+        return np.asarray(self._arr[rows], dtype=np.float64)
+
+
+class ChunkListSource(ChunkSource):
+    """User-provided chunk iterable: a list/tuple of 2-D row blocks.
+
+    The blocks define the chunk granularity; a ragged last block is fine.
+    """
+
+    def __init__(self, parts) -> None:
+        parts = list(parts)
+        if not parts:
+            raise ValueError("empty chunk list")
+        shapes = []
+        for p in parts:
+            if getattr(p, "ndim", None) != 2:
+                raise ValueError(
+                    "chunked data must be a sequence of 2-D row blocks; got "
+                    f"a block of shape {getattr(p, 'shape', None)}"
+                )
+            shapes.append(p.shape)
+        widths = {s[1] for s in shapes}
+        if len(widths) != 1:
+            raise ValueError(f"chunk column counts disagree: {sorted(widths)}")
+        self._parts = parts
+        self.n_rows = sum(s[0] for s in shapes)
+        self.n_cols = widths.pop()
+
+    def chunks(self):
+        start = 0
+        for p in self._parts:
+            yield start, np.asarray(p, dtype=np.float64)
+            start += p.shape[0]
+
+
+class CallableChunkSource(ChunkSource):
+    """``data=callable``: each call must return a FRESH iterator of 2-D row
+    blocks (the two-pass build iterates more than once).  One extra probe
+    iteration establishes the row count the seeded sample draw needs."""
+
+    def __init__(self, fn) -> None:
+        self._fn = fn
+        n = 0
+        cols: Optional[int] = None
+        for p in self._iter_blocks():
+            n += p.shape[0]
+            if cols is None:
+                cols = p.shape[1]
+            elif cols != p.shape[1]:
+                raise ValueError(
+                    f"chunk column counts disagree: {cols} vs {p.shape[1]}"
+                )
+        if cols is None:
+            raise ValueError("chunk callable yielded no chunks")
+        self.n_rows, self.n_cols = n, cols
+
+    def _iter_blocks(self):
+        it = self._fn()
+        if it is None or not hasattr(it, "__iter__"):
+            raise ValueError(
+                "chunk callable must return an iterable of 2-D row blocks"
+            )
+        for p in it:
+            p = np.asarray(p)
+            if p.ndim != 2:
+                raise ValueError(
+                    f"chunk callable yielded a block of shape {p.shape}; "
+                    "2-D row blocks expected"
+                )
+            yield p
+
+    def chunks(self):
+        start = 0
+        for p in self._iter_blocks():
+            yield start, np.asarray(p, dtype=np.float64)
+            start += p.shape[0]
+        if start != self.n_rows:
+            raise ValueError(
+                f"chunk callable is not re-iterable: {start} rows on replay "
+                f"vs {self.n_rows} on the first pass (generators exhaust; "
+                "return a fresh iterator per call)"
+            )
+
+
+class SequenceChunkSource(ChunkSource):
+    """lightgbm Sequence sources, streamed batch-by-batch instead of
+    materialized (the batches are the same slices
+    ``_materialize_sequences`` takes, so values match elementwise)."""
+
+    def __init__(self, seqs) -> None:
+        self._seqs = list(seqs)
+        self.n_rows = sum(len(s) for s in self._seqs)
+        first = np.asarray(self._seqs[0][slice(0, 1)])
+        if first.ndim != 2:
+            raise ValueError(
+                f"Sequence rows must be 2-D slices, got shape {first.shape}"
+            )
+        self.n_cols = first.shape[1]
+
+    def chunks(self):
+        start = 0
+        for seq in self._seqs:
+            n = len(seq)
+            bs = getattr(seq, "batch_size", None) or 4096
+            for s in range(0, n, bs):
+                part = np.asarray(seq[slice(s, min(s + bs, n))])
+                yield start, np.asarray(part, dtype=np.float64)
+                start += part.shape[0]
+
+
+class ArrowChunkSource(ChunkSource):
+    """pyarrow Table/RecordBatch, converted slice-by-slice.
+
+    The table is combined once up front so every slice shares ONE unified
+    dictionary per categorical column — slice conversions then reuse the
+    recorded category order verbatim and the float codes are identical to
+    a full-table ``_arrow_to_numpy``.
+    """
+
+    def __init__(self, data, chunk_rows: int, ref_maps=None) -> None:
+        import pyarrow as pa
+
+        if isinstance(data, pa.RecordBatch):
+            data = pa.Table.from_batches([data])
+        self._table = data.combine_chunks()
+        self._chunk_rows = max(1, int(chunk_rows))
+        self.n_rows = self._table.num_rows
+        self.n_cols = self._table.num_columns
+        self.names = [str(c) for c in self._table.schema.names]
+        self.cats = [
+            self.names[i]
+            for i, f in enumerate(self._table.schema)
+            if pa.types.is_dictionary(f.type)
+        ]
+        if ref_maps is not None:
+            self.category_maps = ref_maps
+        else:
+            # record the unified dictionaries only — no row data touched
+            self.category_maps = {}
+            for i, f in enumerate(self._table.schema):
+                if pa.types.is_dictionary(f.type):
+                    cc = self._table.column(i).combine_chunks()
+                    self.category_maps[self.names[i]] = [
+                        v.as_py() for v in cc.dictionary
+                    ]
+
+    def _convert(self, tbl) -> np.ndarray:
+        from ..dataset import _arrow_to_numpy
+
+        mat, _names, _cats, _maps = _arrow_to_numpy(tbl, self.category_maps)
+        return mat
+
+    def chunks(self):
+        for s in range(0, self.n_rows, self._chunk_rows):
+            m = min(self.n_rows, s + self._chunk_rows) - s
+            yield s, self._convert(self._table.slice(s, m))
+
+    def sample_rows(self, rows):
+        rows = np.asarray(rows, dtype=np.int64)
+        return self._convert(self._table.take(rows))
+
+
+class PandasChunkSource(ChunkSource):
+    """pandas DataFrame, converted ``iloc`` slice-by-slice through the
+    full-column category record (float codes match a full-frame
+    ``_pandas_to_numpy`` by value)."""
+
+    def __init__(self, df, chunk_rows: int, ref_maps=None) -> None:
+        from ..dataset import _is_cat_dtype
+
+        self._df = df
+        self._chunk_rows = max(1, int(chunk_rows))
+        self.n_rows, self.n_cols = len(df), len(df.columns)
+        self.names = [str(c) for c in df.columns]
+        self.cats = [
+            str(c) for c in df.columns if _is_cat_dtype(df[c].dtype)
+        ]
+        if ref_maps is not None:
+            self.category_maps = ref_maps
+        else:
+            self.category_maps = {}
+            for name in self.cats:
+                cc = df[name].astype("category")
+                self.category_maps[name] = [
+                    v.item() if hasattr(v, "item") else v
+                    for v in cc.cat.categories
+                ]
+
+    def _convert(self, frame) -> np.ndarray:
+        from ..dataset import _pandas_to_numpy
+
+        mat, _cats, _maps = _pandas_to_numpy(frame, self.category_maps)
+        return mat
+
+    def chunks(self):
+        for s in range(0, self.n_rows, self._chunk_rows):
+            e = min(self.n_rows, s + self._chunk_rows)
+            yield s, self._convert(self._df.iloc[s:e])
+
+    def sample_rows(self, rows):
+        rows = np.asarray(rows, dtype=np.int64)
+        return self._convert(self._df.iloc[rows])
+
+
+class TextChunkSource(ChunkSource):
+    """Chunked CSV/TSV reader with one-shot ``_load_text_file`` parity.
+
+    Three streaming passes over the file, none holding more than a chunk:
+    a line-count probe (the seeded sample draw needs the row count up
+    front), a pass-1 gather that parses ONLY the sampled lines, and the
+    pass-2 full parse that also collects label / weight_column /
+    group_column.  Values go through the same ``np.loadtxt`` parser as the
+    one-shot path, fed batches of lines instead of the whole file.
+    """
+
+    def __init__(self, path: str, config, chunk_rows: int) -> None:
+        from ..dataset import (
+            _is_libsvm_row,
+            _label_column_index,
+            _resolve_data_columns,
+        )
+
+        self._path = str(path)
+        self._config = config
+        self._chunk_rows = max(1, int(chunk_rows))
+        if config.parser_config_file:
+            raise StreamingUnsupported("parser plugins load one-shot")
+        self._skip = 1 if config.header else 0
+        with open(self._path, "r") as fh:
+            first = fh.readline().rstrip("\n").rstrip("\r")
+            probe: List[str] = []
+            fh.seek(0)
+            for i, ln in enumerate(fh):
+                if i < self._skip:
+                    continue
+                if ln.strip():
+                    probe.append(ln)
+                if len(probe) >= 20:
+                    break
+        header_line = first if (config.header and first) else None
+        if probe and any(_is_libsvm_row(ln) for ln in probe):
+            raise StreamingUnsupported("LibSVM loads through the sparse path")
+        self._delim = "\t" if "\t" in first else ("," if "," in first else None)
+        self._label_col = _label_column_index(config, header_line)
+        self._wcols = _resolve_data_columns(
+            config.weight_column, header_line, self._label_col, "weight_column"
+        )
+        self._gcols = _resolve_data_columns(
+            config.group_column, header_line, self._label_col, "group_column"
+        )
+        self._icols = _resolve_data_columns(
+            config.ignore_column, header_line, self._label_col, "ignore_column"
+        )
+        ignore_raw = self._wcols[:1] + self._gcols[:1] + self._icols
+        lc = self._label_col
+        self.ignore_features = tuple(
+            sorted({c - (1 if c > lc else 0) for c in ignore_raw if c != lc})
+        )
+        # count pass: rows np.loadtxt would parse (blank/comment lines drop)
+        n = 0
+        for _ in self._data_lines():
+            n += 1
+        self.n_rows = n
+        probe_arr = self._parse(probe[:1]) if probe else np.zeros((0, 1))
+        self.n_cols = probe_arr.shape[1] - 1  # label column removed
+        self._fields: Optional[Dict[str, Any]] = None
+
+    def _data_lines(self) -> Iterator[str]:
+        """The file's parseable data lines, comment-stripped — exactly the
+        rows ``np.loadtxt`` (comments='#') yields for the whole file."""
+        with open(self._path, "r") as fh:
+            for i, ln in enumerate(fh):
+                if i < self._skip:
+                    continue
+                ln = ln.split("#", 1)[0].strip()
+                if ln:
+                    yield ln
+
+    def _parse(self, lines: List[str]) -> np.ndarray:
+        return np.loadtxt(
+            lines, delimiter=self._delim, dtype=np.float64, ndmin=2
+        )
+
+    def sample_rows(self, rows):
+        rows = np.asarray(rows, dtype=np.int64)
+        wanted = set(rows.tolist())
+        picked = [
+            ln for i, ln in enumerate(self._data_lines()) if i in wanted
+        ]
+        arr = self._parse(picked)
+        return np.delete(arr, self._label_col, axis=1)
+
+    def chunks(self):
+        collect = self._fields is None
+        labels: List[np.ndarray] = []
+        weights: List[np.ndarray] = []
+        qids: List[np.ndarray] = []
+        start = 0
+        batch: List[str] = []
+        for ln in self._data_lines():
+            batch.append(ln)
+            if len(batch) >= self._chunk_rows:
+                start = yield from self._emit(
+                    batch, start, collect, labels, weights, qids
+                )
+                batch = []
+        if batch:
+            start = yield from self._emit(
+                batch, start, collect, labels, weights, qids
+            )
+        if collect:
+            self._fields = self._assemble_fields(labels, weights, qids)
+
+    def _emit(self, batch, start, collect, labels, weights, qids):
+        arr = self._parse(batch)
+        if collect:
+            # copy: a column view would pin the whole parsed chunk alive
+            # until pass-2 ends, rebuilding the matrix we're streaming out
+            labels.append(arr[:, self._label_col].copy())
+            if self._wcols:
+                weights.append(arr[:, self._wcols[0]].astype(np.float64))
+            if self._gcols:
+                qids.append(arr[:, self._gcols[0]].astype(np.int64))
+        yield start, np.delete(arr, self._label_col, axis=1)
+        return start + arr.shape[0]
+
+    def _assemble_fields(self, labels, weights, qids) -> Dict[str, Any]:
+        from ..dataset import _attach_sidecars
+
+        out: Dict[str, Any] = {
+            "label": (
+                np.concatenate(labels) if labels else np.zeros(0, np.float64)
+            )
+        }
+        if self._wcols:
+            out["weight"] = np.concatenate(weights)
+        if self._gcols:
+            # consecutive query-id runs -> sizes (Metadata::SetQueryId)
+            q = np.concatenate(qids)
+            change = np.nonzero(np.diff(q))[0] + 1
+            bounds = np.concatenate([[0], change, [len(q)]])
+            out["group"] = np.diff(bounds)
+        if self.ignore_features:
+            out["ignore"] = list(self.ignore_features)
+        return _attach_sidecars(out, self._path)
+
+    def row_fields(self) -> Dict[str, Any]:
+        if self._fields is None:
+            raise RuntimeError("row_fields() before pass-2 iteration")
+        return self._fields
+
+
+def is_chunk_iterable(data) -> bool:
+    """True for the explicit chunked-data API: a list/tuple of 2-D row
+    blocks, or a callable returning a fresh iterator of them."""
+    if callable(data) and not isinstance(data, type) and not hasattr(
+        data, "__array__"
+    ):
+        return True
+    return isinstance(data, (list, tuple)) and bool(data) and all(
+        isinstance(p, np.ndarray) and p.ndim == 2 for p in data
+    )
+
+
+def materialize_chunks(data):
+    """Chunk-iterable -> one dense float64 matrix: the one-shot fallback
+    when streaming is declined (linear_tree / free_raw_data=false need the
+    raw matrix anyway).  Non-chunk-iterable data passes through."""
+    if not is_chunk_iterable(data):
+        return data
+    src = (
+        CallableChunkSource(data) if callable(data) else ChunkListSource(data)
+    )
+    return np.concatenate([c for _, c in src.chunks()], axis=0)
+
+
+def _all_sequences(data) -> bool:
+    from ..dataset import Sequence
+
+    return isinstance(data, list) and bool(data) and all(
+        isinstance(d, Sequence) for d in data
+    )
+
+
+def make_chunk_source(data, config, ref_maps=None) -> Optional[ChunkSource]:
+    """A ChunkSource for ``data``, or None for the one-shot path.
+
+    Chunk-iterable inputs (list/tuple of row blocks, or a callable
+    returning a fresh block iterator) ALWAYS stream — they are the
+    explicit out-of-core API.  Everything else streams only when
+    ``ingest_chunk_rows > 0``: text/CSV files, ``.npy`` (memory-mapped),
+    ndarrays, Sequences, Arrow tables and pandas frames.  Sparse matrices
+    bin through the CSC path, which never densifies anyway.
+    """
+    from ..dataset import Sequence, _is_arrow
+
+    chunk_rows = int(config.ingest_chunk_rows) or DEFAULT_CHUNK_ROWS
+    if callable(data) and not isinstance(data, type) and not hasattr(
+        data, "__array__"
+    ):
+        return CallableChunkSource(data)
+    if isinstance(data, (list, tuple)) and data and all(
+        isinstance(p, np.ndarray) and getattr(p, "ndim", 0) == 2
+        for p in data
+    ):
+        return ChunkListSource(data)
+    if config.ingest_chunk_rows <= 0:
+        return None
+    if isinstance(data, (str, Path)):
+        p = str(data)
+        if p.endswith(".npy"):
+            return ArrayChunkSource(
+                np.load(p, mmap_mode="r"), chunk_rows
+            )
+        try:
+            return TextChunkSource(p, config, chunk_rows)
+        except StreamingUnsupported:
+            return None
+    if isinstance(data, Sequence):
+        return SequenceChunkSource([data])
+    if _all_sequences(data):
+        return SequenceChunkSource(data)
+    if _is_arrow(data):
+        return ArrowChunkSource(data, chunk_rows, ref_maps)
+    try:
+        import pandas as pd  # noqa: F401
+
+        if isinstance(data, pd.DataFrame):
+            return PandasChunkSource(data, chunk_rows, ref_maps)
+    except ImportError:
+        pass
+    if isinstance(data, np.ndarray) and data.ndim == 2:
+        return ArrayChunkSource(data, chunk_rows)
+    return None

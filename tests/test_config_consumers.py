@@ -28,10 +28,10 @@ PKG = pathlib.Path(__file__).resolve().parents[1] / "lightgbm_tpu"
 # consumer must be removed from here (the test enforces staleness too);
 # a field that loses its consumer must either be rewired or documented.
 NOT_APPLICABLE = {
-    # threading/layout knobs: XLA owns scheduling and the dataset is ONE
-    # dense [N, P] device matrix, so there is no thread pool and no
-    # row-wise/col-wise histogram layout choice to force
-    "num_threads": "XLA owns scheduling; no host thread pool to size",
+    # layout knobs: the dataset is ONE dense [N, P] device matrix, so
+    # there is no row-wise/col-wise histogram layout choice to force
+    # (num_threads is no longer listed: the streaming ingest thread pool
+    # sizes itself from it, lightgbm_tpu/ingest/pipeline.py)
     "force_col_wise": "single dense bin matrix; no layout duel to force",
     "force_row_wise": "single dense bin matrix; no layout duel to force",
     "histogram_pool_size": "histograms live in HBM/VMEM per kernel launch; "

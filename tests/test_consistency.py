@@ -409,11 +409,36 @@ def test_position_debias_golden_parity():
         "eval_at": [3], "lambdarank_position_bias_regularization": 0.5,
     }
     ds = lgb.Dataset(str(GOLDEN / "position.train.csv"), params=params)
-    ev = {}
-    lgb.train(
-        params, ds, 10, valid_sets=[ds], valid_names=["training"],
-        callbacks=[lgb.record_evaluation(ev)],
-    )
+    # Train under a PRIVATE persistent-compilation-cache dir.  The 3/8
+    # "flake" this test had was never model nondeterminism: with the cache
+    # off, the trained model dump is bit-identical across PYTHONHASHSEED
+    # values and device counts.  The machine-wide /tmp/lgbm_jax_cache the
+    # suite shares (conftest.py) is also written by non-suite processes
+    # (bench, smokes, debug shells) under other XLA topologies, and certain
+    # cache states serve this test's lambdarank programs an executable
+    # whose scores go NON-FINITE (observed: booster._score NaN, trees stop
+    # growing, ndcg frozen ~0.63-0.84).  Which entry gets hit varies with
+    # PYTHONHASHSEED via jaxpr-metadata ordering in the cache key — hence
+    # the intermittent look.  A fresh private dir makes the quality bar
+    # deterministic again (compile-from-scratch, ~3 s).
+    import tempfile
+
+    import jax
+    from jax.experimental.compilation_cache import compilation_cache as _cc
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    with tempfile.TemporaryDirectory() as td:
+        try:
+            _cc.reset_cache()
+            jax.config.update("jax_compilation_cache_dir", td)
+            ev = {}
+            lgb.train(
+                params, ds, 10, valid_sets=[ds], valid_names=["training"],
+                callbacks=[lgb.record_evaluation(ev)],
+            )
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+            _cc.reset_cache()
     key = next(k for k in ev["training"] if "ndcg" in k)
     ours = ev["training"][key][-1]
     assert ours >= ref_ndcg * 0.95, (ours, ref_ndcg)

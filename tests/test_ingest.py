@@ -1,0 +1,639 @@
+"""Streaming ingest (lightgbm_tpu/ingest): byte-parity battery.
+
+The acceptance oracle is BYTE parity: a chunk-streamed Dataset must produce
+bit-identical packed bin planes, bundle layout and trained model dump
+versus the one-shot path on the same data and seed — across source kinds
+(text/CSV, ndarray, memory-mapped ``.npy``, chunk iterables with a ragged
+last chunk, Sequences, Arrow, pandas), under ``np.memmap``-backed planes,
+and through training with bagging/GOSS.  A subprocess peak-RSS drill
+proves the raw float64 matrix never materializes, and a two-process
+launcher drill proves sharded per-host ingest fits globally consistent
+mappers.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+REPO_ROOT = str(Path(__file__).resolve().parents[1])
+
+PARAMS = {
+    "objective": "binary",
+    "num_leaves": 15,
+    "verbose": -1,
+    "bin_construct_sample_cnt": 800,
+    "data_random_seed": 1,
+    "min_data_in_leaf": 5,
+}
+
+
+def _mkdata(n=4000, f=10, seed=7):
+    """Dense + sparse + integer columns, so EFB bundling and quantile
+    binning both have something to do."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    X[:, 2] = (rng.random(n) < 0.04) * rng.normal(size=n)
+    X[:, 3] = (rng.random(n) < 0.04) * rng.normal(size=n)
+    ji = min(5, f - 1)
+    X[:, ji] = rng.integers(0, 6, n)
+    y = (
+        X[:, 0] + 0.3 * X[:, ji] + rng.normal(scale=0.1, size=n) > 0.2
+    ).astype(np.float64)
+    return X, y
+
+
+def _strip_ingest(dump: str) -> str:
+    # the knob itself appears in the model's params section; everything
+    # else (trees, mappers, feature infos) must match bit-for-bit
+    return "\n".join(
+        ln for ln in dump.splitlines() if not ln.startswith("[ingest_")
+    )
+
+
+def _assert_ds_parity(ds_ref, ds_stream):
+    assert ds_ref.bins.dtype == ds_stream.bins.dtype
+    assert np.array_equal(np.asarray(ds_ref.bins), np.asarray(ds_stream.bins))
+    l1, l2 = ds_ref.bundle_layout, ds_stream.bundle_layout
+    assert (l1 is None) == (l2 is None)
+    if l1 is not None:
+        assert [list(p) for p in l1.planes] == [list(p) for p in l2.planes]
+        assert list(l1.plane_bins) == list(l2.plane_bins)
+    assert ds_ref.used_features == ds_stream.used_features
+    for m1, m2 in zip(ds_ref.bin_mappers, ds_stream.bin_mappers):
+        assert m1.num_bins == m2.num_bins
+        assert np.array_equal(
+            np.asarray(m1.bin_upper_bound), np.asarray(m2.bin_upper_bound)
+        )
+
+
+@pytest.mark.parametrize("chunk_rows", [777, 4000, 64])
+def test_ndarray_chunked_parity(chunk_rows):
+    """Streamed ndarray binning == one-shot, including a ragged last chunk
+    (777 ∤ 4000), a single whole-data chunk, and many tiny chunks."""
+    X, y = _mkdata()
+    ds1 = lgb.Dataset(X.copy(), y, params=PARAMS).construct()
+    p2 = dict(PARAMS, ingest_chunk_rows=chunk_rows)
+    ds2 = lgb.Dataset(X.copy(), y, params=p2).construct()
+    _assert_ds_parity(ds1, ds2)
+
+
+def test_model_dump_parity_and_sample_determinism():
+    X, y = _mkdata()
+    p2 = dict(PARAMS, ingest_chunk_rows=600)
+    b1 = lgb.train(PARAMS, lgb.Dataset(X.copy(), y, params=PARAMS), 8)
+    b2 = lgb.train(p2, lgb.Dataset(X.copy(), y, params=p2), 8)
+    b3 = lgb.train(p2, lgb.Dataset(X.copy(), y, params=p2), 8)
+    d1 = _strip_ingest(b1.model_to_string())
+    d2 = _strip_ingest(b2.model_to_string())
+    assert d1 == d2
+    # the seeded pass-1 sample draw is deterministic: rebuilding from the
+    # same chunks gives the identical model, not just close bins
+    assert b2.model_to_string() == b3.model_to_string()
+
+
+def test_text_csv_parity_with_weight_group_columns(tmp_path):
+    """Chunked text ingest threads label + weight_column + group_column
+    and the ``.init`` sidecar identically to the one-shot loader."""
+    rng = np.random.default_rng(3)
+    n = 2500
+    X, y = _mkdata(n=n)
+    w = rng.random(n) + 0.5
+    qid = np.repeat(np.arange(n // 25), 25).astype(np.float64)
+    raw = np.column_stack([y, X, w, qid])
+    csv = tmp_path / "train.csv"
+    np.savetxt(csv, raw, delimiter=",")
+    (tmp_path / "train.csv.init").write_text(
+        "\n".join(str(v) for v in rng.normal(size=n))
+    )
+    ncol = raw.shape[1]
+    params = dict(
+        PARAMS,
+        weight_column=ncol - 2 - 1,  # data-column index (label not counted)
+        group_column=ncol - 1 - 1,
+    )
+    ds1 = lgb.Dataset(str(csv), params=params).construct()
+    ds2 = lgb.Dataset(
+        str(csv), params=dict(params, ingest_chunk_rows=611)
+    ).construct()
+    _assert_ds_parity(ds1, ds2)
+    assert np.array_equal(ds1.metadata.label, ds2.metadata.label)
+    assert np.array_equal(ds1.metadata.weight, ds2.metadata.weight)
+    assert np.array_equal(ds1.metadata.init_score, ds2.metadata.init_score)
+    assert np.array_equal(
+        ds1.metadata.query_boundaries, ds2.metadata.query_boundaries
+    )
+
+
+def test_text_blank_and_comment_lines(tmp_path):
+    """np.loadtxt drops blank and '#' lines; the chunked line reader must
+    count and parse the same surviving rows."""
+    X, y = _mkdata(n=300, f=4)
+    csv = tmp_path / "gaps.csv"
+    rows = [
+        ",".join(f"{v:.10g}" for v in np.concatenate([[y[i]], X[i]]))
+        for i in range(300)
+    ]
+    rows.insert(100, "")
+    rows.insert(200, "# a comment line")
+    rows.append("")
+    csv.write_text("\n".join(rows) + "\n")
+    ds1 = lgb.Dataset(str(csv), params=PARAMS).construct()
+    ds2 = lgb.Dataset(
+        str(csv), params=dict(PARAMS, ingest_chunk_rows=97)
+    ).construct()
+    assert ds1.num_data == 300
+    _assert_ds_parity(ds1, ds2)
+    assert np.array_equal(ds1.metadata.label, ds2.metadata.label)
+
+
+def test_chunk_iterable_list_and_callable():
+    """Dataset(data=[chunk0, chunk1, ...]) and Dataset(data=callable)
+    stream without the knob — the explicit out-of-core API."""
+    X, y = _mkdata()
+    ds1 = lgb.Dataset(X.copy(), y, params=PARAMS).construct()
+    chunks = [X[:1100], X[1100:1100], X[1100:3999], X[3999:]]  # empty + ragged
+    ds2 = lgb.Dataset([c.copy() for c in chunks], y, params=PARAMS).construct()
+    _assert_ds_parity(ds1, ds2)
+
+    def gen():
+        for c in chunks:
+            yield c.copy()
+
+    ds3 = lgb.Dataset(gen, y, params=PARAMS).construct()
+    _assert_ds_parity(ds1, ds3)
+
+
+def test_chunk_callable_must_be_reiterable():
+    X, y = _mkdata(n=500)
+    g = iter([X[:300], X[300:]])
+    with pytest.raises(ValueError, match="re-iterable|fresh iterator"):
+        lgb.Dataset(lambda: g, y, params=PARAMS).construct()
+
+
+def test_chunk_width_mismatch_rejected():
+    X, y = _mkdata(n=500)
+    with pytest.raises(ValueError, match="column counts disagree"):
+        lgb.Dataset([X[:300], X[300:, :5]], y, params=PARAMS).construct()
+
+
+def test_negative_chunk_rows_rejected():
+    X, y = _mkdata(n=100)
+    with pytest.raises(ValueError, match="ingest_chunk_rows"):
+        lgb.Dataset(
+            X, y, params=dict(PARAMS, ingest_chunk_rows=-1)
+        ).construct()
+
+
+def test_npy_mmap_source_parity(tmp_path):
+    """.npy files stream through np.load(mmap_mode='r') — chunk slices read
+    from disk; parity vs one-shot binning of the loaded array."""
+    X, y = _mkdata()
+    npy = tmp_path / "x.npy"
+    np.save(npy, X)
+    ds1 = lgb.Dataset(X.copy(), y, params=PARAMS).construct()
+    ds2 = lgb.Dataset(
+        str(npy), y, params=dict(PARAMS, ingest_chunk_rows=500)
+    ).construct()
+    _assert_ds_parity(ds1, ds2)
+
+
+def test_sequence_source_parity():
+    class Seq(lgb.Sequence):
+        batch_size = 256
+
+        def __init__(self, arr):
+            self.arr = arr
+
+        def __getitem__(self, idx):
+            return self.arr[idx]
+
+        def __len__(self):
+            return len(self.arr)
+
+    X, y = _mkdata()
+    ds1 = lgb.Dataset(X.copy(), y, params=PARAMS).construct()
+    ds2 = lgb.Dataset(
+        Seq(X), y, params=dict(PARAMS, ingest_chunk_rows=1)
+    ).construct()
+    _assert_ds_parity(ds1, ds2)
+    ds3 = lgb.Dataset(
+        [Seq(X[:1500]), Seq(X[1500:])],
+        y,
+        params=dict(PARAMS, ingest_chunk_rows=1),
+    ).construct()
+    _assert_ds_parity(ds1, ds3)
+
+
+def test_pandas_source_parity():
+    pd = pytest.importorskip("pandas")
+    X, y = _mkdata()
+    df = pd.DataFrame(X, columns=[f"f{i}" for i in range(X.shape[1])])
+    df["cat"] = pd.Categorical(
+        np.random.default_rng(5).choice(["a", "b", "c"], len(df))
+    )
+    ds1 = lgb.Dataset(df.copy(), y, params=PARAMS).construct()
+    ds2 = lgb.Dataset(
+        df.copy(), y, params=dict(PARAMS, ingest_chunk_rows=700)
+    ).construct()
+    _assert_ds_parity(ds1, ds2)
+    assert ds1.pandas_categorical == ds2.pandas_categorical
+    assert ds1.feature_names == ds2.feature_names
+
+
+def test_arrow_source_parity():
+    pa = pytest.importorskip("pyarrow")
+    X, y = _mkdata()
+    cols = {f"f{i}": X[:, i] for i in range(X.shape[1])}
+    cols["dict"] = pa.array(
+        np.random.default_rng(6).choice(["u", "v", "w"], len(X))
+    ).dictionary_encode()
+    tbl = pa.table(cols)
+    ds1 = lgb.Dataset(tbl, y, params=PARAMS).construct()
+    ds2 = lgb.Dataset(
+        tbl, y, params=dict(PARAMS, ingest_chunk_rows=700)
+    ).construct()
+    _assert_ds_parity(ds1, ds2)
+    assert ds1.arrow_categories == ds2.arrow_categories
+
+
+def test_memmap_backed_bins_parity(tmp_path):
+    """ingest_mmap_dir puts the packed planes on disk (unlinked-after-map:
+    nothing is left behind) with byte-identical contents."""
+    X, y = _mkdata()
+    ds1 = lgb.Dataset(X.copy(), y, params=PARAMS).construct()
+    mdir = tmp_path / "spill"
+    p2 = dict(PARAMS, ingest_chunk_rows=640, ingest_mmap_dir=str(mdir))
+    ds2 = lgb.Dataset(X.copy(), y, params=p2).construct()
+    assert isinstance(ds2.bins, np.memmap)
+    _assert_ds_parity(ds1, ds2)
+    assert list(mdir.iterdir()) == []  # spill file already unlinked
+    # training from memmap-backed planes matches, end to end
+    b1 = lgb.train(PARAMS, lgb.Dataset(X.copy(), y, params=PARAMS), 5)
+    b2 = lgb.train(p2, lgb.Dataset(X.copy(), y, params=p2), 5)
+    assert _strip_ingest(b1.model_to_string()) == _strip_ingest(
+        b2.model_to_string()
+    )
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [
+        {"bagging_fraction": 0.7, "bagging_freq": 1, "bagging_seed": 9},
+        {"boosting": "goss", "top_rate": 0.3, "other_rate": 0.2,
+         "learning_rate": 0.3},
+    ],
+    ids=["bagging", "goss"],
+)
+def test_bagging_goss_streamed_parity(extra):
+    """Row sampling consumes the binned planes and seeded device RNG only,
+    so a chunk-streamed Dataset trains to the identical model under
+    bagging and GOSS — no full raw row set ever exists host-side."""
+    X, y = _mkdata()
+    p1 = dict(PARAMS, **extra)
+    p2 = dict(p1, ingest_chunk_rows=700)
+    b1 = lgb.train(p1, lgb.Dataset(X.copy(), y, params=p1), 8)
+    b2 = lgb.train(p2, lgb.Dataset(X.copy(), y, params=p2), 8)
+    assert _strip_ingest(b1.model_to_string()) == _strip_ingest(
+        b2.model_to_string()
+    )
+
+
+def test_mesh_spec_streamed_parity():
+    """Under a tree_learner=data mesh spec (8 virtual devices, one
+    process) the chunk-streamed Dataset trains to the identical model:
+    the mesh consumes the packed planes after construction, and those
+    are byte-identical to one-shot."""
+    X, y = _mkdata()
+    p1 = dict(PARAMS, tree_learner="data")
+    p2 = dict(p1, ingest_chunk_rows=700)
+    b1 = lgb.train(p1, lgb.Dataset(X.copy(), y, params=p1), 6)
+    b2 = lgb.train(p2, lgb.Dataset(X.copy(), y, params=p2), 6)
+    assert _strip_ingest(b1.model_to_string()) == _strip_ingest(
+        b2.model_to_string()
+    )
+
+
+def test_valid_set_streams_against_reference():
+    X, y = _mkdata()
+    Xv, yv = _mkdata(n=1200, seed=11)
+    p2 = dict(PARAMS, ingest_chunk_rows=500)
+    train1 = lgb.Dataset(X.copy(), y, params=PARAMS).construct()
+    valid1 = lgb.Dataset(Xv.copy(), yv, params=PARAMS, reference=train1)
+    valid1.construct()
+    train2 = lgb.Dataset(X.copy(), y, params=p2).construct()
+    valid2 = lgb.Dataset(Xv.copy(), yv, params=p2, reference=train2)
+    valid2.construct()
+    assert np.array_equal(
+        np.asarray(valid1.bins), np.asarray(valid2.bins)
+    )
+
+
+def test_linear_tree_falls_back_to_one_shot():
+    """linear_tree needs the raw matrix; the knob falls back (with a
+    warning) instead of breaking the mode."""
+    X, y = _mkdata(n=800, f=5)
+    p1 = dict(PARAMS, linear_tree=True)
+    p2 = dict(p1, ingest_chunk_rows=300)
+    ds2 = lgb.Dataset(X.copy(), y, params=p2).construct()
+    assert ds2.raw is not None  # one-shot path kept the raw matrix
+    b1 = lgb.train(p1, lgb.Dataset(X.copy(), y, params=p1), 5)
+    b2 = lgb.train(p2, lgb.Dataset(X.copy(), y, params=p2), 5)
+    assert _strip_ingest(b1.model_to_string()) == _strip_ingest(
+        b2.model_to_string()
+    )
+
+
+def test_libsvm_falls_back_to_sparse_path(tmp_path):
+    """LibSVM text probes as unstreamable and bins through the sparse
+    path, knob or not."""
+    rng = np.random.default_rng(4)
+    lines = []
+    for i in range(400):
+        feats = sorted(rng.choice(8, size=3, replace=False))
+        kv = " ".join(f"{j}:{rng.normal():.6f}" for j in feats)
+        lines.append(f"{int(rng.random() < 0.5)} {kv}")
+    path = tmp_path / "train.svm"
+    path.write_text("\n".join(lines) + "\n")
+    ds1 = lgb.Dataset(str(path), params=PARAMS).construct()
+    ds2 = lgb.Dataset(
+        str(path), params=dict(PARAMS, ingest_chunk_rows=100)
+    ).construct()
+    assert np.array_equal(np.asarray(ds1.bins), np.asarray(ds2.bins))
+
+
+def test_ingest_telemetry_gauges():
+    """Phase timers + ingest gauges land in the registry and export as
+    lgbtpu_* prometheus lines."""
+    from lightgbm_tpu.obs.export import prometheus_snapshot
+    from lightgbm_tpu.obs.registry import get_session
+    from lightgbm_tpu.utils.timer import global_timer
+
+    sess = get_session()
+    prev = sess.enabled
+    sess.configure(enabled=True)
+    try:
+        sess.reset()
+        X, y = _mkdata(n=1500)
+        lgb.Dataset(
+            X, y, params=dict(PARAMS, ingest_chunk_rows=400)
+        ).construct()
+        for g in (
+            "ingest/chunks_total",
+            "ingest/rows_per_sec",
+            "ingest/peak_rss_bytes",
+        ):
+            assert g in sess.gauges, sorted(sess.gauges)
+        assert sess.gauges["ingest/chunks_total"] == 4.0
+        assert sess.gauges["ingest/peak_rss_bytes"] > 0
+        text = prometheus_snapshot()
+        assert "lgbtpu_ingest_chunks_total" in text
+        assert "lgbtpu_ingest_peak_rss_bytes" in text
+    finally:
+        sess.reset()
+        sess.configure(enabled=prev)
+    for phase in (
+        "dataset/ingest/sample",
+        "dataset/ingest/bin_fit",
+        "dataset/ingest/bundle",
+        "dataset/ingest/pack",
+    ):
+        assert global_timer.counts.get(phase, 0) >= 1, phase
+
+
+RSS_SCRIPT = textwrap.dedent(
+    """
+    import os, resource, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    N, F = 600_000, 50
+    npy = sys.argv[1]
+    mode = sys.argv[2]
+
+    # settle the interpreter + jax + one tiny construct, THEN measure the
+    # additional high-water the big build adds (ru_maxrss is monotone)
+    Xs, ys = np.random.default_rng(0).normal(size=(500, F)), np.zeros(500)
+    ys[:250] = 1.0
+    lgb.Dataset(Xs, ys, params={{"verbose": -1}}).construct()
+    base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+    y = np.zeros(N); y[: N // 2] = 1.0
+    params = {{"verbose": -1, "bin_construct_sample_cnt": 50_000,
+              "data_random_seed": 1}}
+    if mode == "stream":
+        params["ingest_chunk_rows"] = 65_536
+    ds = lgb.Dataset(npy if mode == "stream" else np.load(npy), y,
+                     params=params).construct()
+    assert ds.bins.shape == (N, F), ds.bins.shape
+    peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    print("DELTA", peak - base)
+    """
+)
+
+
+@pytest.mark.slow
+def test_streamed_peak_rss_never_materializes_raw(tmp_path):
+    """The raw 600k x 50 float64 matrix is 240 MB; the streamed build's
+    additional peak RSS must stay far under it (bins + sample + a bounded
+    chunk window), while the one-shot build pays the full matrix."""
+    npy = tmp_path / "big.npy"
+    out = np.lib.format.open_memmap(
+        npy, mode="w+", dtype=np.float64, shape=(600_000, 50)
+    )
+    rng = np.random.default_rng(12)
+    for s in range(0, 600_000, 100_000):
+        out[s : s + 100_000] = rng.normal(size=(100_000, 50))
+    out.flush()
+    del out
+
+    def run(mode):
+        script = tmp_path / f"rss_{mode}.py"
+        script.write_text(RSS_SCRIPT.format(repo=REPO_ROOT))
+        r = subprocess.run(
+            [sys.executable, str(script), str(npy), mode],
+            capture_output=True, text=True, timeout=600,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+        for ln in r.stdout.splitlines():
+            if ln.startswith("DELTA"):
+                return int(ln.split()[1])
+        raise AssertionError(r.stdout)
+
+    raw_bytes = 600_000 * 50 * 8
+    stream_delta = run("stream")
+    oneshot_delta = run("oneshot")
+    assert stream_delta < raw_bytes // 2, (stream_delta, raw_bytes)
+    # allocator page reuse can shave the one-shot delta slightly under the
+    # nominal matrix size; 3/4 still clearly shows the full materialization
+    assert oneshot_delta > raw_bytes * 3 // 4, (oneshot_delta, raw_bytes)
+    assert stream_delta * 2 < oneshot_delta, (stream_delta, oneshot_delta)
+
+
+def test_sharded_global_sample_simulated(monkeypatch):
+    """exchange_global_sample with a faked 2-process collective (threads +
+    barrier): every rank must end with the IDENTICAL global sample, equal
+    to the one-shot seeded draw over the concatenated matrix.  This runs
+    in the default tier; the real two-process launcher drill below needs
+    cross-process CPU collectives."""
+    import threading
+
+    import jax
+
+    from lightgbm_tpu import parallel as par
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.ingest.sharded import exchange_global_sample
+    from lightgbm_tpu.ingest.sources import ArrayChunkSource
+
+    X, _ = _mkdata(n=6000, f=8)
+    shards = [X[:3500], X[3500:]]
+    cfg = Config.from_params(
+        {"bin_construct_sample_cnt": 1500, "data_random_seed": 21}
+    )
+
+    tl = threading.local()
+    barrier = threading.Barrier(2)
+    store = [None, None]
+    lock = threading.Lock()
+
+    def fake_varlen(arr, return_counts=False):
+        store[tl.rank] = np.asarray(arr)
+        barrier.wait()
+        with lock:
+            out = np.concatenate([store[0], store[1]], axis=0)
+            counts = np.asarray([len(store[0]), len(store[1])], np.int32)
+        barrier.wait()  # both ranks read before the next round overwrites
+        return (out, counts) if return_counts else out
+
+    monkeypatch.setattr(par, "allgather_host_varlen", fake_varlen)
+    monkeypatch.setattr(jax, "process_index", lambda: tl.rank)
+
+    results = [None, None]
+    errors = []
+
+    def worker(rank):
+        tl.rank = rank
+        try:
+            src = ArrayChunkSource(shards[rank], 512)
+            results[rank] = exchange_global_sample(src, cfg)
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errors.append(exc)
+            try:
+                barrier.abort()
+            except Exception:
+                pass
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errors, errors
+    (gn0, off0, s0), (gn1, off1, s1) = results
+    assert (gn0, gn1) == (6000, 6000)
+    assert (off0, off1) == (0, 3500)
+    assert np.array_equal(s0, s1)
+    rows = np.sort(
+        np.random.default_rng(21).choice(6000, size=1500, replace=False)
+    )
+    assert np.array_equal(s0, X[rows])
+
+
+SHARDED_TMPL = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, "__REPO__")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    import hashlib
+    import numpy as np
+    from lightgbm_tpu.parallel import init_distributed
+
+    init_distributed()
+    rank = jax.process_index()
+    rng = np.random.default_rng(99)
+    X = rng.integers(0, 63, size=(8000, 6)).astype(np.float64)
+    y = X[:, 0] * 0.2 + np.sin(X[:, 1]) + rng.normal(scale=0.3, size=8000)
+    lo, hi = rank * 4000, (rank + 1) * 4000
+    import lightgbm_tpu as lgb
+
+    params = dict(
+        objective="regression", num_leaves=31, min_data_in_leaf=20,
+        tree_learner="data", pre_partition=True, verbosity=-1, metric="none",
+        max_bin=63, ingest_chunk_rows=1024,
+        bin_construct_sample_cnt=3000, data_random_seed=5,
+    )
+    d = lgb.Dataset(X[lo:hi], y[lo:hi], params=params)
+    d.construct()
+    # globally consistent mappers, fit from the allgathered GLOBAL sample
+    h = hashlib.sha256()
+    for m in d.bin_mappers:
+        h.update(np.asarray(m.bin_upper_bound).tobytes())
+        h.update(bytes([m.num_bins & 0xFF]))
+    print(f"MAPPERHASH {h.hexdigest()}")
+    b = lgb.train(params, d, 5)
+    ms = b.model_to_string()
+    print(f"MODELHASH {hashlib.sha256(ms.encode()).hexdigest()}")
+    """
+)
+
+
+@pytest.mark.slow
+def test_two_process_sharded_streamed_ingest(tmp_path):
+    """Sharded per-host streamed ingest: each process streams only its row
+    shard; the global-sample exchange must yield identical bin mappers
+    (and identical trained models) on every process, equal to the mappers
+    a single-process run fits from the SAME global sample."""
+    script = tmp_path / "sharded_ingest_worker.py"
+    script.write_text(SHARDED_TMPL.replace("__REPO__", REPO_ROOT))
+    from lightgbm_tpu.parallel.launcher import launch_collect
+
+    rc, outputs = launch_collect(
+        2,
+        [sys.executable, str(script)],
+        extra_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
+    )
+    assert rc == 0, outputs
+    mapper_digests, model_digests = [], []
+    for out in outputs:
+        for line in out.splitlines():
+            if line.startswith("MAPPERHASH"):
+                mapper_digests.append(line.split()[1][:64])
+            if line.startswith("MODELHASH"):
+                model_digests.append(line.split()[1][:64])
+    assert len(mapper_digests) == 2, outputs
+    assert len(set(mapper_digests)) == 1, mapper_digests
+    assert len(set(model_digests)) == 1, model_digests
+
+    # single-process streamed run over the same GLOBAL data: the sharded
+    # exchange must reproduce its seeded sample, hence its mappers
+    import hashlib
+
+    rng = np.random.default_rng(99)
+    X = rng.integers(0, 63, size=(8000, 6)).astype(np.float64)
+    y = X[:, 0] * 0.2 + np.sin(X[:, 1]) + rng.normal(scale=0.3, size=8000)
+    params = dict(
+        objective="regression", num_leaves=31, min_data_in_leaf=20,
+        verbosity=-1, metric="none", max_bin=63, ingest_chunk_rows=1024,
+        bin_construct_sample_cnt=3000, data_random_seed=5,
+    )
+    d = lgb.Dataset(X, y, params=params).construct()
+    h = hashlib.sha256()
+    for m in d.bin_mappers:
+        h.update(np.asarray(m.bin_upper_bound).tobytes())
+        h.update(bytes([m.num_bins & 0xFF]))
+    assert h.hexdigest()[:64] == mapper_digests[0], (
+        "sharded mappers diverge from the single-process global sample"
+    )

@@ -200,6 +200,41 @@ assert any("tensor" in k for k in labels), sorted(labels)
 print("tensor-forest smoke: walker/matmul byte parity OK")
 PYEOF
 
+# streaming-ingest smoke: a 3-iteration train whose Dataset was built by
+# the chunked two-pass ingest (pass 1 samples + fits mappers, pass 2
+# streams chunks through binning; the full raw f64 matrix never
+# materializes) must dump byte-identically to the one-shot build of the
+# same data/seed, including through a memmap-backed bin-plane spill.
+echo "=== streaming-ingest smoke (chunked two-pass train parity vs one-shot) ==="
+python - <<'PYEOF' || rc=$?
+import tempfile
+
+import numpy as np
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(3000, 12))
+X[:, 4] = (rng.random(3000) < 0.06) * rng.normal(size=3000)  # sparse col
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "bin_construct_sample_cnt": 700, "data_random_seed": 3,
+          "min_data_in_leaf": 10}
+
+def dump(extra):
+    p = dict(params, **extra)
+    b = lgb.train(p, lgb.Dataset(X.copy(), y, params=p), 3)
+    return "\n".join(ln for ln in b.model_to_string().splitlines()
+                     if not ln.startswith("[ingest_"))
+
+ref = dump({})
+assert dump({"ingest_chunk_rows": 611}) == ref, (
+    "chunked-ingest dump diverged from one-shot")
+with tempfile.TemporaryDirectory() as td:
+    assert dump({"ingest_chunk_rows": 611, "ingest_mmap_dir": td}) == ref, (
+        "memmap-spill chunked dump diverged from one-shot")
+print("streaming-ingest smoke: chunked/memmap train parity OK")
+PYEOF
+
 # perf-contract gate: collect the deterministic telemetry slice (retraces
 # by label, analytic+measured collective bytes, executable FLOPs/temp HBM)
 # and diff it against the committed contract.  HARD gate — any drift in a
