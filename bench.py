@@ -925,7 +925,156 @@ def ingest_sweep() -> dict:
         shutil.rmtree(td, ignore_errors=True)
 
 
+_FLEET_CELL_SCRIPT = r"""
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+n_rows, m, iters, leaves = (int(v) for v in sys.argv[1:5])
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.boosting import create_booster
+from lightgbm_tpu.boosting.fleet import FleetTrainer
+from lightgbm_tpu.obs.jit import compile_counts_by_label
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(n_rows, 28))
+y = X @ rng.normal(size=28) * 0.5 + rng.normal(size=n_rows) * 0.1
+base = {
+    "objective": "regression", "num_leaves": leaves, "verbosity": -1,
+    "min_data_in_leaf": 20, "seed": 0,
+}
+param_sets = [
+    dict(base, seed=i, learning_rate=0.05 + 0.01 * i) for i in range(m)
+]
+ds = lgb.Dataset(X, y, free_raw_data=False)
+
+# solo reference: one member trained alone through the standard update
+# path (what M sequential runs would each pay per iteration)
+solo = create_booster(dict(param_sets[0]), ds)
+t0 = time.perf_counter()
+solo.update()
+solo_compile_s = time.perf_counter() - t0
+solo.update()  # settle
+t0 = time.perf_counter()
+for _ in range(iters):
+    solo.update()
+# the solo path pipelines its host fetch one iteration behind — drain it
+# (models_ property) and block on the score so the timed window covers
+# ALL the work an iteration dispatched
+import jax
+_ = solo.models_
+jax.block_until_ready(solo._score)
+solo_iter_ms = (time.perf_counter() - t0) / iters * 1e3
+c0 = compile_counts_by_label()
+
+boosters = [create_booster(dict(p), ds) for p in param_sets]
+trainer = FleetTrainer(boosters)
+t0 = time.perf_counter()
+trainer.update()
+fleet_compile_s = time.perf_counter() - t0
+trainer.update()
+t0 = time.perf_counter()
+for _ in range(iters):
+    trainer.update()
+fleet_iter_ms = (time.perf_counter() - t0) / iters * 1e3
+c1 = compile_counts_by_label()
+
+print(json.dumps({
+    "m": m,
+    "rows": n_rows,
+    "solo_iter_ms": round(solo_iter_ms, 1),
+    "sequential_iter_ms": round(solo_iter_ms * m, 1),
+    "fleet_iter_ms": round(fleet_iter_ms, 1),
+    "fleet_iter_per_member_ms": round(fleet_iter_ms / m, 1),
+    "speedup_vs_sequential": round(solo_iter_ms * m / fleet_iter_ms, 2),
+    "solo_compile_s": round(solo_compile_s, 1),
+    "fleet_compile_s": round(fleet_compile_s, 1),
+    "fleet_grow_executables": int(
+        c1.get("fleet/grow", 0) - c0.get("fleet/grow", 0)
+    ),
+    # dispatch ledger per boosting iteration: M sequential runs issue M
+    # grow dispatches (each with its own per-leaf histogram launches);
+    # the fleet's custom_vmap hist rule folds the member axis into the
+    # segment ids, so ONE launch per leaf covers all M members
+    "grow_dispatches_per_iter": {"sequential": m, "fleet": 1},
+    "hist_launch_reduction": m,
+}))
+"""
+
+
+def fleet_sweep() -> dict:
+    """Vmapped model-fleet A/B (``--fleet-sweep``).
+
+    For each fleet size M in {1, 4, 16, 32} train M same-shape regression
+    members (seed + learning-rate sweep) at 64k x 28 two ways — M solo
+    runs through the standard update path vs ONE FleetTrainer whose
+    vmapped grow batches all members per launch — and record per-iteration
+    wall, compile time, grow-executable counts and the dispatch ledger.
+    Each cell runs in a fresh subprocess so compile caches and counters
+    start cold.  The analytic fleet psum model (one stacked [M, ...]
+    payload per collective step under ``tree_learner=data``) rides along
+    from ``parallel.mesh.fleet_psum_bytes_per_iteration`` — the same
+    formula the perf gate pins."""
+    import subprocess
+
+    from lightgbm_tpu.parallel.mesh import (
+        MeshSpec,
+        fleet_psum_bytes_per_iteration,
+    )
+
+    n_rows = int(os.environ.get("BENCH_FLEET_ROWS", 64_000))
+    iters = int(os.environ.get("BENCH_FLEET_ITERS", 3))
+    leaves = int(os.environ.get("BENCH_FLEET_LEAVES", 15))
+    m_grid = [
+        int(v)
+        for v in os.environ.get("BENCH_FLEET_M", "1,4,16,32").split(",")
+        if v.strip()
+    ]
+    out = {
+        "rows": n_rows,
+        "n_features": 28,
+        "num_leaves": leaves,
+        "timed_iters": iters,
+        "cells": [],
+    }
+    for m in m_grid:
+        r = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                _FLEET_CELL_SCRIPT,
+                str(n_rows),
+                str(m),
+                str(iters),
+                str(leaves),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=3600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"fleet cell m={m} failed:\n" + r.stderr[-4000:]
+            )
+        cell = json.loads(r.stdout.strip().splitlines()[-1])
+        cell["analytic_psum_bytes_data8"] = fleet_psum_bytes_per_iteration(
+            n_splits=leaves - 1,
+            n_features=28,
+            num_bins=255,
+            fleet=m,
+            spec=MeshSpec("data", data=8, feature=1),
+        )
+        out["cells"].append(cell)
+    return out
+
+
 def main() -> None:
+    if "--fleet-sweep" in sys.argv:
+        # standalone, CPU-pinned: each M cell is its own subprocess so the
+        # compile counters and jit caches start cold
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps({"fleet_sweep": fleet_sweep()}))
+        return
     if "--ingest-sweep" in sys.argv:
         # standalone, CPU-pinned: each cell is its own subprocess, so the
         # parent only orchestrates and writes the CSV fixture

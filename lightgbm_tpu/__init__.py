@@ -30,7 +30,7 @@ from .callback import (
 )
 from .config import Config
 from .dataset import Dataset
-from .engine import CVBooster, cv, train
+from .engine import CVBooster, cv, train, train_fleet
 from .dask import DaskLGBMClassifier, DaskLGBMRanker, DaskLGBMRegressor
 from .dataset import Sequence
 from .plotting import (
@@ -70,6 +70,7 @@ __all__ = [
     "Booster",
     "CVBooster",
     "train",
+    "train_fleet",
     "cv",
     "early_stopping",
     "log_evaluation",

@@ -1776,6 +1776,36 @@ class Booster:
                 f"iteration {iteration} (objective={self._objective_name()})"
             )
 
+    def set_row_mask(self, row_mask) -> None:
+        """Restrict training to a fixed row subset (CV folds, holdouts).
+
+        The mask rides the same live-row machinery as mesh padding: excluded
+        rows get exact-zero gradients BEFORE sampling (so GOSS never selects
+        them) and a zero sample mask after. Shape must be [num_data] (unpadded
+        length); pass None to clear. Scores for excluded rows still advance —
+        that is what makes out-of-fold prediction on the train-set scores
+        possible."""
+        sampler = getattr(self, "_sampler", None)
+        if row_mask is None:
+            self._fixed_row_mask = None
+            if sampler is not None:
+                sampler.set_live_count(None)
+            return
+        m = np.asarray(row_mask, dtype=np.float32).reshape(-1)
+        if m.shape[0] != self.train_set.num_data:
+            raise ValueError(
+                f"row_mask length {m.shape[0]} != num_data "
+                f"{self.train_set.num_data}"
+            )
+        live = int((m > 0).sum())
+        if live == 0:
+            raise ValueError("row_mask excludes every row")
+        if self._pad_rows:
+            m = np.concatenate([m, np.zeros(self._pad_rows, np.float32)])
+        self._fixed_row_mask = jnp.asarray(m)
+        if sampler is not None:
+            sampler.set_live_count(live)
+
     def _sample(self, grad, hess):
         """Bagging/GOSS row sampling; padded (mesh-fill) rows never count.
 
@@ -1787,8 +1817,11 @@ class Booster:
         # op sequences on the same global arrays (SPMD violation — only some
         # processes reaching the next collective deadlocks the cluster)
         any_pad = bool(self._pad_rows) or getattr(self, "_multiproc", False)
-        if any_pad:
+        fixed = getattr(self, "_fixed_row_mask", None)
+        if any_pad or fixed is not None:
             live = self._ones_mask[None] > 0
+            if fixed is not None:
+                live = jnp.logical_and(live, fixed[None] > 0)
             grad = jnp.where(live, grad, 0.0)
             hess = jnp.where(live, hess, 0.0)
         mask, grad, hess = self._sampler.sample(
@@ -1796,6 +1829,8 @@ class Booster:
         )
         if any_pad:
             mask = mask * self._ones_mask
+        if fixed is not None:
+            mask = mask * fixed
         ses = get_session()
         if ses.enabled:
             # host pull of a scalar; only paid when telemetry is on
@@ -2010,143 +2045,167 @@ class Booster:
 
         should_continue = False
         for kk in range(k):
-            tree_idx = len(self.models_)
+            grown = None
             if self._class_need_train[kk] and self._bins.shape[1] > 0:
-                qg, qh = self._quant_grow_inputs(grad[kk], hess[kk])
-                ta, leaf_id = self._grow_one(
-                    qg,
-                    qh,
-                    mask,
-                    feature_mask,
-                    self._tree_rng(),
+                grown = self._grow_class(
+                    kk, grad, hess, mask, feature_mask, self._tree_rng()
                 )
-                ta = self._quant_renew(ta, leaf_id, grad[kk], hess[kk], mask)
-                # two bulk transfers instead of ~14 small ones (remote TPU
-                # round-trips dominate otherwise)
-                with get_session().phase("host_materialize"):
-                    ta_host = fetch_tree_arrays(ta)
-                if cfg.check_numerics:
-                    self._guard_tree(ta_host, self._iter)
-                self._note_refine_rate(ta_host)
-                n_leaves = int(ta_host.num_leaves)
-            else:
-                n_leaves = 1
-
-            if n_leaves > 1:
+            if self._commit_class_tree(kk, grown, grad, hess, mask, init_scores):
                 should_continue = True
-                leaf_value = ta.leaf_value
-                if self.objective is not None and self.objective.is_renew_tree_output:
-                    lv = self.objective.renew_tree_output(
-                        np.asarray(self._score[kk], dtype=np.float64)[:n],
-                        np.asarray(leaf_id)[:n],
-                        np.asarray(ta_host.leaf_value, dtype=np.float64),
-                        np.asarray(mask)[:n],
-                    )
-                    leaf_value = jnp.asarray(lv, dtype=jnp.float32)
-                    ta = ta._replace(leaf_value=leaf_value)
-                    ta_host = ta_host._replace(leaf_value=lv)
-                tree = Tree.from_device_arrays(
-                    ta_host,
-                    self.train_set.bin_mappers,
-                    self.train_set.used_features,
-                    bundle_layout=self._bundle,
-                )
-                if cfg.verbosity >= 2:
-                    tree.validate()  # debug CHECK paths (tree.py)
-                is_linear = bool(cfg.linear_tree)
-                if is_linear:
-                    self._fit_linear_leaves(
-                        tree,
-                        np.asarray(leaf_id)[:n],
-                        np.asarray(grad[kk], dtype=np.float64)[:n],
-                        np.asarray(hess[kk], dtype=np.float64)[:n],
-                        np.asarray(mask)[:n],
-                    )
-                tree.apply_shrinkage(self._shrinkage_rate)
 
-                if is_linear:
-                    # linear leaves: per-row output depends on raw features;
-                    # scores advance by a host tree walk (the reference's
-                    # LinearTreeLearner AddPredictionToScore equivalent)
-                    delta = tree.predict(self._raw_for_replay(self.train_set))
-                    self._score = self._score.at[kk].add(
-                        self._pad_delta(delta, self._pad_rows)
+        return self._finish_iteration(should_continue)
+
+    def _grow_class(self, kk, grad, hess, mask, feature_mask, rng):
+        """Grow + host-materialize one class's tree.
+
+        Returns (ta, ta_host, leaf_id); the commit step is separate so a
+        fleet trainer can substitute one batched grow for M solo grows and
+        still reuse the per-member commit path unchanged."""
+        cfg = self.config
+        qg, qh = self._quant_grow_inputs(grad[kk], hess[kk])
+        ta, leaf_id = self._grow_one(qg, qh, mask, feature_mask, rng)
+        ta = self._quant_renew(ta, leaf_id, grad[kk], hess[kk], mask)
+        # two bulk transfers instead of ~14 small ones (remote TPU
+        # round-trips dominate otherwise)
+        with get_session().phase("host_materialize"):
+            ta_host = fetch_tree_arrays(ta)
+        if cfg.check_numerics:
+            self._guard_tree(ta_host, self._iter)
+        self._note_refine_rate(ta_host)
+        return ta, ta_host, leaf_id
+
+    def _commit_class_tree(self, kk, grown, grad, hess, mask, init_scores):
+        """Commit one class's grown tree into the model: score updates,
+        Tree materialization, bin records. `grown` is `_grow_class`'s
+        result or None for a skipped class. Returns True when the tree
+        has at least one split (the iteration should continue)."""
+        cfg = self.config
+        k = self.num_tree_per_iteration
+        n = self.train_set.num_data
+        n_leaves = int(grown[1].num_leaves) if grown is not None else 1
+
+        if n_leaves > 1:
+            ta, ta_host, leaf_id = grown
+            leaf_value = ta.leaf_value
+            if self.objective is not None and self.objective.is_renew_tree_output:
+                lv = self.objective.renew_tree_output(
+                    np.asarray(self._score[kk], dtype=np.float64)[:n],
+                    np.asarray(leaf_id)[:n],
+                    np.asarray(ta_host.leaf_value, dtype=np.float64),
+                    np.asarray(mask)[:n],
+                )
+                leaf_value = jnp.asarray(lv, dtype=jnp.float32)
+                ta = ta._replace(leaf_value=leaf_value)
+                ta_host = ta_host._replace(leaf_value=lv)
+            tree = Tree.from_device_arrays(
+                ta_host,
+                self.train_set.bin_mappers,
+                self.train_set.used_features,
+                bundle_layout=self._bundle,
+            )
+            if cfg.verbosity >= 2:
+                tree.validate()  # debug CHECK paths (tree.py)
+            is_linear = bool(cfg.linear_tree)
+            if is_linear:
+                self._fit_linear_leaves(
+                    tree,
+                    np.asarray(leaf_id)[:n],
+                    np.asarray(grad[kk], dtype=np.float64)[:n],
+                    np.asarray(hess[kk], dtype=np.float64)[:n],
+                    np.asarray(mask)[:n],
+                )
+            tree.apply_shrinkage(self._shrinkage_rate)
+
+            if is_linear:
+                # linear leaves: per-row output depends on raw features;
+                # scores advance by a host tree walk (the reference's
+                # LinearTreeLearner AddPredictionToScore equivalent)
+                delta = tree.predict(self._raw_for_replay(self.train_set))
+                self._score = self._score.at[kk].add(
+                    self._pad_delta(delta, self._pad_rows)
+                )
+                for entry in self._valid:
+                    vdelta = tree.predict(self._raw_for_replay(entry.dataset))
+                    entry.score = entry.score.at[kk].add(
+                        self._pad_delta(vdelta, entry.pad)
                     )
-                    for entry in self._valid:
-                        vdelta = tree.predict(self._raw_for_replay(entry.dataset))
-                        entry.score = entry.score.at[kk].add(
-                            self._pad_delta(vdelta, entry.pad)
-                        )
-                else:
-                    shrunk = leaf_value * self._shrinkage_rate
-                    # train score update: one gather (reference UpdateScore
-                    # :501); the donated entry retires the old score cache
-                    self._score = _apply_tree_score(
-                        self._score, shrunk, leaf_id, jnp.int32(kk)
-                    )
-                    # valid score updates: bin-space walk of the new tree
-                    for entry in self._valid:
-                        entry.score = _apply_tree_valid_score(
-                            entry.score,
-                            entry.bins,
-                            self._nan_bins,
-                            ta.split_feature,
-                            ta.split_bin,
-                            ta.default_left,
-                            ta.left_child,
-                            ta.right_child,
-                            shrunk,
-                            ta.split_is_cat,
-                            ta.cat_mask,
-                            jnp.int32(kk),
-                        )
-                if abs(init_scores[kk]) > _EPS:
-                    tree.add_bias(init_scores[kk])
-                nn = n_leaves - 1
-                rec = {
-                    "split_feature": np.asarray(ta_host.split_feature)[:nn],
-                    "split_bin": np.asarray(ta_host.split_bin)[:nn],
-                    "default_left": np.asarray(ta_host.default_left)[:nn],
-                    "left_child": np.asarray(ta_host.left_child)[:nn],
-                    "right_child": np.asarray(ta_host.right_child)[:nn],
-                    "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
-                    "split_is_cat": np.asarray(ta_host.split_is_cat)[:nn],
-                    "cat_mask": np.asarray(ta_host.cat_mask)[:nn],
-                }
-                self._cegb_mark_used(rec["split_feature"])
-                if is_linear:
-                    rec["no_bin_form"] = True  # device walker can't see coeffs
-                self._bin_records.append(rec)
-                self.models_.append(tree)
-                self._bump_model_version()
             else:
-                # constant tree (reference gbdt.cpp:428-441)
-                if len(self.models_) < k:
-                    if (
-                        self.objective is not None
-                        and not cfg.boost_from_average
-                        and not self._has_init_score
-                    ):
-                        init_scores[kk] = self.objective.boost_from_score(kk)
-                        self._score = self._score.at[kk].add(init_scores[kk])
-                        for entry in self._valid:
-                            entry.score = entry.score.at[kk].add(init_scores[kk])
-                    tree = Tree.constant_tree(init_scores[kk])
-                else:
-                    tree = Tree.constant_tree(0.0)
-                self._bin_records.append(
-                    {
-                        "split_feature": np.zeros(0, np.int32),
-                        "split_bin": np.zeros(0, np.int32),
-                        "default_left": np.zeros(0, bool),
-                        "left_child": np.zeros(0, np.int32),
-                        "right_child": np.zeros(0, np.int32),
-                        "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
-                    }
+                shrunk = leaf_value * self._shrinkage_rate
+                # train score update: one gather (reference UpdateScore
+                # :501); the donated entry retires the old score cache
+                self._score = _apply_tree_score(
+                    self._score, shrunk, leaf_id, jnp.int32(kk)
                 )
-                self.models_.append(tree)
-                self._bump_model_version()
+                # valid score updates: bin-space walk of the new tree
+                for entry in self._valid:
+                    entry.score = _apply_tree_valid_score(
+                        entry.score,
+                        entry.bins,
+                        self._nan_bins,
+                        ta.split_feature,
+                        ta.split_bin,
+                        ta.default_left,
+                        ta.left_child,
+                        ta.right_child,
+                        shrunk,
+                        ta.split_is_cat,
+                        ta.cat_mask,
+                        jnp.int32(kk),
+                    )
+            if abs(init_scores[kk]) > _EPS:
+                tree.add_bias(init_scores[kk])
+            nn = n_leaves - 1
+            rec = {
+                "split_feature": np.asarray(ta_host.split_feature)[:nn],
+                "split_bin": np.asarray(ta_host.split_bin)[:nn],
+                "default_left": np.asarray(ta_host.default_left)[:nn],
+                "left_child": np.asarray(ta_host.left_child)[:nn],
+                "right_child": np.asarray(ta_host.right_child)[:nn],
+                "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
+                "split_is_cat": np.asarray(ta_host.split_is_cat)[:nn],
+                "cat_mask": np.asarray(ta_host.cat_mask)[:nn],
+            }
+            self._cegb_mark_used(rec["split_feature"])
+            if is_linear:
+                rec["no_bin_form"] = True  # device walker can't see coeffs
+            self._bin_records.append(rec)
+            self.models_.append(tree)
+            self._bump_model_version()
+        else:
+            # constant tree (reference gbdt.cpp:428-441)
+            if len(self.models_) < k:
+                if (
+                    self.objective is not None
+                    and not cfg.boost_from_average
+                    and not self._has_init_score
+                ):
+                    init_scores[kk] = self.objective.boost_from_score(kk)
+                    self._score = self._score.at[kk].add(init_scores[kk])
+                    for entry in self._valid:
+                        entry.score = entry.score.at[kk].add(init_scores[kk])
+                tree = Tree.constant_tree(init_scores[kk])
+            else:
+                tree = Tree.constant_tree(0.0)
+            self._bin_records.append(
+                {
+                    "split_feature": np.zeros(0, np.int32),
+                    "split_bin": np.zeros(0, np.int32),
+                    "default_left": np.zeros(0, bool),
+                    "left_child": np.zeros(0, np.int32),
+                    "right_child": np.zeros(0, np.int32),
+                    "leaf_value": np.asarray(tree.leaf_value, dtype=np.float32),
+                }
+            )
+            self.models_.append(tree)
+            self._bump_model_version()
 
+        return n_leaves > 1
+
+    def _finish_iteration(self, should_continue: bool) -> bool:
+        """Iteration epilogue shared by solo and fleet-lockstep paths:
+        roll back the all-constant round or advance the iteration
+        counter. Returns the is_finished flag."""
+        k = self.num_tree_per_iteration
         if not should_continue:
             if len(self.models_) > k:
                 for _ in range(k):
@@ -2156,6 +2215,69 @@ class Booster:
             return True
         self._iter += 1
         return False
+
+    def _fleet_begin_iter(self):
+        """Per-iteration preamble for lockstep fleet training.
+
+        Mirrors the non-pipelined `_update_impl` preamble EXACTLY —
+        including RNG consumption order, which is what makes a fleet
+        member's model dump byte-identical to its solo run: gradients
+        consume one key, bagging one key, then one per-class tree key
+        drawn only for classes that actually train and only when the
+        grower needs device RNG (`_tree_rng` returns None otherwise).
+        Returns the iteration operands the fleet trainer stacks across
+        members before the single batched grow."""
+        ses = get_session()
+        cfg = self.config
+        k = self.num_tree_per_iteration
+        init_scores = [0.0] * k
+        if (
+            not self.models_
+            and not self._has_init_score
+            and self.objective is not None
+            and cfg.boost_from_average
+        ):
+            for kk in range(k):
+                s = self.objective.boost_from_score(kk)
+                if abs(s) > _EPS:
+                    init_scores[kk] = s
+                    self._score = self._score.at[kk].add(s)
+                    for entry in self._valid:
+                        entry.score = entry.score.at[kk].add(s)
+        with ses.phase("gradients"):
+            grad, hess = self._get_gradients()
+            ses.sync(grad)
+        grad, hess = chaos.maybe_poison_gradients(grad, hess, self._iter)
+        if cfg.check_numerics:
+            self._guard_gradients(grad, hess)
+        with ses.phase("sample"):
+            mask, grad, hess = self._sample(grad, hess)
+            ses.sync(mask)
+        feature_mask = self._feature_mask_for_iter()
+        tree_rngs = [
+            self._tree_rng()
+            if (self._class_need_train[kk] and self._bins.shape[1] > 0)
+            else None
+            for kk in range(k)
+        ]
+        return {
+            "init_scores": init_scores,
+            "grad": grad,
+            "hess": hess,
+            "mask": mask,
+            "feature_mask": feature_mask,
+            "tree_rngs": tree_rngs,
+        }
+
+    def _fleet_end_iter(self, should_continue: bool) -> bool:
+        """Fleet-lockstep epilogue: `_finish_iteration` plus latching the
+        finished flag so this member becomes a value-preserving no-op slot
+        (zero gradients, discarded outputs) while the rest of the fleet
+        keeps training."""
+        finished = self._finish_iteration(should_continue)
+        if finished:
+            self._finished = True
+        return finished
 
     def _feature_mask_for_iter(self) -> jnp.ndarray:
         cfg = self.config

@@ -33,6 +33,16 @@ class SampleStrategy:
         self.config = config
         self.num_data = num_data
         self._ones = jnp.ones((num_data,), jnp.float32)
+        self._live_count: int | None = None
+
+    def set_live_count(self, n: int | None) -> None:
+        """Row count the strategy should size itself against when a fixed
+        row mask (Booster.set_row_mask — CV folds, holdouts) restricts
+        training to a subset: GOSS derives top_k/other_k and its
+        reweighting factor from the LIVE rows, not the full matrix.  None
+        restores full-data sizing; bagging is per-row Bernoulli and needs
+        no adjustment (the fixed mask intersects it downstream)."""
+        self._live_count = int(n) if n is not None else None
 
     def sample(
         self, iteration: int, grad: jnp.ndarray, hess: jnp.ndarray, rng: jax.Array
@@ -122,11 +132,14 @@ class GOSSStrategy(SampleStrategy):
         if iteration < self._warmup:
             return self._ones, grad, hess
         cfg = self.config
-        n = self.num_data
+        # with a fixed row mask the excluded rows reach us as exact zeros
+        # (|g*h| = 0, never in the top set); sizing against the live count
+        # keeps the effective top/other rates right for the subset
+        n = self._live_count if self._live_count is not None else self.num_data
         metric = jnp.abs(grad * hess).sum(axis=0)  # sum over classes [N]
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))
-        threshold = jnp.sort(metric)[n - top_k]
+        threshold = jnp.sort(metric)[self.num_data - top_k]
         is_top = metric >= threshold
         rest_prob = other_k / max(1, n - top_k)
         sampled = jax.random.uniform(rng, (n,)) < rest_prob

@@ -333,6 +333,12 @@ class Config:
     # grows back within a training run; every K has a warm compiled loop).
     leaf_batch_adaptive: bool = True
     leaf_batch_min_commit_rate: float = 0.625
+    # TPU extension: model-fleet training (engine.train_fleet /
+    # boosting/fleet.py) — when train_fleet receives ONE params dict it is
+    # expanded to this many members whose seeds are offset by the member
+    # index, all trained in lockstep through a single vmapped grow
+    # executable.  Explicit params_list entries override this count.
+    num_fleet: int = 1
     # TPU extension: fused Pallas grow step — partition + smaller-child
     # election + histogram for the whole frontier batch in ONE kernel launch
     # (ops/pallas/grow_step.py), collapsing the fixed dispatch/fusion-

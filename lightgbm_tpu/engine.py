@@ -257,6 +257,151 @@ def train(
     return booster
 
 
+def train_fleet(
+    params_list: Union[Dict[str, Any], Sequence[Dict[str, Any]]],
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    valid_sets: Optional[Union[Dataset, Sequence[Dataset]]] = None,
+    valid_names: Optional[Sequence[str]] = None,
+    feval: Optional[Callable] = None,
+    callbacks: Optional[List[Callable]] = None,
+    row_masks: Optional[Sequence] = None,
+    boosters: Optional[List[Booster]] = None,
+) -> List[Booster]:
+    """Train M same-shape models for far less than M runs.
+
+    All members share the binned dataset and ONE compiled, vmapped grow
+    step per tree class (boosting/fleet.py): histograms for every member
+    accumulate in a single kernel launch, and under ``tree_learner=data``
+    the per-member psums collapse into one stacked payload per step.
+    Every member's model is byte-identical to the model its params would
+    produce in a solo :func:`train` run.
+
+    ``params_list`` is either an explicit list of per-member params dicts
+    (same-shape sweeps: seeds, ``learning_rate``, bagging/GOSS fractions,
+    ``extra_seed``) or ONE dict expanded to ``num_fleet`` members whose
+    seeds are offset by the member index.  ``row_masks`` optionally
+    restricts each member to a fixed row subset (CV folds) via
+    :meth:`Booster.set_row_mask`.  ``callbacks`` are FACTORIES invoked
+    once per member (stateful callbacks like ``early_stopping`` must not
+    share state across members); per-member early stopping freezes that
+    member while the rest of the fleet keeps training in the same warm
+    executable.  ``boosters`` bypasses construction (used by ``cv``).
+
+    Not supported in v1 (raises): custom fobj, init_model/resume,
+    checkpointing, dart/rf boosting, linear trees, quantized gradients,
+    CEGB, multi-process feeding.
+    """
+    from .boosting.fleet import FleetTrainer
+
+    global_timer.reset()
+    if boosters is None:
+        if isinstance(params_list, dict):
+            base = dict(params_list)
+            cfg0 = Config.from_params(base)
+            seed0 = cfg0.seed if cfg0.seed is not None else 0
+            params_list = []
+            for i in range(max(1, cfg0.num_fleet)):
+                p = dict(base)
+                p["seed"] = seed0 + i
+                params_list.append(p)
+        boosters = [create_booster(dict(p), train_set) for p in params_list]
+    if row_masks is not None:
+        if len(row_masks) != len(boosters):
+            raise ValueError(
+                f"row_masks has {len(row_masks)} entries for "
+                f"{len(boosters)} fleet members"
+            )
+        for b, m in zip(boosters, row_masks):
+            if m is not None:
+                b.set_row_mask(m)
+
+    cfg = boosters[0].config
+    ses = get_session()
+    if cfg.telemetry:
+        ses.configure(
+            enabled=True,
+            sync_timing=cfg.obs_sync_timing,
+            sink_path=cfg.telemetry_out,
+            device_accounting=cfg.obs_device_accounting,
+            measure_collectives=cfg.obs_collectives,
+        )
+    if "num_iterations" in cfg.raw:
+        num_boost_round = cfg.num_iterations
+
+    if isinstance(valid_sets, Dataset):
+        valid_sets = [valid_sets]
+    valid_sets = list(valid_sets or [])
+    valid_names = list(valid_names or [])
+    for b in boosters:
+        for i, vs in enumerate(valid_sets):
+            name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
+            b.add_valid(vs, name)
+
+    # per-member callback instances: early_stopping keeps closure state,
+    # so each member needs its own (factories, not shared instances)
+    factories = list(callbacks or [])
+    per_member_after: List[List[Callable]] = []
+    for b in boosters:
+        cbs = [f() for f in factories]
+        bc = b.config
+        if bc.early_stopping_round and bc.early_stopping_round > 0:
+            cbs.append(
+                early_stopping(
+                    bc.early_stopping_round, bc.first_metric_only,
+                    verbose=bc.verbosity > 0,
+                    min_delta=bc.early_stopping_min_delta,
+                )
+            )
+        cbs = [cb for cb in cbs if not getattr(cb, "before_iteration", False)]
+        cbs.sort(key=lambda cb: getattr(cb, "order", 0))
+        per_member_after.append(cbs)
+
+    trainer = FleetTrainer(boosters)
+    last_eval: List[List] = [[] for _ in boosters]
+    for it in range(num_boost_round):
+        was_active = trainer.active_members()
+        trainer.update()
+        for i in was_active:
+            b = boosters[i]
+            evals: List = []
+            if (it + 1) % max(1, b.config.metric_freq) == 0 or (
+                it + 1 == num_boost_round
+            ):
+                with global_timer.timed("boosting/eval"):
+                    evals = b.eval_valid(feval)
+                if evals:
+                    last_eval[i] = evals
+            try:
+                for cb in per_member_after[i]:
+                    cb(
+                        CallbackEnv(
+                            model=b,
+                            params=b.params,
+                            iteration=it,
+                            begin_iteration=0,
+                            end_iteration=num_boost_round,
+                            evaluation_result_list=evals,
+                        )
+                    )
+            except EarlyStopException as e:
+                b.best_iteration = e.best_iteration + 1
+                last_eval[i] = e.best_score
+                trainer.stop_member(i)
+        if trainer.done():
+            break
+    for b, evals in zip(boosters, last_eval):
+        b.best_score = {}
+        for item in evals or []:
+            data_name, eval_name, val = item[0], item[1], item[2]
+            b.best_score.setdefault(data_name, {})[eval_name] = val
+    if cfg.verbosity >= 1:
+        log_info(global_timer.summary())
+        if ses.enabled:
+            log_info(_deep_obs_summary(ses))
+    return boosters
+
+
 def _fmt_bytes(v: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(v) < 1024.0 or unit == "GiB":
@@ -437,8 +582,19 @@ def cv(
     eval_train_metric: bool = False,
     return_cvbooster: bool = False,
     fobj: Optional[Callable] = None,
+    fleet: bool = False,
 ) -> Dict[str, List[float]]:
-    """K-fold cross-validation (reference: engine.py:627)."""
+    """K-fold cross-validation (reference: engine.py:627).
+
+    ``fleet=True`` trains all folds in lockstep through ONE vmapped grow
+    executable (boosting/fleet.py): folds become per-member row masks on
+    the SHARED full-data binning instead of per-fold rebuilt Datasets, so
+    one batched grow per iteration replaces nfold serial grows.  Metric
+    values differ slightly from the legacy loop (shared bin boundaries
+    and boost_from_average computed over the full data rather than per
+    fold); each fold's trained model is byte-identical to a solo
+    mask-based run of that fold.  Ranking objectives and custom ``fobj``
+    fall back to the legacy per-fold loop with a warning."""
     params = dict(params or {})
     if metrics is not None:
         params["metric"] = metrics
@@ -470,6 +626,20 @@ def cv(
             f if len(f) == 4 else (*f, *_fold_groups(train_set, f, need_query))
             for f in folds
         ]
+
+    if fleet:
+        if need_query or fobj is not None or init_model is not None:
+            from .utils.log import log_warning
+
+            log_warning(
+                "cv(fleet=True) supports non-ranking objectives without "
+                "fobj/init_model; falling back to the legacy per-fold loop"
+            )
+        else:
+            return _cv_fleet(
+                params, cfg, train_set, num_boost_round, folds, feval,
+                callbacks, eval_train_metric, return_cvbooster,
+            )
 
     cvbooster = CVBooster()
     raw = train_set.raw
@@ -538,6 +708,103 @@ def cv(
                         evaluation_result_list=agg,
                     )
                 )
+    except EarlyStopException as e:
+        cvbooster.best_iteration = e.best_iteration + 1
+        for key in list(results.keys()):
+            results[key] = results[key][: cvbooster.best_iteration]
+    if return_cvbooster:
+        results["cvbooster"] = cvbooster  # type: ignore[assignment]
+    return results
+
+
+def _cv_fleet(
+    params: Dict[str, Any],
+    cfg: Config,
+    train_set: Dataset,
+    num_boost_round: int,
+    folds,
+    feval: Optional[Callable],
+    callbacks: Optional[List[Callable]],
+    eval_train_metric: bool,
+    return_cvbooster: bool,
+) -> Dict[str, List[float]]:
+    """Fleet-mode cv: every fold is a row-masked member of ONE lockstep
+    fleet over the shared full-data binning — one vmapped grow per
+    iteration instead of nfold serial grows (see boosting/fleet.py).
+
+    The oracle for this path is the sequential mask-based loop: training
+    fold i alone with ``set_row_mask(fold_i)`` produces the byte-identical
+    model (tests/test_fleet.py); the legacy rebuild-the-Dataset cv differs
+    by bin boundaries, which is a documented fleet-mode trade."""
+    from .boosting.fleet import FleetTrainer
+
+    raw = train_set.raw
+    if raw is None:
+        raise ValueError(
+            "cv requires the training Dataset to keep raw data; construct it "
+            "with free_raw_data=False"
+        )
+    label = train_set.get_label()
+    weight = train_set.get_weight()
+    n = train_set.num_data
+    cvbooster = CVBooster()
+    for train_idx, test_idx, _tg, _ttg in folds:
+        booster = create_booster(params, train_set)
+        mask = np.zeros(n, np.float32)
+        mask[np.asarray(train_idx)] = 1.0
+        booster.set_row_mask(mask)
+        dtest = train_set.create_valid(
+            raw[test_idx],
+            label[test_idx],
+            weight=None if weight is None else weight[test_idx],
+        )
+        booster.add_valid(dtest, "valid")
+        cvbooster.append(booster)
+
+    results: Dict[str, List[float]] = {}
+    callbacks = list(callbacks or [])
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        callbacks.append(early_stopping(
+            cfg.early_stopping_round, cfg.first_metric_only, verbose=False,
+            min_delta=cfg.early_stopping_min_delta,
+        ))
+    callbacks_after = sorted(
+        [cb for cb in callbacks if not getattr(cb, "before_iteration", False)],
+        key=lambda cb: getattr(cb, "order", 0),
+    )
+
+    trainer = FleetTrainer(cvbooster.boosters)
+    try:
+        for it in range(num_boost_round):
+            trainer.update()
+            all_res: Dict[str, Any] = {}
+            for booster in cvbooster.boosters:
+                res = booster.eval_valid(feval)
+                if eval_train_metric:
+                    res = booster.eval_train(feval) + res
+                for data_name, name, val, hib in res:
+                    entry = all_res.setdefault(f"{data_name} {name}", ([], hib))
+                    entry[0].append(val)
+            agg = []
+            for key, (vals, hib) in all_res.items():
+                mean = float(np.mean(vals))
+                std = float(np.std(vals))
+                results.setdefault(f"{key}-mean", []).append(mean)
+                results.setdefault(f"{key}-stdv", []).append(std)
+                agg.append(("cv_agg", key, mean, hib, std))
+            for cb in callbacks_after:
+                cb(
+                    CallbackEnv(
+                        model=cvbooster,
+                        params=params,
+                        iteration=it,
+                        begin_iteration=0,
+                        end_iteration=num_boost_round,
+                        evaluation_result_list=agg,
+                    )
+                )
+            if trainer.done():
+                break
     except EarlyStopException as e:
         cvbooster.best_iteration = e.best_iteration + 1
         for key in list(results.keys()):

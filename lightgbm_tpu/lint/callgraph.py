@@ -461,7 +461,7 @@ class SpmdIndex:
         fn = scope.node
         if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for a in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
-                if a.arg == "axis_name":
+                if a.arg.endswith("axis_name"):
                     derived.add(a.arg)
         scope.axis_derived = derived
         statics = set(
@@ -504,13 +504,13 @@ class SpmdIndex:
             return cached
         hit = False
         for n in ast.walk(fn):
-            if isinstance(n, ast.Attribute) and n.attr == "axis_name":
+            if isinstance(n, ast.Attribute) and n.attr.endswith("axis_name"):
                 hit = True
                 break
-            if isinstance(n, ast.Name) and n.id == "axis_name":
+            if isinstance(n, ast.Name) and n.id.endswith("axis_name"):
                 hit = True
                 break
-            if isinstance(n, ast.arg) and n.arg == "axis_name":
+            if isinstance(n, ast.arg) and n.arg.endswith("axis_name"):
                 hit = True
                 break
         self._fn_axis_cache[id(fn)] = hit
@@ -521,10 +521,10 @@ class SpmdIndex:
         ``.axis_name`` access, an axis-derived name, or a call into an
         in-package function whose body reads the axis name."""
         for n in ast.walk(expr):
-            if isinstance(n, ast.Attribute) and n.attr == "axis_name":
+            if isinstance(n, ast.Attribute) and n.attr.endswith("axis_name"):
                 return True
             if isinstance(n, ast.Name) and (
-                n.id == "axis_name" or n.id in scope.axis_derived
+                n.id.endswith("axis_name") or n.id in scope.axis_derived
             ):
                 return True
             if isinstance(n, ast.Call):

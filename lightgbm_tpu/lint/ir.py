@@ -545,6 +545,59 @@ def build_entry_specs() -> List[EntrySpec]:
     # production path — traces the seg/partition pallas kernels for GL014
     specs.append(grow_entry("grow/seg_fused", "data", 1, 1, hist_mode="seg"))
 
+    # ---- fleet grow (perf-gate fleet scenario): the M=4 vmapped grow
+    # step on the data mesh.  Every collective payload inside the member
+    # vmap carries a leading [M] axis, so the sanctioned per-site bytes
+    # are exactly M x the solo model (the same scaling
+    # fleet_psum_bytes_per_iteration pins analytically).
+    FLEET_M = 4
+
+    def build_fleet_grow():
+        from ..parallel.mesh import make_fleet_grow
+
+        spec, mesh = _entry_mesh("data", 8, 1)
+        params = _grower_params(measure_collectives=True)
+        fn = make_fleet_grow(mesh, params, spec)
+        ops = list(_grow_operands(N, F))
+        for idx in (1, 2, 3, 6, 9):  # grad, hess, mask, feature_mask, rng
+            o = ops[idx]
+            ops[idx] = _sds((FLEET_M,) + o.shape, o.dtype)
+        return fn, tuple(ops), {}
+
+    def _fleet_psum_model():
+        from ..parallel.mesh import MeshSpec
+
+        solo = _grow_psum_model(MeshSpec("data", data=8), leaf_batch=1)
+        model = {
+            axis: frozenset(FLEET_M * v for v in vals)
+            for axis, vals in solo.items()
+        }
+        # capacity-ladder pmax over the vmapped member axis: a scalar i32
+        # bucket size per member (the only cross-member collective).  The
+        # vmap batching rule rewrites the named-axis pmax into a
+        # positional reduction, so the jaxpr records axis '0' with the
+        # batched [M] operand
+        for ax in ("fleet", "0"):
+            model[ax] = frozenset({4, FLEET_M * 4})
+        return model
+
+    specs.append(
+        EntrySpec(
+            name="grow/fleet_m4_data8",
+            build=build_fleet_grow,
+            anchor=_anchor(grower_mod, "grow_tree"),
+            axes=frozenset({"data", "fleet", "0"}),
+            psum_model=_fleet_psum_model,
+            root_modules=(
+                "ops/grower.py",
+                "parallel/mesh.py",
+                "obs/collectives.py",
+                "ops/histogram.py",
+                "ops/split.py",
+            ),
+        )
+    )
+
     # ---- quantized training entries (perf-gate quantized scenario)
     def build_quantize():
         fn = quantize_mod.quantize_gradients

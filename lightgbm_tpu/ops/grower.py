@@ -179,6 +179,15 @@ class GrowerParams:
     # psum/* site).  Structurally off at leaf_batch=1 — the serial loop
     # has nothing to overlap with.  gbdt resolves 'auto'/'on'/'off'.
     overlap_collectives: bool = False
+    # vmapped model-fleet training (parallel/mesh.make_fleet_grow): name of
+    # the vmap model axis.  Capacity-bucket switch indices are pmax'd over
+    # this axis before the searchsorted: vmap's collective batching rule
+    # reduces over the mapped dimension and returns an UNMAPPED value, so
+    # the ladder switch lowers ONE shared branch for the whole fleet instead
+    # of executing every branch (the select-all-branches rule for batched
+    # switch indices — measured ~8x per-member at 64k rows).  Capacity only
+    # pads, so the max member's bucket is value-preserving for the rest.
+    fleet_axis_name: Optional[str] = None
 
 
 def _hist_caps(n: int, full_range: bool = False) -> list:
@@ -697,6 +706,29 @@ def fetch_tree_arrays(ta: "TreeArrays") -> "TreeArrays":
     return unpack_tree_arrays(np.asarray(ints_d), np.asarray(floats_d), nn, L)
 
 
+# fleet variant: one vmapped pack of the whole [M, ...] stacked TreeArrays,
+# so M models cost the SAME two host transfers as one (boosting/fleet.py)
+pack_fleet_tree_arrays = instrumented_jit(
+    jax.vmap(_pack_tree_arrays_impl), label="fleet/pack_tree_arrays"
+)
+
+
+def fetch_fleet_tree_arrays(ta: "TreeArrays"):
+    """Pull a fleet-stacked [M, ...] device TreeArrays to host in two
+    transfers; returns a list of M per-member host TreeArrays, each
+    identical to what ``fetch_tree_arrays`` would return for that member's
+    slice."""
+    import numpy as np
+
+    ints_d, floats_d = pack_fleet_tree_arrays(ta)
+    m = ta.split_feature.shape[0]
+    nn = ta.split_feature.shape[1]  # L - 1
+    L = ta.leaf_value.shape[1]
+    ints = np.asarray(ints_d)
+    floats = np.asarray(floats_d)
+    return [unpack_tree_arrays(ints[i], floats[i], nn, L) for i in range(m)]
+
+
 @functools.partial(instrumented_jit, static_argnames=("params",))
 def grow_tree(
     bins: jnp.ndarray,  # [N, F] int32
@@ -723,6 +755,17 @@ def grow_tree(
     p = params
     n, f = bins.shape
     L, B = p.num_leaves, p.max_bin
+
+    def _cap_size(x):
+        # uniform capacity-bucket sizing across the fleet model axis
+        # (see GrowerParams.fleet_axis_name)
+        if not p.fleet_axis_name:
+            return x
+        return timed_pmax(
+            x, p.fleet_axis_name, site="fleet_cap",
+            measure=p.measure_collectives,
+        )
+
     use_bundle = p.use_bundle and bundle_end is not None
     if not use_bundle:
         bundle_end = None
@@ -1633,6 +1676,8 @@ def grow_tree(
                     n_pad=n_pad_seg,
                     wide=seg_wide,
                     gl_vec=gl_vec,
+                    fleet_axis_name=p.fleet_axis_name,
+                    measure=p.measure_collectives,
                 )
             if p.axis_name is not None:
                 # global smaller-child choice (see gather-mode comment)
@@ -1656,7 +1701,7 @@ def grow_tree(
             begin_l = st.leaf_begin[l]
             cnt_l = jnp.where(can_split, st.leaf_nrows[l], 0)
             pbucket = jnp.clip(
-                jnp.searchsorted(pcaps_arr, cnt_l, side="left"),
+                jnp.searchsorted(pcaps_arr, _cap_size(cnt_l), side="left"),
                 0,
                 len(pcaps) - 1,
             ).astype(jnp.int32)
@@ -1690,7 +1735,9 @@ def grow_tree(
             child_start = begin_l + jnp.where(left_smaller, 0, nleft)
             child_cnt = jnp.where(left_smaller, nleft, nright)
             cbucket = jnp.clip(
-                jnp.searchsorted(caps_arr, tc, side="left"), 0, len(caps) - 1
+                jnp.searchsorted(caps_arr, _cap_size(tc), side="left"),
+                0,
+                len(caps) - 1,
             ).astype(jnp.int32)
             with jax.named_scope("histogram"):
                 sm = lax.switch(
@@ -1747,7 +1794,9 @@ def grow_tree(
                 target = jnp.where(left_smaller, l, nl)
                 tc = jnp.minimum(rows_l, rows_r)
             bucket = jnp.clip(
-                jnp.searchsorted(caps_arr, tc, side="left"), 0, len(caps) - 1
+                jnp.searchsorted(caps_arr, _cap_size(tc), side="left"),
+                0,
+                len(caps) - 1,
             ).astype(jnp.int32)
             with jax.named_scope("histogram"):
                 sm = lax.switch(bucket, hist_branches, (leaf_id == target) & can_split)
@@ -2393,7 +2442,9 @@ def grow_tree(
                 nleft_list = []
                 for i in range(K):
                     pbucket_i = jnp.clip(
-                        jnp.searchsorted(pcaps_arr, cnt_k[i], side="left"),
+                        jnp.searchsorted(
+                            pcaps_arr, _cap_size(cnt_k[i]), side="left"
+                        ),
                         0,
                         len(pcaps) - 1,
                     ).astype(jnp.int32)
@@ -2426,7 +2477,9 @@ def grow_tree(
                 done_halves = []
                 for i in range(K):
                     cbucket_i = jnp.clip(
-                        jnp.searchsorted(caps_arr, tc_k[i], side="left"),
+                        jnp.searchsorted(
+                            caps_arr, _cap_size(tc_k[i]), side="left"
+                        ),
                         0,
                         len(caps) - 1,
                     ).astype(jnp.int32)
@@ -2510,7 +2563,9 @@ def grow_tree(
                     done_halves = []
                     for i in range(K):
                         bucket_i = jnp.clip(
-                            jnp.searchsorted(caps_arr, tc_k[i], side="left"),
+                            jnp.searchsorted(
+                                caps_arr, _cap_size(tc_k[i]), side="left"
+                            ),
                             0,
                             len(caps) - 1,
                         ).astype(jnp.int32)

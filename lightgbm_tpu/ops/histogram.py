@@ -27,6 +27,60 @@ import jax.numpy as jnp
 from ..obs.collectives import timed_psum
 
 
+@functools.lru_cache(maxsize=None)
+def _segment_hist_fn(num_bins: int):
+    """Per-``num_bins`` segment-sum histogram with a fleet-aware vmap rule.
+
+    Under ``jax.vmap`` (model-fleet training batches grad/hess/mask over a
+    leading member axis M) the default batching of ``segment_sum`` emits one
+    scatter per member.  The custom rule instead folds the member axis into
+    the segment ids — ``id += member * (F * B)`` — so all M histograms
+    accumulate in a single segment_sum launch over ``M * F * B`` segments.
+    Float adds happen in the same per-(row, feature, bin) order as the
+    unbatched kernel, so each member's [F, B, 3] plane is byte-identical to
+    its solo run.  ``num_bins`` is closed over (lru_cached) because
+    custom_vmap arguments must all be array operands.
+    """
+
+    @jax.custom_batching.custom_vmap
+    def impl(bins, grad, hess, mask):
+        n, f = bins.shape
+        ids = (bins + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins).reshape(-1)
+        g = (grad * mask)[:, None]
+        h = (hess * mask)[:, None]
+        c = mask[:, None]
+        data = jnp.broadcast_to(
+            jnp.concatenate([g, h, c], axis=1)[:, None, :], (n, f, 3)
+        ).reshape(-1, 3)
+        hist = jax.ops.segment_sum(data, ids, num_segments=f * num_bins)
+        return hist.reshape(f, num_bins, 3)
+
+    @impl.def_vmap
+    def impl_vmap(axis_size, in_batched, bins, grad, hess, mask):
+        m = axis_size
+
+        def bcast(x, batched):
+            return x if batched else jnp.broadcast_to(x[None], (m,) + x.shape)
+
+        bins_b = bcast(bins, in_batched[0])
+        grad_b = bcast(grad, in_batched[1])
+        hess_b = bcast(hess, in_batched[2])
+        mask_b = bcast(mask, in_batched[3])
+        _, n, f = bins_b.shape
+        ids = bins_b + jnp.arange(f, dtype=jnp.int32)[None, None, :] * num_bins
+        ids = ids + (jnp.arange(m, dtype=jnp.int32) * (f * num_bins))[:, None, None]
+        ghc = jnp.stack(
+            [grad_b * mask_b, hess_b * mask_b, mask_b], axis=-1
+        )  # [M, N, 3]
+        data = jnp.broadcast_to(ghc[:, :, None, :], (m, n, f, 3)).reshape(-1, 3)
+        hist = jax.ops.segment_sum(
+            data, ids.reshape(-1), num_segments=m * f * num_bins
+        )
+        return hist.reshape(m, f, num_bins, 3), True
+
+    return impl
+
+
 def leaf_histogram_segment(
     bins: jnp.ndarray,  # [N, F] int32 bin indices
     grad: jnp.ndarray,  # [N] f32
@@ -34,17 +88,11 @@ def leaf_histogram_segment(
     mask: jnp.ndarray,  # [N] f32 — 1 for rows of the target leaf (in-bag), else 0
     num_bins: int,
 ) -> jnp.ndarray:
-    """Masked histogram via segment_sum. Returns [F, B, 3] (g, h, count)."""
-    n, f = bins.shape
-    ids = (bins + jnp.arange(f, dtype=jnp.int32)[None, :] * num_bins).reshape(-1)
-    g = (grad * mask)[:, None]
-    h = (hess * mask)[:, None]
-    c = mask[:, None]
-    data = jnp.broadcast_to(
-        jnp.concatenate([g, h, c], axis=1)[:, None, :], (n, f, 3)
-    ).reshape(-1, 3)
-    hist = jax.ops.segment_sum(data, ids, num_segments=f * num_bins)
-    return hist.reshape(f, num_bins, 3)
+    """Masked histogram via segment_sum. Returns [F, B, 3] (g, h, count).
+
+    Vmapping over a leading member axis (fleet training) collapses into one
+    flattened segment_sum launch — see ``_segment_hist_fn``."""
+    return _segment_hist_fn(int(num_bins))(bins, grad, hess, mask)
 
 
 def leaf_histogram_onehot(

@@ -59,7 +59,8 @@ def _go_left(colv, tbin, dl, nanb, iscat, catmask):
 
 
 @functools.partial(
-    instrumented_jit, static_argnames=("f", "n_pad", "wide", "use_gl_vec")
+    instrumented_jit,
+    static_argnames=("f", "n_pad", "wide", "use_gl_vec"),
 )
 def sort_partition_xla(
     seg: jnp.ndarray,  # [LANES, n_pad] i16 packed rows, PLANE-MAJOR — the
@@ -79,6 +80,8 @@ def sort_partition_xla(
     n_pad: int,
     wide: bool = False,
     use_gl_vec: bool = False,
+    cnt_cap: Optional[jnp.ndarray] = None,  # fleet-wide max cnt (bucket
+    #   sizing only; defaults to cnt — see sort_partition)
 ):
     """Partition seg[sbegin : sbegin+cnt) by the split rule.
 
@@ -144,8 +147,13 @@ def sort_partition_xla(
         return branch
 
     caps_arr = jnp.asarray(caps, dtype=jnp.int32)
+    # fleet-vmapped growth: the caller pre-reduces cnt over the model axis
+    # (cnt_cap) so ONE window branch lowers for the whole fleet — the
+    # collective stays OUTSIDE the platform branches (sort_partition)
+    if cnt_cap is None:
+        cnt_cap = cnt
     bucket = jnp.clip(
-        jnp.searchsorted(caps_arr, cnt, side="left"), 0, len(caps) - 1
+        jnp.searchsorted(caps_arr, cnt_cap, side="left"), 0, len(caps) - 1
     ).astype(jnp.int32)
     branches = [make_branch(P) for P in caps]
     seg_new, nl = lax.switch(
@@ -158,7 +166,8 @@ def sort_partition_xla(
 
 def sort_partition(
     seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask, *, f: int,
-    n_pad: int, wide: bool = False, gl_vec=None,
+    n_pad: int, wide: bool = False, gl_vec=None, fleet_axis_name=None,
+    measure: bool = False,
 ):
     """Platform dispatch for the segment partition: the Pallas streaming
     kernel on TPU (ops/pallas/partition.py — exact window, in place, no
@@ -169,11 +178,21 @@ def sort_partition(
     precomputed [n_pad] bit vector; the Pallas kernel DMAs a bits tile per
     row tile instead of reading the feature column."""
     from .pallas.partition import seg_partition_pallas
+    from ..obs.collectives import timed_pmax
 
     use_gl = gl_vec is not None
+    # fleet-vmapped growth: reduce cnt over the model axis HERE, outside
+    # the platform branches, so both lower the same collective sequence
+    # (none) and the XLA window ladder sizes one shared branch
+    if fleet_axis_name is not None:
+        cnt_cap = timed_pmax(
+            cnt, fleet_axis_name, site="fleet_cap", measure=measure
+        )
+    else:
+        cnt_cap = cnt
 
-    def _pallas(seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask,
-                *maybe_gl):
+    def _pallas(seg, sbegin, cnt, cnt_cap, feat, tbin, dl, nanb, iscat,
+                catmask, *maybe_gl):
         bm = catmask.shape[0]
         bmt = max(256, -(-bm // 128) * 128)  # cat-table width (wide bins)
         catm = jnp.zeros((1, bmt), jnp.float32)
@@ -187,15 +206,16 @@ def sort_partition(
         )
         return seg_new, nl, cnt - nl
 
-    def _xla(seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask,
-             *maybe_gl):
+    def _xla(seg, sbegin, cnt, cnt_cap, feat, tbin, dl, nanb, iscat,
+             catmask, *maybe_gl):
         return sort_partition_xla(
             seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask,
             maybe_gl[0] if maybe_gl else None,
             f=f, n_pad=n_pad, wide=wide, use_gl_vec=use_gl,
+            cnt_cap=cnt_cap,
         )
 
-    args = (seg, sbegin, cnt, feat, tbin, dl, nanb, iscat, catmask)
+    args = (seg, sbegin, cnt, cnt_cap, feat, tbin, dl, nanb, iscat, catmask)
     if use_gl:
         args = args + (gl_vec,)
     if jax.default_backend() != "tpu":

@@ -215,6 +215,98 @@ def make_mesh_grow(mesh: Optional[Mesh], params: GrowerParams,
     return instrumented_jit(fn, label="parallel/sharded_grow")
 
 
+# vmap model-axis name for fleet training.  Distinct from the mesh axes:
+# the fleet axis is a vmap batching axis INSIDE the shard_map body, used
+# only to unmap capacity-bucket indices (GrowerParams.fleet_axis_name).
+FLEET_AXIS = "fleet"
+
+
+def make_fleet_grow(mesh: Optional[Mesh], params: GrowerParams,
+                    spec: Optional[MeshSpec] = None):
+    """The fleet grow path: ``grow_tree`` vmapped over a leading model axis
+    M, composed INSIDE the same shard_map the solo path uses.
+
+    Operand batching (leading [M] axis): grad, hess, count_mask,
+    feature_mask, rng.  Everything else — the [N, P] bin planes, bin
+    metadata, constraint tables — is shared across members, so the batched
+    histogram builds reuse ONE resident bin matrix and the data-mesh
+    histogram psum moves one stacked [M, K, F, B, 3] payload per step
+    instead of M separate ones.  Outputs come back stacked: TreeArrays
+    [M, ...] and leaf_id [M, N].
+
+    Member arrays ride the mesh with the member axis REPLICATED and rows
+    sharded (``P(None, 'data')``) — each shard holds its row slice of every
+    member.  The vmap carries ``axis_name=FLEET_AXIS`` so the grower can
+    pmax capacity-bucket indices across members (one shared ladder branch;
+    see GrowerParams.fleet_axis_name).  Per-member byte parity vs the solo
+    path is the acceptance oracle (tests/test_fleet.py).
+    """
+    if spec is None:
+        spec = MeshSpec("data", data=mesh.size if mesh is not None else 1)
+    p = dataclasses.replace(
+        grower_axis_params(params, spec), fleet_axis_name=FLEET_AXIS
+    )
+
+    def local(bins, grad, hess, mask, num_bins, nan_bins, feature_mask,
+              monotone, interaction_sets, rng, is_cat, forced, cegb_penalty,
+              cegb_used, quant_scales, bundle_end, feature_contri):
+        return grow_tree(
+            bins, grad, hess, mask, num_bins, nan_bins, feature_mask, p,
+            monotone=monotone, interaction_sets=interaction_sets, rng=rng,
+            is_cat=is_cat, forced=forced, cegb_penalty=cegb_penalty,
+            cegb_used=cegb_used, quant_scales=quant_scales,
+            bundle_end=bundle_end, feature_contri=feature_contri,
+        )
+
+    # member axis on grad/hess/mask/feature_mask/rng; all else shared
+    in_axes = (None, 0, 0, 0, None, None, 0, None, None, 0, None, None,
+               None, None, None, None, None)
+    batched = jax.vmap(local, in_axes=in_axes, axis_name=FLEET_AXIS)
+
+    if mesh is None or mesh.size == 1:
+        return instrumented_jit(batched, label="fleet/grow")
+
+    rep = role_spec("replicated")
+    mrows = P(None, DATA_AXIS)  # [M, N]: members replicated, rows sharded
+    fn = _shard_map(
+        batched,
+        mesh=mesh,
+        in_specs=(role_spec("bins"), mrows, mrows, mrows, rep, rep, rep, rep,
+                  rep, rep, rep, rep, rep, rep, rep, rep, rep),
+        out_specs=(
+            jax.tree.map(
+                lambda _: role_spec("tree"),
+                TreeArrays(*([0] * len(TreeArrays._fields))),
+            ),
+            mrows,
+        ),
+    )
+    return instrumented_jit(fn, label="fleet/grow")
+
+
+def fleet_psum_bytes_per_iteration(
+    n_splits: int,
+    n_features: int,
+    num_bins: int,
+    fleet: int,
+    leaf_batch: int = 1,
+    spec: Optional[MeshSpec] = None,
+) -> dict:
+    """Analytic per-iteration psum bytes for an M-member fleet: the batched
+    grow issues the SAME collective sites as one member with every payload
+    carrying an extra leading [M] axis, so each entry is exactly M x the
+    solo model.  Kept as its own function (not a multiplier at call sites)
+    so the perf gate and the fleet bench pin one shared formula."""
+    solo = mesh_psum_bytes_per_iteration(
+        n_splits, n_features, num_bins, leaf_batch=leaf_batch, spec=spec
+    )
+    m = max(1, int(fleet))
+    out = {k: v * m for k, v in solo.items()}
+    out["steps"] = solo["steps"]  # lockstep: shared trip count, M x payload
+    out["fleet"] = m
+    return out
+
+
 def mesh_psum_bytes_per_iteration(
     n_splits: int,
     n_features: int,

@@ -127,6 +127,51 @@ class ModelRegistry:
             ses.inc("serve/load_total")
         return entry
 
+    def register_fleet(
+        self,
+        boosters,
+        *,
+        model_ids=None,
+        prefix: str = "fleet",
+        warm: bool = True,
+    ) -> List[ModelEntry]:
+        """Bulk-register a trained model fleet (engine.train_fleet output).
+
+        Members become independent entries named ``{prefix}/{i}`` (or the
+        explicit ``model_ids``), each AOT-warmed before it is visible to
+        dispatchers.  Every load runs under the registry's existing memory
+        budget: a fleet larger than the budget admits members in order and
+        LRU-evicts idle earlier ones, exactly like any other load — there
+        is no fleet-wide reservation.  On a member's warm-up failure the
+        members already registered STAY live and the error propagates, so
+        callers can retry or shrink the fleet without losing progress."""
+        boosters = list(boosters)
+        if model_ids is not None:
+            ids = [str(m) for m in model_ids]
+            if len(ids) != len(boosters):
+                raise ValueError(
+                    f"model_ids has {len(ids)} entries for "
+                    f"{len(boosters)} boosters"
+                )
+        else:
+            ids = [f"{prefix}/{i}" for i in range(len(boosters))]
+        if len(set(ids)) != len(ids):
+            raise ValueError("fleet model ids must be unique")
+        with self._lock:
+            clash = [m for m in ids if m in self._live]
+        if clash:
+            raise ValueError(
+                f"model ids already loaded: {clash}; use hot_swap"
+            )
+        entries = []
+        for mid, b in zip(ids, boosters):
+            entries.append(self.load(mid, b, warm=warm))
+        ses = get_session()
+        if ses.enabled:
+            ses.inc("serve/fleet_register_total")
+            ses.set_gauge("serve/fleet_size", len(entries))
+        return entries
+
     def hot_swap(self, model_id: str, booster) -> ModelEntry:
         """Atomically replace the live version of ``model_id``.
 

@@ -1,0 +1,310 @@
+"""Vmapped model-fleet training (engine.train_fleet / boosting/fleet.py).
+
+The acceptance oracle is BYTE parity: every fleet member's model dump must
+equal the dump a solo run of the same effective params produces — the fleet
+is an execution strategy, never a semantic change.  The second oracle is the
+compile counter: one fleet = one grow executable ("fleet/grow" compiles
+exactly once), proving members with different finish times ride the same
+warm program as zero-fed lanes.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.boosting import create_booster
+from lightgbm_tpu.boosting.fleet import FleetTrainer
+from lightgbm_tpu.obs.jit import compile_counts_by_label
+from lightgbm_tpu.serving.registry import ModelRegistry
+
+RNG = np.random.default_rng(0)
+N, F = 500, 6
+X = RNG.normal(size=(N, F))
+Y = X[:, 0] * 2 + np.sin(3 * X[:, 1]) + RNG.normal(scale=0.1, size=N)
+
+BASE = {
+    "objective": "regression",
+    "num_leaves": 8,
+    "min_data_in_leaf": 5,
+    "verbosity": -1,
+}
+
+
+def _solo_dumps(param_sets, rounds, masks=None):
+    """Oracle: train each member alone (mask-based when masks given)."""
+    dumps = []
+    for i, p in enumerate(param_sets):
+        ds = lgb.Dataset(X, Y, free_raw_data=False)
+        b = create_booster(dict(p), ds)
+        if masks is not None and masks[i] is not None:
+            b.set_row_mask(masks[i])
+        for _ in range(rounds):
+            if b.update():
+                break
+        dumps.append(b.model_to_string())
+    return dumps
+
+
+def _fleet_dumps(param_sets, rounds, masks=None):
+    ds = lgb.Dataset(X, Y, free_raw_data=False)
+    boosters = lgb.train_fleet(
+        param_sets, ds, num_boost_round=rounds, row_masks=masks
+    )
+    return [b.model_to_string() for b in boosters]
+
+
+def _assert_parity(param_sets, rounds, masks=None):
+    fleet = _fleet_dumps(param_sets, rounds, masks)
+    solo = _solo_dumps(param_sets, rounds, masks)
+    for i, (f, s) in enumerate(zip(fleet, solo)):
+        assert f == s, f"member {i} diverged from its solo run"
+
+
+# ----------------------------------------------------------------- parity
+
+
+def test_fleet_parity_mixed_and_zero_retrace():
+    """Plain seed/lr sweep + bagging + extra-trees in ONE fleet, with the
+    compile counter proving a single grow executable served all of it."""
+    before = dict(compile_counts_by_label())
+    param_sets = [
+        dict(BASE, seed=1, learning_rate=0.1),
+        dict(BASE, seed=2, learning_rate=0.3),
+        dict(BASE, seed=3, learning_rate=0.1, bagging_fraction=0.7,
+             bagging_freq=1),
+        dict(BASE, seed=4, learning_rate=0.2, bagging_fraction=0.5,
+             bagging_freq=2),
+    ]
+    fleet = _fleet_dumps(param_sets, 5)
+    after = dict(compile_counts_by_label())
+    for label in ("fleet/grow", "fleet/pack_tree_arrays"):
+        delta = after.get(label, 0) - before.get(label, 0)
+        assert delta == 1, f"{label} compiled {delta} times for one fleet"
+    solo = _solo_dumps(param_sets, 5)
+    for i, (f, s) in enumerate(zip(fleet, solo)):
+        assert f == s, f"member {i} diverged from its solo run"
+
+
+def test_fleet_parity_extra_trees_seed_sweep():
+    # extra_trees lives inside GrowerParams, so ALL members must enable it;
+    # the sweep axis is extra_seed
+    _assert_parity(
+        [
+            dict(BASE, seed=1, learning_rate=0.1, extra_trees=True,
+                 extra_seed=11),
+            dict(BASE, seed=1, learning_rate=0.1, extra_trees=True,
+                 extra_seed=99),
+        ],
+        4,
+    )
+
+
+def test_fleet_parity_goss_sweep():
+    # learning_rate 0.5 -> GOSS warmup of 2 iterations, so sampling is live
+    _assert_parity(
+        [
+            dict(BASE, boosting="goss", seed=1, learning_rate=0.5,
+                 top_rate=0.2, other_rate=0.1),
+            dict(BASE, boosting="goss", seed=2, learning_rate=0.5,
+                 top_rate=0.3, other_rate=0.2),
+        ],
+        5,
+    )
+
+
+def test_fleet_parity_cv_row_masks():
+    m0 = np.zeros(N, np.float32)
+    m0[: N // 2] = 1.0
+    m1 = np.zeros(N, np.float32)
+    m1[N // 2:] = 1.0
+    _assert_parity(
+        [dict(BASE, seed=1, learning_rate=0.1)] * 2, 4, masks=[m0, m1]
+    )
+
+
+def test_fleet_parity_data_parallel():
+    # conftest forces 8 virtual CPU devices; the stacked [M, K, F, B, 3]
+    # histogram psums one payload per step for the whole fleet
+    _assert_parity(
+        [
+            dict(BASE, tree_learner="data", seed=1, learning_rate=0.1),
+            dict(BASE, tree_learner="data", seed=2, learning_rate=0.2),
+        ],
+        4,
+    )
+
+
+def test_fleet_parity_m8():
+    _assert_parity(
+        [dict(BASE, seed=s, learning_rate=0.1) for s in range(8)], 3
+    )
+
+
+def test_num_fleet_dict_expansion():
+    """One dict + num_fleet=M expands to M members with offset seeds, each
+    byte-equal to a solo run of its effective params."""
+    ds = lgb.Dataset(X, Y, free_raw_data=False)
+    fleet = lgb.train_fleet(
+        dict(BASE, seed=5, learning_rate=0.1, num_fleet=3),
+        ds,
+        num_boost_round=3,
+    )
+    assert len(fleet) == 3
+    solo = _solo_dumps(
+        [dict(BASE, seed=5 + i, learning_rate=0.1, num_fleet=3)
+         for i in range(3)],
+        3,
+    )
+    for i, b in enumerate(fleet):
+        assert b.model_to_string() == solo[i], f"member {i} diverged"
+
+
+# --------------------------------------------------------------------- cv
+
+
+def test_cv_fleet_matches_sequential_mask_loop():
+    """cv(fleet=True)'s oracle is the sequential mask-based loop over the
+    SHARED binning (not legacy cv, which re-bins per fold — a documented
+    fleet-mode difference)."""
+    idx = np.arange(N)
+    folds = [
+        (idx[N // 3:], idx[: N // 3]),
+        (np.concatenate([idx[: N // 3], idx[2 * N // 3:]]),
+         idx[N // 3: 2 * N // 3]),
+        (idx[: 2 * N // 3], idx[2 * N // 3:]),
+    ]
+    ds = lgb.Dataset(X, Y, free_raw_data=False)
+    params = dict(BASE, seed=7, learning_rate=0.1, metric="l2")
+    res = lgb.cv(
+        params, ds, num_boost_round=4, folds=folds, fleet=True,
+        return_cvbooster=True,
+    )
+    assert len(res["valid l2-mean"]) == 4
+    assert len(res["valid l2-stdv"]) == 4
+    fleet_dumps = [
+        b.model_to_string() for b in res["cvbooster"].boosters
+    ]
+
+    # sequential oracle: per-fold mask-based training on the same binning
+    masks = []
+    for train_idx, _test_idx in folds:
+        m = np.zeros(N, np.float32)
+        m[np.asarray(train_idx)] = 1.0
+        masks.append(m)
+    solo = _solo_dumps([dict(params)] * len(folds), 4, masks=masks)
+    for i, (f, s) in enumerate(zip(fleet_dumps, solo)):
+        assert f == s, f"fold {i} diverged from its sequential mask run"
+
+    # per-iteration mean really is the mean of the per-fold evals
+    evals = [b.eval_valid() for b in res["cvbooster"].boosters]
+    manual = float(np.mean([e[0][2] for e in evals]))
+    assert res["valid l2-mean"][-1] == pytest.approx(manual)
+
+
+def test_cv_fleet_falls_back_for_fobj():
+    ds = lgb.Dataset(X, Y, free_raw_data=False)
+
+    def fobj(preds, train_data):
+        y = train_data.get_label()
+        return preds - y, np.ones_like(preds)
+
+    res = lgb.cv(
+        dict(BASE, seed=1, learning_rate=0.1, metric="l2"),
+        ds, num_boost_round=2, nfold=2, fleet=True, fobj=fobj,
+    )
+    assert any(k.endswith("-mean") for k in res)
+
+
+# ------------------------------------------------------------ early stop
+
+
+def test_fleet_per_member_early_stopping():
+    """A member that early-stops freezes (best_iteration set, no further
+    trees) while the rest of the fleet trains on in the same executable."""
+    ds = lgb.Dataset(X, Y, free_raw_data=False)
+    dv = lgb.Dataset(
+        X[:100] + RNG.normal(scale=2.0, size=(100, F)), Y[:100],
+        free_raw_data=False, reference=ds,
+    )
+    param_sets = [
+        # huge lr on noisy valid -> stops almost immediately
+        dict(BASE, seed=1, learning_rate=5.0, metric="l2",
+             early_stopping_round=1, first_metric_only=True),
+        dict(BASE, seed=2, learning_rate=0.1, metric="l2"),
+    ]
+    fleet = lgb.train_fleet(
+        param_sets, ds, num_boost_round=8, valid_sets=[dv],
+        valid_names=["v"],
+    )
+    assert fleet[0].best_iteration > 0
+    assert fleet[0].current_iteration() < 8
+    assert fleet[1].current_iteration() == 8
+    # the survivor is still byte-equal to its solo run
+    solo = _solo_dumps([param_sets[1]], 8)[0]
+    assert fleet[1].model_to_string() == solo
+
+
+# ------------------------------------------------------------- serving
+
+
+def test_register_fleet_bulk():
+    ds = lgb.Dataset(X, Y, free_raw_data=False)
+    boosters = lgb.train_fleet(
+        [dict(BASE, seed=1, learning_rate=0.1),
+         dict(BASE, seed=2, learning_rate=0.3)],
+        ds, num_boost_round=3,
+    )
+    reg = ModelRegistry(chunk=256)
+    try:
+        entries = reg.register_fleet(boosters, prefix="sweep")
+        assert [e.model_id for e in entries] == ["sweep/0", "sweep/1"]
+        ids = {m["model_id"] for m in reg.models()}
+        assert ids == {"sweep/0", "sweep/1"}
+        for i, b in enumerate(boosters):
+            got = reg.booster(f"sweep/{i}").predict(X[:32])
+            np.testing.assert_array_equal(got, b.predict(X[:32]))
+        with pytest.raises(ValueError):
+            reg.register_fleet(boosters, prefix="sweep")  # id clash
+        with pytest.raises(ValueError):
+            reg.register_fleet(boosters, model_ids=["only-one"])
+    finally:
+        reg.close()
+
+
+# ----------------------------------------------------------- validation
+
+
+def test_fleet_rejects_shape_mismatch():
+    ds = lgb.Dataset(X, Y, free_raw_data=False)
+    with pytest.raises(ValueError, match="GrowerParams"):
+        lgb.train_fleet(
+            [dict(BASE, seed=1), dict(BASE, seed=2, num_leaves=31)],
+            ds, num_boost_round=2,
+        )
+
+
+def test_fleet_rejects_unsupported_features():
+    ds = lgb.Dataset(X, Y, free_raw_data=False)
+    with pytest.raises(ValueError, match="linear_tree"):
+        lgb.train_fleet(
+            [dict(BASE, seed=1, linear_tree=True)] * 2, ds,
+            num_boost_round=2,
+        )
+
+
+def test_fleet_rejects_bad_row_mask():
+    ds = lgb.Dataset(X, Y, free_raw_data=False)
+    b = create_booster(dict(BASE, seed=1), ds)
+    with pytest.raises(ValueError):
+        b.set_row_mask(np.zeros(N, np.float32))  # no live rows
+    with pytest.raises(ValueError):
+        b.set_row_mask(np.ones(N + 1, np.float32))  # wrong length
+
+
+def test_fleet_trainer_requires_shared_dataset():
+    ds1 = lgb.Dataset(X, Y, free_raw_data=False)
+    ds2 = lgb.Dataset(X, Y, free_raw_data=False)
+    b1 = create_booster(dict(BASE, seed=1), ds1)
+    b2 = create_booster(dict(BASE, seed=2), ds2)
+    with pytest.raises(ValueError, match="Dataset"):
+        FleetTrainer([b1, b2])

@@ -284,6 +284,35 @@ def collect() -> Dict[str, float]:
             metrics["collective/measured_hybrid_psum_bytes"] = round(
                 measured, 1
             )
+
+        # -- scenario 5: M=4 model fleet on the data mesh — ONE vmapped
+        # grow executable serves the whole fleet, so retrace/fleet/* is
+        # frozen at 1 compile per label, and the per-iteration psums
+        # collapse into one stacked [M, K, F, B, 3] payload (the analytic
+        # fleet model from parallel.mesh.fleet_psum_bytes_per_iteration,
+        # surfaced through the fleet/psum_* gauges FleetTrainer sets)
+        ses.reset()
+        labels_before = compile_counts_by_label()
+        t0 = time.perf_counter()
+        lgb.train_fleet(
+            [
+                {**base, "tree_learner": "data", "seed": 11 + i}
+                for i in range(4)
+            ],
+            lgb.Dataset(X, label=y, params=base),
+            num_boost_round=3,
+        )
+        metrics["wall/fleet_train_s"] = round(time.perf_counter() - t0, 3)
+        labels_after = compile_counts_by_label()
+        for label, count in sorted(labels_after.items()):
+            delta = count - labels_before.get(label, 0)
+            if delta:
+                metrics[f"retrace/fleet/{label}"] = float(delta)
+        fleet_analytic = float(
+            ses.gauges.get("fleet/psum_hist_bytes", 0.0)
+        ) + float(ses.gauges.get("fleet/psum_count_bytes", 0.0))
+        if fleet_analytic:
+            metrics["collective/analytic_fleet_bytes"] = fleet_analytic
     else:  # pragma: no cover - CI always has the virtual mesh
         print(
             f"perf_gate: only {ndev} cpu devices; skipping the "
