@@ -925,6 +925,126 @@ def ingest_sweep() -> dict:
         shutil.rmtree(td, ignore_errors=True)
 
 
+_LAUNCH_CELL_SCRIPT = r"""
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+n_rows, n_launch, rounds, leaves, mesh = (int(v) for v in sys.argv[1:6])
+if mesh:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs.jit import compile_counts_by_label
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(n_rows, 28))
+y = X @ rng.normal(size=28) * 0.5 + rng.normal(size=n_rows) * 0.1
+params = {
+    "objective": "regression", "num_leaves": leaves, "verbosity": -1,
+    "min_data_in_leaf": 20, "seed": 0,
+    "train_steps_per_launch": n_launch,
+}
+if mesh:
+    params.update({"tree_learner": "data", "num_machines": 8})
+ds = lgb.Dataset(X, y, free_raw_data=False)
+
+# warmup run in-process: compiles the grow/scan executable once so the
+# timed run measures steady-state launches, not tracing
+lgb.train(dict(params), ds, num_boost_round=2 * n_launch)
+c0 = dict(compile_counts_by_label())
+
+t0 = time.perf_counter()
+booster = lgb.train(dict(params), ds, num_boost_round=rounds)
+wall_s = time.perf_counter() - t0
+c1 = compile_counts_by_label()
+
+host_ms = list(booster._host_overhead_ms)
+print(json.dumps({
+    "steps_per_launch": n_launch,
+    "rows": n_rows,
+    "rounds": rounds,
+    "mesh": "data8" if mesh else "serial",
+    "wall_s": round(wall_s, 3),
+    "iter_ms": round(wall_s / rounds * 1e3, 2),
+    "iters_per_s": round(rounds / wall_s, 2),
+    "dispatches": (rounds + n_launch - 1) // n_launch,
+    # wall between device dispatches (callbacks, telemetry, Python loop),
+    # amortized over the boosting iterations each dispatch covers
+    "host_overhead_ms_per_iter": round(sum(host_ms) / rounds, 4),
+    "host_overhead_ms_per_dispatch": round(
+        sum(host_ms) / max(1, len(host_ms)), 4
+    ),
+    # retrace ledger for the timed run: the scan executable (and the
+    # sharded grow beneath it) must show ZERO fresh compiles after warmup
+    "timed_run_compiles": {
+        k: int(c1.get(k, 0) - c0.get(k, 0))
+        for k in sorted(set(c0) | set(c1))
+        if (c1.get(k, 0) - c0.get(k, 0)) > 0
+        and (k.startswith("grow/") or k.startswith("parallel/"))
+    },
+}))
+"""
+
+
+def launch_sweep() -> dict:
+    """Device-resident boosting A/B (``--launch-sweep``).
+
+    For N in {1, 2, 4, 8} train the same 20k x 28 regression model with
+    ``train_steps_per_launch=N`` — serial and under the ``tree_learner=
+    data`` 8-device mesh — and record per-iteration wall, the host
+    overhead between device dispatches, and the steady-state retrace
+    ledger.  Each cell is a fresh subprocess (cold jit caches + compile
+    counters); a warmup train inside the cell absorbs tracing so the
+    timed run measures launch steady state.  The model bytes are
+    N-invariant (tests/test_launch_scan.py); this sweep measures only
+    where the host round-trip time goes."""
+    import subprocess
+
+    n_rows = int(os.environ.get("BENCH_LAUNCH_ROWS", 20_000))
+    rounds = int(os.environ.get("BENCH_LAUNCH_ROUNDS", 24))
+    leaves = int(os.environ.get("BENCH_LAUNCH_LEAVES", 15))
+    n_grid = [
+        int(v)
+        for v in os.environ.get("BENCH_LAUNCH_N", "1,2,4,8").split(",")
+        if v.strip()
+    ]
+    out = {
+        "rows": n_rows,
+        "n_features": 28,
+        "num_leaves": leaves,
+        "rounds": rounds,
+        "cells": [],
+    }
+    for mesh in (0, 1):
+        for n in n_grid:
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    _LAUNCH_CELL_SCRIPT,
+                    str(n_rows),
+                    str(n),
+                    str(rounds),
+                    str(leaves),
+                    str(mesh),
+                ],
+                capture_output=True,
+                text=True,
+                timeout=3600,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"launch cell n={n} mesh={mesh} failed:\n"
+                    + r.stderr[-4000:]
+                )
+            out["cells"].append(json.loads(r.stdout.strip().splitlines()[-1]))
+    return out
+
+
 _FLEET_CELL_SCRIPT = r"""
 import json, os, sys, time
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -1074,6 +1194,12 @@ def main() -> None:
         # compile counters and jit caches start cold
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         print(json.dumps({"fleet_sweep": fleet_sweep()}))
+        return
+    if "--launch-sweep" in sys.argv:
+        # standalone, CPU-pinned: each (N, mesh) cell is its own subprocess
+        # so jit caches and compile counters start cold
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps({"launch_sweep": launch_sweep()}))
         return
     if "--ingest-sweep" in sys.argv:
         # standalone, CPU-pinned: each cell is its own subprocess, so the

@@ -207,6 +207,25 @@ class FleetTrainer:
 
     # ------------------------------------------------------------- iteration
 
+    def update_launch(self, n: int) -> int:
+        """Advance up to ``n`` lockstep rounds in ONE compiled launch
+        (scan-over-vmap — boosting/launch.py).  Per-member models stay
+        byte-identical to the serial round loop; externally-stopped
+        members ride as select-frozen no-op lanes.  Returns the number of
+        rounds consumed."""
+        if int(n) <= 1:
+            self.update()
+            return 1
+        from .launch import FleetLaunchRunner
+
+        cache = getattr(self, "_launch_runners", None)
+        if cache is None:
+            cache = self._launch_runners = {}
+        runner = cache.get(int(n))
+        if runner is None:
+            runner = cache[int(n)] = FleetLaunchRunner(self, int(n))
+        return runner.run()
+
     def update(self) -> List[bool]:
         """One lockstep boosting iteration.  Returns the per-member
         inactive flags (True = finished or stopped) after the round."""

@@ -1955,6 +1955,34 @@ class Booster:
             wd.observe(event, ses)
         return finished
 
+    def _launch_runner_for(self, n: int):
+        """Cached compiled N-iteration launch runner (boosting/launch.py).
+        Rebuilt when the static snapshot went stale (set_row_mask /
+        reset_parameter between trains swap the sampler or grower
+        params)."""
+        from .launch import LaunchRunner
+
+        cache = getattr(self, "_launch_runners", None)
+        if cache is None:
+            cache = self._launch_runners = {}
+        runner = cache.get(int(n))
+        if runner is None or runner.stale(self):
+            runner = cache[int(n)] = LaunchRunner(self, int(n))
+        return runner
+
+    def update_launch(self, n: int) -> Tuple[int, bool]:
+        """Advance up to ``n`` boosting iterations in ONE compiled device
+        launch (lax.scan over the iteration loop — boosting/launch.py).
+        Model dumps are byte-identical to ``n`` serial ``update()`` calls
+        for every eligible config; the caller (engine.train) handles
+        eligibility and period clamping via ``resolve_launch_steps``.
+        Returns ``(steps_consumed, is_finished)`` — the finishing
+        all-constant iteration counts as consumed, like ``update()``
+        returning True."""
+        if int(n) <= 1:
+            return 1, self.update()
+        return self._launch_runner_for(int(n)).run()
+
     def _update_impl(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         if train_set is not None and train_set is not self.train_set:
             self._init_train(train_set)
@@ -2074,11 +2102,17 @@ class Booster:
         self._note_refine_rate(ta_host)
         return ta, ta_host, leaf_id
 
-    def _commit_class_tree(self, kk, grown, grad, hess, mask, init_scores):
+    def _commit_class_tree(self, kk, grown, grad, hess, mask, init_scores,
+                           skip_train_score=False):
         """Commit one class's grown tree into the model: score updates,
         Tree materialization, bin records. `grown` is `_grow_class`'s
         result or None for a skipped class. Returns True when the tree
-        has at least one split (the iteration should continue)."""
+        has at least one split (the iteration should continue).
+
+        ``skip_train_score`` is the device-resident launch path
+        (boosting/launch.py): the scan already applied this tree's train
+        score delta inside the compiled program, so only the valid-score
+        walk and host materialization run here."""
         cfg = self.config
         k = self.num_tree_per_iteration
         n = self.train_set.num_data
@@ -2133,9 +2167,10 @@ class Booster:
                 shrunk = leaf_value * self._shrinkage_rate
                 # train score update: one gather (reference UpdateScore
                 # :501); the donated entry retires the old score cache
-                self._score = _apply_tree_score(
-                    self._score, shrunk, leaf_id, jnp.int32(kk)
-                )
+                if not skip_train_score:
+                    self._score = _apply_tree_score(
+                        self._score, shrunk, leaf_id, jnp.int32(kk)
+                    )
                 # valid score updates: bin-space walk of the new tree
                 for entry in self._valid:
                     entry.score = _apply_tree_valid_score(
@@ -2279,17 +2314,28 @@ class Booster:
             self._finished = True
         return finished
 
-    def _feature_mask_for_iter(self) -> jnp.ndarray:
+    def _feature_mask_np_for(self, iteration: int) -> np.ndarray:
+        """Host-side feature mask for an arbitrary iteration — the pure
+        part of ``_feature_mask_for_iter``, reusable by the launch path
+        (boosting/launch.py), which precomputes the masks for a whole
+        N-iteration window before dispatching the scan."""
         cfg = self.config
         f = self._bins.shape[1]
         if cfg.feature_fraction >= 1.0 or f == 0:
-            self._note_live_plane(None, f)
-            return self._full_feature_mask
-        rng = np.random.default_rng(cfg.feature_fraction_seed + self._iter)
+            return np.ones(f, dtype=bool)
+        rng = np.random.default_rng(cfg.feature_fraction_seed + iteration)
         used = max(1, int(round(f * cfg.feature_fraction)))
         chosen = rng.choice(f, size=used, replace=False)
         m = np.zeros(f, dtype=bool)
         m[chosen] = True
+        return m
+
+    def _feature_mask_for_iter(self) -> jnp.ndarray:
+        f = self._bins.shape[1]
+        if self.config.feature_fraction >= 1.0 or f == 0:
+            self._note_live_plane(None, f)
+            return self._full_feature_mask
+        m = self._feature_mask_np_for(self._iter)
         self._note_live_plane(m, f)
         return jnp.asarray(m)
 

@@ -49,6 +49,19 @@ class SampleStrategy:
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         return self._ones, grad, hess
 
+    # -- device-resident boosting (boosting/launch.py): a trace-safe step
+    # form of sample().  ``iteration`` is a traced i32 scalar inside the
+    # lax.scan body, so the host-side refresh/warmup branches become
+    # whole-array jnp.where selects (byte-equivalent: the rng key is drawn
+    # every iteration in the serial loop too, and a select of untouched
+    # inputs preserves their exact bit patterns — including -0.0).
+    # ``carried_mask`` threads the bagging mask through the scan carry;
+    # strategies without persistent state pass it through unchanged.
+
+    def scan_sample(self, iteration, grad, hess, rng, carried_mask):
+        ones = jnp.ones((self.num_data,), jnp.float32)
+        return ones, grad, hess, carried_mask
+
 
 class BaggingStrategy(SampleStrategy):
     """Per-row Bernoulli bagging, refreshed every ``bagging_freq`` iterations.
@@ -90,29 +103,38 @@ class BaggingStrategy(SampleStrategy):
             self._qpad_dev = jnp.asarray(~padq, jnp.float32)
 
     def sample(self, iteration, grad, hess, rng):
-        cfg = self.config
-        freq = max(1, cfg.bagging_freq)
+        freq = max(1, self.config.bagging_freq)
         if iteration % freq == 0:
-            if self._qsizes is not None:
-                nq = len(self._qsizes)
-                qmask = jax.random.bernoulli(
-                    rng, cfg.bagging_fraction, (nq,)
-                ).astype(jnp.float32)
-                qmask = qmask * self._qpad_dev
-                self._mask = jnp.repeat(
-                    qmask, self._qsizes, total_repeat_length=self.num_data
-                )
-            elif self._is_pos is not None:
-                p = jnp.where(
-                    self._is_pos, cfg.pos_bagging_fraction, cfg.neg_bagging_fraction
-                )
-                self._mask = jax.random.uniform(rng, (self.num_data,)) < p
-                self._mask = self._mask.astype(jnp.float32)
-            else:
-                self._mask = jax.random.bernoulli(
-                    rng, cfg.bagging_fraction, (self.num_data,)
-                ).astype(jnp.float32)
+            self._mask = self._fresh_mask(rng)
         return self._mask, grad, hess
+
+    def _fresh_mask(self, rng):
+        cfg = self.config
+        if self._qsizes is not None:
+            nq = len(self._qsizes)
+            qmask = jax.random.bernoulli(
+                rng, cfg.bagging_fraction, (nq,)
+            ).astype(jnp.float32)
+            qmask = qmask * self._qpad_dev
+            return jnp.repeat(
+                qmask, self._qsizes, total_repeat_length=self.num_data
+            )
+        if self._is_pos is not None:
+            p = jnp.where(
+                self._is_pos, cfg.pos_bagging_fraction, cfg.neg_bagging_fraction
+            )
+            return (jax.random.uniform(rng, (self.num_data,)) < p).astype(
+                jnp.float32
+            )
+        return jax.random.bernoulli(
+            rng, cfg.bagging_fraction, (self.num_data,)
+        ).astype(jnp.float32)
+
+    def scan_sample(self, iteration, grad, hess, rng, carried_mask):
+        freq = max(1, self.config.bagging_freq)
+        fresh = self._fresh_mask(rng)
+        mask = jnp.where(iteration % freq == 0, fresh, carried_mask)
+        return mask, grad, hess, mask
 
 
 class GOSSStrategy(SampleStrategy):
@@ -148,6 +170,17 @@ class GOSSStrategy(SampleStrategy):
         factor = jnp.where(is_top, 1.0, multiply)[None, :]
         mask = in_bag.astype(jnp.float32)
         return mask, grad * factor * mask[None, :], hess * factor * mask[None, :]
+
+    def scan_sample(self, iteration, grad, hess, rng, carried_mask):
+        mask, g, h = self.sample(self._warmup, grad, hess, rng)
+        warm = iteration < self._warmup
+        ones = jnp.ones((self.num_data,), jnp.float32)
+        return (
+            jnp.where(warm, ones, mask),
+            jnp.where(warm, grad, g),
+            jnp.where(warm, hess, h),
+            carried_mask,
+        )
 
 
 def bagging_is_active(config: Config) -> bool:

@@ -376,6 +376,16 @@ class Config:
     # leaf_batch > 1 (the serial loop has nothing to overlap with);
     # 'on' / 'off' force it.
     overlap_collectives: str = "auto"
+    # TPU extension: device-resident boosting (boosting/launch.py) — fuse N
+    # consecutive boosting iterations (gradients, tree grow, score update,
+    # in-scan bagging/GOSS mask derivation) into ONE compiled lax.scan
+    # program so the host loop advances N trees per dispatch.  Host-boundary
+    # work (eval, early stopping, callbacks, checkpointing, flight events)
+    # buckets to launch boundaries, so the validator clamps N to divide the
+    # active eval period / checkpoint_interval / snapshot_freq and warns
+    # once.  'auto' = 8 on TPU backends, 1 elsewhere; model dumps are
+    # byte-identical to the N=1 serial loop for every eligible config.
+    train_steps_per_launch: Any = "auto"
     early_stopping_round: int = 0
     early_stopping_min_delta: float = 0.0
     first_metric_only: bool = False
@@ -671,6 +681,18 @@ class Config:
             )
         if self.hist_near_tie_tol < 0.0:
             raise ValueError("hist_near_tie_tol must be >= 0")
+        if self.train_steps_per_launch != "auto":
+            try:
+                n = int(self.train_steps_per_launch)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "train_steps_per_launch must be 'auto' or an integer >= 1"
+                )
+            if n < 1:
+                raise ValueError(
+                    "train_steps_per_launch must be 'auto' or an integer >= 1"
+                )
+            self.train_steps_per_launch = n
         if not (0.0 <= self.leaf_batch_min_commit_rate <= 1.0):
             raise ValueError("leaf_batch_min_commit_rate must be in [0, 1]")
         if self.checkpoint_interval < 0:

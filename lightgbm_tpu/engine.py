@@ -8,6 +8,7 @@ canonical loop: construct Booster, per-iteration callbacks + booster.update()
 from __future__ import annotations
 
 import copy
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -153,8 +154,33 @@ def train(
     else:
         end_iteration = begin_iteration + num_boost_round
     evaluation_result_list: List = []
+    # hoisted "no eval work" fast path: without valid sets the loop used to
+    # re-derive the eval-period modulo every iteration just to call an
+    # eval_valid() that returns [] — decide once, skip the block entirely
+    has_eval_work = bool(is_valid_contain_train or booster._valid)
+    # device-resident boosting: one compiled launch advances launch_n
+    # iterations; host-boundary work below buckets to launch boundaries
+    launch_n = 1
+    if fobj is None:
+        from .boosting.launch import resolve_launch_steps
+
+        launch_n = resolve_launch_steps(booster, has_eval_work=has_eval_work)
+        if launch_n > 1 and callbacks_before:
+            from .utils.log import log_warning
+
+            log_warning(
+                "[launch] train_steps_per_launch disabled: before-iteration "
+                "callbacks (e.g. reset_parameter) mutate per-iteration state "
+                "the compiled scan cannot observe"
+            )
+            launch_n = 1
+    # per-launch host overhead: wall between the end of one device dispatch
+    # and the start of the next (callbacks, eval, telemetry, Python loop)
+    booster._host_overhead_ms = []
+    prev_dispatch_end: Optional[float] = None
     try:
-        for it in range(begin_iteration, end_iteration):
+        it = begin_iteration
+        while it < end_iteration:
             for cb in callbacks_before:
                 cb(
                     CallbackEnv(
@@ -168,16 +194,33 @@ def train(
                 )
             if trace is not None:
                 trace.on_iteration_start(it)
+            # serial tail: a partial window would compile a second scan
+            # length — fall back to one-iteration dispatches instead
+            use_launch = launch_n > 1 and it + launch_n <= end_iteration
+            t_dispatch = time.perf_counter()
+            if prev_dispatch_end is not None:
+                host_ms = (t_dispatch - prev_dispatch_end) * 1e3
+                booster._host_overhead_ms.append(host_ms)
+                if ses.enabled:
+                    ses.set_gauge("train/host_overhead_ms", host_ms)
             with global_timer.timed("boosting/update"):
-                is_finished = booster.update(fobj=fobj)
+                if use_launch:
+                    steps, is_finished = booster.update_launch(launch_n)
+                else:
+                    is_finished = booster.update(fobj=fobj)
+                    steps = 1
+                    if ses.enabled and launch_n > 1:
+                        ses.set_gauge("train/steps_per_launch_effective", 1.0)
+            prev_dispatch_end = time.perf_counter()
+            it_last = it + max(1, steps) - 1
             if trace is not None:
-                trace.on_iteration_end(it)
+                trace.on_iteration_end(it_last)
 
             # periodic model snapshot (reference GBDT::Train gbdt.cpp:258)
             sf = booster.config.snapshot_freq
-            if sf > 0 and (it + 1) % sf == 0:
+            if sf > 0 and (it_last + 1) % sf == 0:
                 booster.save_model(
-                    f"{booster.config.output_model}.snapshot_iter_{it + 1}"
+                    f"{booster.config.output_model}.snapshot_iter_{it_last + 1}"
                 )
 
             # resilience checkpoint: full trainer state, atomic (tmp+rename);
@@ -185,14 +228,17 @@ def train(
             # state so the resumed run is byte-identical
             ck_dir = booster.config.checkpoint_dir
             ck_int = booster.config.checkpoint_interval
-            if ck_dir and ck_int > 0 and (it + 1) % ck_int == 0:
+            if ck_dir and ck_int > 0 and (it_last + 1) % ck_int == 0:
                 from .resilience.checkpoint import save_checkpoint
 
                 with global_timer.timed("boosting/checkpoint"):
                     save_checkpoint(booster, ck_dir)
 
             evaluation_result_list = []
-            if (it + 1) % max(1, booster.config.metric_freq) == 0 or it + 1 == end_iteration:
+            if has_eval_work and (
+                (it_last + 1) % max(1, booster.config.metric_freq) == 0
+                or it_last + 1 == end_iteration
+            ):
                 with global_timer.timed("boosting/eval"):
                     if is_valid_contain_train:
                         res = booster.eval_train(feval)
@@ -213,7 +259,7 @@ def train(
                     CallbackEnv(
                         model=booster,
                         params=params,
-                        iteration=it,
+                        iteration=it_last,
                         begin_iteration=begin_iteration,
                         end_iteration=end_iteration,
                         evaluation_result_list=evaluation_result_list,
@@ -221,6 +267,7 @@ def train(
                 )
             if is_finished:
                 break
+            it += max(1, steps)
     except EarlyStopException as e:
         booster.best_iteration = e.best_iteration + 1
         evaluation_result_list = e.best_score
@@ -358,15 +405,30 @@ def train_fleet(
         per_member_after.append(cbs)
 
     trainer = FleetTrainer(boosters)
+    # device-resident boosting composed with the fleet: one compiled
+    # launch advances launch_n lockstep rounds (scan-over-vmap); eval and
+    # per-member early stopping bucket to launch boundaries
+    from .boosting.launch import resolve_fleet_launch_steps
+
+    launch_n = resolve_fleet_launch_steps(
+        trainer, has_eval_work=any(b._valid for b in boosters)
+    )
     last_eval: List[List] = [[] for _ in boosters]
-    for it in range(num_boost_round):
+    it = 0
+    while it < num_boost_round:
         was_active = trainer.active_members()
-        trainer.update()
+        use_launch = launch_n > 1 and it + launch_n <= num_boost_round
+        if use_launch:
+            steps = trainer.update_launch(launch_n)
+        else:
+            trainer.update()
+            steps = 1
+        it_last = it + max(1, steps) - 1
         for i in was_active:
             b = boosters[i]
             evals: List = []
-            if (it + 1) % max(1, b.config.metric_freq) == 0 or (
-                it + 1 == num_boost_round
+            if (it_last + 1) % max(1, b.config.metric_freq) == 0 or (
+                it_last + 1 == num_boost_round
             ):
                 with global_timer.timed("boosting/eval"):
                     evals = b.eval_valid(feval)
@@ -378,7 +440,7 @@ def train_fleet(
                         CallbackEnv(
                             model=b,
                             params=b.params,
-                            iteration=it,
+                            iteration=it_last,
                             begin_iteration=0,
                             end_iteration=num_boost_round,
                             evaluation_result_list=evals,
@@ -390,6 +452,7 @@ def train_fleet(
                 trainer.stop_member(i)
         if trainer.done():
             break
+        it += max(1, steps)
     for b, evals in zip(boosters, last_eval):
         b.best_score = {}
         for item in evals or []:

@@ -73,7 +73,14 @@ def _jit_wrapper_call(
 def jit_entries(
     project: Project,
 ) -> List[Tuple[str, Module, ast.FunctionDef, FrozenSet[str]]]:
-    """All jit entry points: (module_rel, module, func, static_argnames)."""
+    """All jit entry points: (module_rel, module, func, static_argnames).
+
+    Memoized per Project: five rules call this and the full-tree ast.walk
+    dominates lint CPU; callers only iterate the result.
+    """
+    cached = project.__dict__.get("_jit_entries_cache")
+    if cached is not None:
+        return cached
     out = []
     for rel, mod in project.modules.items():
         for node in ast.walk(mod.tree):
@@ -117,6 +124,7 @@ def jit_entries(
                     (target[0], project.modules[target[0]], fn,
                      frozenset(names or ()))
                 )
+    project.__dict__["_jit_entries_cache"] = out
     return out
 
 
@@ -132,7 +140,13 @@ def pallas_call_sites(
     assigned in the enclosing function before the call.  Sites inside
     nested functions resolve against their INNERMOST enclosing scope
     (``ast.walk`` yields outer scopes first, so the last write wins).
+
+    Memoized per Project, like :func:`jit_entries` — three rules re-walk
+    otherwise and callers only iterate.
     """
+    cached = project.__dict__.get("_pallas_sites_cache")
+    if cached is not None:
+        return cached
     sites: Dict[int, Tuple] = {}
     for rel, mod in project.modules.items():
         for encl in ast.walk(mod.tree):
@@ -164,7 +178,9 @@ def pallas_call_sites(
                         if fn is not None:
                             kernel = (target[0], fn)
                 sites[id(node)] = (rel, mod, node, kernel, encl.name)
-    return list(sites.values())
+    out = list(sites.values())
+    project.__dict__["_pallas_sites_cache"] = out
+    return out
 
 
 def positional_params(fn: ast.FunctionDef) -> List[str]:

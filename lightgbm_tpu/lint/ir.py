@@ -598,6 +598,76 @@ def build_entry_specs() -> List[EntrySpec]:
         )
     )
 
+    # ---- device-resident launch scan (perf-gate launch scenario): the
+    # REAL production N=4 launch body over the data-8 mesh, built from a
+    # live Booster so the traced jaxpr is exactly what training runs.
+    # GL011 walks into the lax.scan body (walk_jaxpr recurses through
+    # sub-jaxprs) and must find each psum site once with the SAME
+    # payloads as the solo grow/data8 model — the scan multiplies trip
+    # count, never payload shape.  GL013 requires the scanned carry
+    # (the donated score cache, arg 0) to hand its buffer back.
+    def build_launch_scan():
+        import numpy as np
+
+        from ..boosting import create_booster
+        from ..boosting.launch import LaunchRunner
+        from ..dataset import Dataset
+
+        rng = np.random.RandomState(3)
+        Xl = rng.rand(N, F).astype(np.float32)
+        yl = (Xl[:, 0] + 0.25 * Xl[:, 1]).astype(np.float32)
+        b = create_booster(
+            {
+                "objective": "regression",
+                "num_leaves": NUM_LEAVES,
+                "max_bin": MAX_BIN_PADDED - 1,  # pads back to MAX_BIN_PADDED
+                "min_data_in_leaf": 5,
+                "verbosity": -1,
+                "tree_learner": "data",
+                "num_machines": 8,
+            },
+            Dataset(Xl, label=yl),
+        )
+        runner = LaunchRunner(b, 4)
+        args = (
+            _sds(tuple(b._score.shape), b._score.dtype),  # score (carried)
+            _sds((2,), jnp.uint32),  # rng key
+            _sds((1,), f32),  # bagging-mask carry (dummy: no sampling)
+            _sds((4,), i32),  # iteration numbers
+            _sds((4, b._bins.shape[1]), jnp.bool_),  # feature masks
+            _sds(tuple(b._bins.shape), b._bins.dtype),  # bins
+            _sds((b._bins.shape[0],), f32),  # ones_mask
+            _sds((1,), f32),  # fixed-row mask (dummy)
+        )
+        return runner._fn, args, {}
+
+    def _scan_psum_model():
+        from ..parallel.mesh import MeshSpec
+
+        return _grow_psum_model(MeshSpec("data", data=8), leaf_batch=1)
+
+    from ..boosting import launch as launch_mod
+
+    specs.append(
+        EntrySpec(
+            name="grow/scan4_data8",
+            build=build_launch_scan,
+            anchor=_anchor(launch_mod, "LaunchRunner"),
+            axes=frozenset({"data"}),
+            carried=((0, "score"),),
+            psum_model=_scan_psum_model,
+            root_modules=(
+                "boosting/launch.py",
+                "boosting/gbdt.py",
+                "ops/grower.py",
+                "parallel/mesh.py",
+                "obs/collectives.py",
+                "ops/histogram.py",
+                "ops/split.py",
+            ),
+        )
+    )
+
     # ---- quantized training entries (perf-gate quantized scenario)
     def build_quantize():
         fn = quantize_mod.quantize_gradients

@@ -313,6 +313,7 @@ def mesh_psum_bytes_per_iteration(
     num_bins: int,
     leaf_batch: int = 1,
     spec: Optional[MeshSpec] = None,
+    launch_steps: int = 1,
 ) -> dict:
     """Layout-aware analytic psum bytes for one boosting iteration — the
     2-D generalization of ``parallel.psum_bytes_per_iteration`` (which it
@@ -355,9 +356,18 @@ def mesh_psum_bytes_per_iteration(
         count_bytes += 3 * 4  # root-totals broadcast psum
     d = max(1, spec.size)
     ring = 2.0 * (d - 1) / d
+    # device-resident boosting (boosting/launch.py): one compiled launch
+    # scans ``launch_steps`` iterations, each issuing the SAME collective
+    # sites — per-launch traffic is an exact multiple of the per-iteration
+    # model (the scan body contains each psum site once; trip count and
+    # payloads are iteration-invariant)
+    ls = max(1, int(launch_steps))
+    hist_bytes *= ls
+    count_bytes *= ls
+    elect_bytes *= ls
     total = hist_bytes + count_bytes + elect_bytes
     return {
-        "steps": steps,
+        "steps": steps * ls,
         "hist_bytes": hist_bytes,
         "count_bytes": count_bytes,
         "elect_bytes": elect_bytes,

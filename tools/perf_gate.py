@@ -313,6 +313,41 @@ def collect() -> Dict[str, float]:
         ) + float(ses.gauges.get("fleet/psum_count_bytes", 0.0))
         if fleet_analytic:
             metrics["collective/analytic_fleet_bytes"] = fleet_analytic
+
+        # -- scenario 6: device-resident boosting on the data mesh — 6
+        # iterations as 3 compiled launches (train_steps_per_launch=2).
+        # The scan executable label grow/scan2 is frozen at EXACTLY 1
+        # compile (a second trace would mean the warm launch re-specializes
+        # per window — the regression this feature exists to prevent), and
+        # the analytic per-launch collective bytes freeze the launch factor
+        # in mesh_psum_bytes_per_iteration (each launch moves launch_steps×
+        # the per-iteration psum payload; the scan body contains each psum
+        # site once)
+        ses.reset()
+        ses.configure(enabled=True)
+        labels_before = compile_counts_by_label()
+        t0 = time.perf_counter()
+        lgb.train(
+            {**base, "tree_learner": "data", "train_steps_per_launch": 2},
+            lgb.Dataset(X, label=y, params=base),
+            num_boost_round=6,
+        )
+        metrics["wall/launch_train_s"] = round(time.perf_counter() - t0, 3)
+        labels_after = compile_counts_by_label()
+        for label, count in sorted(labels_after.items()):
+            delta = count - labels_before.get(label, 0)
+            if delta:
+                metrics[f"retrace/launch/{label}"] = float(delta)
+        launch_analytic = sum(
+            float(e["collective"]["psum_bytes"])
+            for e in ses.events
+            if e.get("event") == "launch" and "collective" in e
+        )
+        if launch_analytic:
+            metrics["collective/analytic_launch_bytes"] = launch_analytic
+        metrics["cost/launch/steps_per_launch_effective"] = float(
+            ses.gauges.get("train/steps_per_launch_effective", 0.0)
+        )
     else:  # pragma: no cover - CI always has the virtual mesh
         print(
             f"perf_gate: only {ndev} cpu devices; skipping the "

@@ -370,4 +370,39 @@ assert resumed.model_to_string() == baseline.model_to_string(), (
     "resumed dump diverged from uninterrupted run")
 print("kill-and-resume smoke: byte-identical dump after SIGKILL+resume OK")
 PYEOF
+
+# launch-scan smoke: device-resident boosting must be invisible in the
+# model bytes.  3 launches of N=2 scanned iterations (one compiled
+# lax.scan dispatch each) vs 6 serial iterations: byte-identical dump
+# (modulo the requested-N config echo) and exactly ONE compile of the
+# scan executable across all 3 launches.
+echo "=== launch-scan smoke (3 launches x N=2 vs 6 serial iterations) ==="
+python - <<'PYEOF' || rc=$?
+import re
+
+import numpy as np
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(400, 8))
+y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=400)
+params = dict(objective="regression", num_leaves=15, learning_rate=0.1,
+              min_data_in_leaf=20, verbosity=-1, seed=7,
+              bagging_fraction=0.7, bagging_freq=1)
+
+def dump(n):
+    p = dict(params, train_steps_per_launch=n)
+    b = lgb.train(p, lgb.Dataset(X, y), num_boost_round=6)
+    return re.sub(r"\[train_steps_per_launch: [^\]]*\]\n?", "",
+                  b.model_to_string())
+
+ref = dump(1)
+before = dict(lgb.compile_counts_by_label())
+assert dump(2) == ref, "launch-scan dump diverged from serial loop"
+after = lgb.compile_counts_by_label()
+scan_compiles = after.get("grow/scan2", 0) - before.get("grow/scan2", 0)
+assert scan_compiles == 1, (
+    f"expected 1 scan compile across 3 launches, saw {scan_compiles}")
+print("launch-scan smoke: byte parity + single scan compile OK")
+PYEOF
 exit $rc
