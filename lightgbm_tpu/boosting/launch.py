@@ -240,25 +240,30 @@ class LaunchRunner:
         self._params = dataclasses.replace(
             booster._grower_params, grow_fused=False
         )
-        self._signature = self._static_signature(booster)
+        # STRONG refs to the snapshotted objects: they pin the snapshot for
+        # the runner's lifetime so the identity checks in stale() cannot be
+        # fooled by CPython allocating a replacement object at a freed
+        # object's address (id reuse would silently revive a cached
+        # executable traced against the old sampler/objective constants)
+        self._snap_sampler = booster._sampler
+        self._snap_objective = booster.objective
+        self._snap_grower_params = booster._grower_params
+        self._snap_bins_shape = booster._bins.shape
         self._fn = instrumented_jit(
             self._launch_impl,
             label=f"grow/scan{self._n}",
             donate_argnums=(0,),
         )
 
-    @staticmethod
-    def _static_signature(booster):
-        return (
-            id(booster._sampler),
-            id(booster.objective),
-            id(booster._grower_params),
-            getattr(booster, "_fixed_row_mask", None) is not None,
-            booster._bins.shape,
-        )
-
     def stale(self, booster) -> bool:
-        return self._signature != self._static_signature(booster)
+        return not (
+            booster._sampler is self._snap_sampler
+            and booster.objective is self._snap_objective
+            and booster._grower_params is self._snap_grower_params
+            and (getattr(booster, "_fixed_row_mask", None) is not None)
+            == self._has_fixed
+            and booster._bins.shape == self._snap_bins_shape
+        )
 
     # ----------------------------------------------------------- trace body
 
@@ -881,6 +886,22 @@ class FleetLaunchRunner:
         active = t.active_members()
         if not active:
             return 0
+        # first-round constant-tree hazard scan BEFORE any score mutation:
+        # if ANY active member needs the serial fallback (boost_from_average
+        # off, no models, no init score), take it for the WHOLE fleet now.
+        # Falling back after boosting earlier members would re-apply
+        # boost_from_average inside _fleet_begin_iter (their models_ is
+        # still empty), silently double-boosting train and valid scores.
+        for i in active:
+            b = boosters[i]
+            if (
+                not b.models_
+                and b.objective is not None
+                and not b.config.boost_from_average
+                and not b._has_init_score
+            ):
+                t.update()
+                return 1
         # first-round prologue per member (see LaunchRunner.run)
         init_scores_by_member = {}
         for i in active:
@@ -900,14 +921,6 @@ class FleetLaunchRunner:
                         b._score = b._score.at[kk].add(s)
                         for entry in b._valid:
                             entry.score = entry.score.at[kk].add(s)
-            elif (
-                not b.models_
-                and b.objective is not None
-                and not cfg.boost_from_average
-                and not b._has_init_score
-            ):
-                t.update()
-                return 1
             init_scores_by_member[i] = isc
 
         ses = get_session()

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import copy
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -175,8 +176,13 @@ def train(
             )
             launch_n = 1
     # per-launch host overhead: wall between the end of one device dispatch
-    # and the start of the next (callbacks, eval, telemetry, Python loop)
-    booster._host_overhead_ms = []
+    # and the start of the next (callbacks, eval, telemetry, Python loop).
+    # The sample window is bounded (long serial runs would otherwise grow
+    # one float per iteration, and the list outlives train()); running
+    # totals keep the whole-run average exact for bench reporting.
+    booster._host_overhead_ms = deque(maxlen=128)
+    booster._host_overhead_total_ms = 0.0
+    booster._host_overhead_n = 0
     prev_dispatch_end: Optional[float] = None
     try:
         it = begin_iteration
@@ -195,12 +201,24 @@ def train(
             if trace is not None:
                 trace.on_iteration_start(it)
             # serial tail: a partial window would compile a second scan
-            # length — fall back to one-iteration dispatches instead
-            use_launch = launch_n > 1 and it + launch_n <= end_iteration
+            # length — fall back to one-iteration dispatches instead.
+            # Alignment: windows must START on a multiple of launch_n so the
+            # (it_last + 1) % period checks below land on the iterations the
+            # serial loop acts on (resolve_launch_steps only guarantees
+            # launch_n divides each period, not that begin_iteration is
+            # aligned — an init_model or a first-round serial fallback can
+            # leave `it` unaligned); one-iteration dispatches re-align it
+            use_launch = (
+                launch_n > 1
+                and it % launch_n == 0
+                and it + launch_n <= end_iteration
+            )
             t_dispatch = time.perf_counter()
             if prev_dispatch_end is not None:
                 host_ms = (t_dispatch - prev_dispatch_end) * 1e3
                 booster._host_overhead_ms.append(host_ms)
+                booster._host_overhead_total_ms += host_ms
+                booster._host_overhead_n += 1
                 if ses.enabled:
                     ses.set_gauge("train/host_overhead_ms", host_ms)
             with global_timer.timed("boosting/update"):
@@ -417,7 +435,15 @@ def train_fleet(
     it = 0
     while it < num_boost_round:
         was_active = trainer.active_members()
-        use_launch = launch_n > 1 and it + launch_n <= num_boost_round
+        # same alignment rule as train(): a first-round serial fallback
+        # (constant-tree hazard) consumes one round, so windows must wait
+        # for `it` to re-align or the per-member metric_freq checks below
+        # would stop landing on the serial loop's eval iterations
+        use_launch = (
+            launch_n > 1
+            and it % launch_n == 0
+            and it + launch_n <= num_boost_round
+        )
         if use_launch:
             steps = trainer.update_launch(launch_n)
         else:

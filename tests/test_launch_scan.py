@@ -146,6 +146,65 @@ def test_launch_parity_fleet():
     assert fleet_dumps(4) == ref
 
 
+def test_fleet_mixed_boost_from_average_first_round_fallback():
+    # regression: a member with boost_from_average OFF forces the fleet's
+    # first-round serial fallback; that fallback must be decided BEFORE
+    # any earlier member's boost_from_average score mutation, or the
+    # serial round re-applies the boost (models_ still empty) and the
+    # earlier member's scores are silently doubled.  Same member count /
+    # bagging config as test_launch_parity_fleet so the fleet executables
+    # stay warm (boost_from_average is host-side prologue work only).
+    def dumps(n):
+        members = [
+            dict(BASE, seed=3 + i, bagging_fraction=0.8, bagging_freq=1,
+                 train_steps_per_launch=n,
+                 boost_from_average=(i != 2))
+            for i in range(3)
+        ]
+        ds = lgb.Dataset(X, label=Y)
+        return [
+            _strip(b.model_to_string())
+            for b in lgb.train_fleet(members, ds, num_boost_round=8)
+        ]
+
+    ref = dumps(1)
+    assert dumps(4) == ref
+
+
+def test_launch_realigns_after_unaligned_init_model():
+    # continue training from an init_model whose iteration count is NOT a
+    # multiple of launch_n: the loop must dispatch serially until the
+    # window start re-aligns, so periodic host work (eval here) fires on
+    # exactly the iterations the serial continuation acts on
+    Xv = RNG.normal(size=(100, F)).astype(np.float32)
+    Yv = (Xv[:, 0] * 2 + np.sin(3 * Xv[:, 1])
+          + RNG.normal(scale=0.1, size=100)).astype(np.float32)
+    base = dict(BASE, metric="l2", metric_freq=2)
+    ds = lgb.Dataset(X, label=Y)
+    init = lgb.train(
+        dict(base, train_steps_per_launch=1), ds, num_boost_round=3
+    )
+
+    def continue_from_init(n):
+        fired = []
+
+        def record(env):
+            if env.evaluation_result_list:
+                fired.append(env.iteration)
+
+        vs = lgb.Dataset(Xv, label=Yv)
+        b = lgb.train(
+            dict(base, train_steps_per_launch=n), ds, num_boost_round=5,
+            valid_sets=[vs], init_model=init, callbacks=[record],
+        )
+        return fired, _strip(b.model_to_string())
+
+    ref_fired, ref_dump = continue_from_init(1)
+    lau_fired, lau_dump = continue_from_init(2)
+    assert lau_fired == ref_fired
+    assert lau_dump == ref_dump
+
+
 def test_launch_parity_early_finish_inside_window():
     # a gain ceiling stops boosting mid-window: the scan's finished latch
     # must reproduce the serial stop point and the rolled-back final round
@@ -227,6 +286,13 @@ def test_host_overhead_gauge_populated():
     # wall between device dispatches, one sample per dispatch after the first
     assert len(b._host_overhead_ms) >= 3
     assert all(v >= 0.0 for v in b._host_overhead_ms)
+    # the sample window is bounded (long runs must not grow the booster);
+    # running totals stay exact for the bench average
+    assert b._host_overhead_ms.maxlen == 128
+    assert b._host_overhead_n == len(b._host_overhead_ms)
+    assert b._host_overhead_total_ms == pytest.approx(
+        sum(b._host_overhead_ms)
+    )
 
 
 # ------------------------------------------------------------- validator
