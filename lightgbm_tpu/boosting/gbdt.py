@@ -36,6 +36,7 @@ from ..obs.flight import get_flight
 from ..obs.health import HealthWatchdog
 from ..obs.jit import compile_count as _obs_compile_count
 from ..obs.registry import get_session
+from ..obs.trace import get_tracer
 from ..objectives import ObjectiveFunction, create_objective
 from ..resilience import NumericsError, chaos
 from ..obs.jit import instrumented_jit
@@ -1117,6 +1118,15 @@ class Booster:
         # exactly what a postmortem needs — dump the flight ring now
         flight = get_flight()
         flight.note_event(event)
+        get_tracer().instant(
+            "lifecycle/degradation",
+            "lifecycle",
+            args={
+                "component": "fused_grow_step",
+                "iter": int(self._iter),
+                "error": event["error"],
+            },
+        )
         flight.dump("degradation")
         log_warning(
             "[resilience] fused Pallas grow step failed "
@@ -1744,6 +1754,11 @@ class Booster:
             }
         ses.record_alert(alert)
         flight.note_alert(alert)
+        get_tracer().instant(
+            "lifecycle/fault",
+            "lifecycle",
+            args={"reason": reason, "iter": it},
+        )
         return flight.dump(reason)
 
     def _guard_gradients(self, grad, hess) -> None:
@@ -1853,8 +1868,21 @@ class Booster:
             # still sees walls; gauges/counters stay empty so gauge-based
             # rules simply never fire
             it = self._iter
+            tracer = get_tracer()
             t0 = time.perf_counter()
-            finished = self._update_impl(train_set, fobj)
+            sp = tracer.begin(
+                "train/iteration",
+                "train",
+                args={"iter": it},
+                attach=True,
+                ambient=True,
+            )
+            finished = False
+            try:
+                finished = self._update_impl(train_set, fobj)
+            finally:
+                if sp is not None:
+                    tracer.end(sp, extra={"finished": bool(finished)})
             if flight.active or wd is not None:
                 event = {
                     "event": "iteration",
@@ -1869,15 +1897,33 @@ class Booster:
         it = self._iter
         trees_before = len(self._bin_records_store)
         compiles_before = _obs_compile_count()
+        tracer = get_tracer()
         t0 = time.perf_counter()
+        # iteration span opens BEFORE begin_iteration so phase timers
+        # (registry._PhaseTimer -> note_phase) attach as children; ambient
+        # parents the collective io_callback spans fired off-thread
+        sp = tracer.begin(
+            "train/iteration",
+            "train",
+            args={"iter": it},
+            attach=True,
+            ambient=True,
+        )
         ses.begin_iteration()
+        finished = False
         try:
-            finished = self._update_impl(train_set, fobj)
+            try:
+                finished = self._update_impl(train_set, fobj)
+            finally:
+                phases = ses.end_iteration()
+            # under obs_sync_timing wall_ms is the fully synchronized
+            # iteration time; otherwise it is dispatch time (async runtime)
+            ses.sync(self._score)
         finally:
-            phases = ses.end_iteration()
-        # under obs_sync_timing wall_ms is the fully synchronized iteration
-        # time; otherwise it is dispatch time (async runtime)
-        ses.sync(self._score)
+            # the finally keeps the tls span stack balanced when
+            # _update_impl raises (NumericsError -> _fault_dump)
+            if sp is not None:
+                tracer.end(sp, extra={"finished": bool(finished)})
         wall_ms = (time.perf_counter() - t0) * 1e3
         # host bookkeeping (and hence these records) lags one iteration on
         # the pipelined path — splits here count trees MATERIALIZED this call
@@ -2495,6 +2541,16 @@ class Booster:
         from ..obs.export import health_snapshot
 
         return health_snapshot(getattr(self, "_watchdog", None))
+
+    def dump_trace(self, path: str) -> str:
+        """Write the span recorder's ring as a Chrome trace-event JSON file
+        (atomic tmp+rename).  Load the file in Perfetto
+        (https://ui.perfetto.dev) or ``chrome://tracing`` to see the
+        train-launch / iteration / phase / collective span timeline.  The
+        same document is served live at ``GET /trace`` when
+        ``obs_export_port`` is set, and dumped automatically next to every
+        flight-recorder fault dump.  Returns the path written."""
+        return get_tracer().dump(path)
 
     def current_iteration(self) -> int:
         return self._iter

@@ -61,6 +61,7 @@ from ..obs.jit import compile_count as _compile_count
 from ..obs.jit import instrumented_jit
 from ..obs.registry import get_session
 from ..obs.device import sample_device_memory
+from ..obs.trace import get_tracer
 from ..ops.grower import _pack_tree_arrays_impl, grow_tree, unpack_tree_arrays
 from ..resilience import NumericsError, chaos
 from ..utils.log import log_warning
@@ -494,32 +495,51 @@ class LaunchRunner:
         fixed_arg = fixed if fixed is not None else jnp.zeros((1,), jnp.float32)
 
         compiles_before = _compile_count()
+        tracer = get_tracer()
+        # launch span: the phase("launch") child attaches under it via the
+        # tls stack; synthetic per-iteration children are reconstructed from
+        # the device counter records in _note_launch, which also ends it
+        lsp = tracer.begin(
+            "train/launch",
+            "train",
+            args={"launch_begin": it0, "steps_per_launch": S},
+            attach=True,
+            ambient=True,
+        )
         t0 = time.perf_counter()
         if ses.enabled:
             ses.begin_iteration()
         try:
-            with ses.phase("launch"):
-                carry, ys = self._fn(
-                    b._score,
-                    b._rng,
-                    bag0,
-                    its,
-                    fms,
-                    b._bins,
-                    b._ones_mask,
-                    fixed_arg,
-                )
-                score, rng, bag, finished_dev, bad_dev = carry
-                # donated score: rebind before anything can raise
-                b._score = score
-                b._rng = rng
-                if is_bagging:
-                    b._sampler._mask = bag
-        finally:
-            phases = ses.end_iteration() if ses.enabled else {}
-        ints = np.asarray(ys["ints"])  # [S, k, ints_len] — blocks = synced
-        floats = np.asarray(ys["floats"])
-        bad = int(bad_dev)
+            try:
+                with ses.phase("launch"):
+                    carry, ys = self._fn(
+                        b._score,
+                        b._rng,
+                        bag0,
+                        its,
+                        fms,
+                        b._bins,
+                        b._ones_mask,
+                        fixed_arg,
+                    )
+                    score, rng, bag, finished_dev, bad_dev = carry
+                    # donated score: rebind before anything can raise
+                    b._score = score
+                    b._rng = rng
+                    if is_bagging:
+                        b._sampler._mask = bag
+            finally:
+                phases = ses.end_iteration() if ses.enabled else {}
+            ints = np.asarray(ys["ints"])  # [S, k, ints_len] — blocks = synced
+            floats = np.asarray(ys["floats"])
+            bad = int(bad_dev)
+        except BaseException:
+            # scan failure skips _note_launch — end the span here to keep
+            # the tls span stack balanced for the fault path
+            if lsp is not None:
+                tracer.end(lsp, extra={"error": True})
+                lsp = None
+            raise
         wall_ms = (time.perf_counter() - t0) * 1e3
 
         # ---- host replay: materialize + commit in serial iteration order
@@ -581,12 +601,13 @@ class LaunchRunner:
             self._note_launch(
                 ses, flight, wd, it0, steps_done, wall_ms, phases,
                 _compile_count() - compiles_before, records, is_finished,
+                span=lsp,
             )
         return steps_done, is_finished
 
     def _note_launch(
         self, ses, flight, wd, it0, steps_done, wall_ms, phases,
-        compiles_delta, records, is_finished,
+        compiles_delta, records, is_finished, span=None,
     ) -> None:
         """One batched observability event per launch: the flight ring and
         watchdog see a single record carrying the N per-iteration
@@ -643,11 +664,63 @@ class LaunchRunner:
                 "collective_ring_bytes_per_device",
                 coll["ring_bytes_per_device"],
             )
+        tracer = get_tracer()
+        if span is not None:
+            # synthetic per-iteration children: the device ran the S
+            # iterations inside ONE scan, so the host reconstructs S
+            # equal-width child spans under the launch span.  Boundaries
+            # are estimated (device-uniform division of the launch wall);
+            # the per-iteration counters (splits, grow_steps, refine_count)
+            # are exact device values that rode the packed scan carry out.
+            slice_us = (wall_ms * 1000.0) / steps
+            for s, rec in enumerate(records):
+                tracer.add_span(
+                    "train/iteration",
+                    "train",
+                    int(span.t0_us + s * slice_us),
+                    max(1, int(slice_us)),
+                    trace_id=span.trace_id,
+                    parent_id=span.span_id,
+                    args={
+                        "iter": rec["iter"],
+                        "trees_materialized": rec["trees_materialized"],
+                        "splits": rec["splits"],
+                        "grow_steps": rec["grow_steps"],
+                        "refine_count": rec["refine_count"],
+                        "from_launch": True,
+                    },
+                    synthetic=True,
+                    tid=span.tid,
+                )
+            tracer.end(
+                span,
+                extra={
+                    "steps": steps_done,
+                    "launch_wall_ms": wall_ms,
+                    "compiles_delta": compiles_delta,
+                    "finished": bool(is_finished),
+                },
+            )
         if ses.enabled:
             ses.inc("iterations", steps_done)
             ses.inc("launch/launches")
             ses.set_gauge("train/steps_per_launch_effective", float(steps_done))
             sample_device_memory("iteration")
+            # per-iteration JSONL shape compatibility: one replayed
+            # iteration event per consumed step, flagged from_launch so
+            # offline tools (telemetry_summary.py) keep their
+            # event=="iteration" filter across serial and launched runs.
+            # Recorded BEFORE the deferred launch event so late eval
+            # annotations still land on the launch JSONL line.
+            for rec in records:
+                ses.record({
+                    "event": "iteration",
+                    "iter": rec["iter"],
+                    "wall_ms": wall_ms / steps,
+                    "trees_materialized": rec["trees_materialized"],
+                    "splits": rec["splits"],
+                    "from_launch": True,
+                })
             ses.record(event, defer=True)
         if flight.active:
             flight.note_event(event)

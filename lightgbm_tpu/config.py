@@ -170,6 +170,8 @@ _PARAM_ALIASES: Dict[str, str] = {
     "telemetry_output": "telemetry_out",
     "telemetry_file": "telemetry_out",
     "trace_dir": "profile_trace_dir",
+    "trace_enabled": "trace_spans",
+    "trace_sample_rate": "trace_sample",
     # resilience
     "checkpoint_path": "checkpoint_dir",
     "checkpoint_freq": "checkpoint_interval",
@@ -449,6 +451,13 @@ class Config:
     obs_export_port: int = 0
     health_watchdog: bool = True
     flight_capacity: int = 256
+    # distributed tracing (obs/trace): always-on span recorder exporting
+    # Chrome trace-event JSON (Booster.dump_trace / GET /trace / paired
+    # with every flight dump); trace_sample is the default per-span accept
+    # rate (deterministic, per category — 1.0 records everything)
+    trace_spans: bool = True
+    trace_capacity: int = 4096
+    trace_sample: float = 1.0
     profile_trace_dir: str = ""
     profile_iter_start: int = 0
     profile_iter_end: int = -1
@@ -728,6 +737,13 @@ class Config:
                 "flight_capacity must be >= 32 (the dump-on-fault contract "
                 "promises the last 32 iteration events)"
             )
+        if self.trace_capacity < 64:
+            raise ValueError(
+                "trace_capacity must be >= 64 (one training iteration or "
+                "serving flush records several spans)"
+            )
+        if not (0.0 <= self.trace_sample <= 1.0):
+            raise ValueError("trace_sample must be in [0, 1]")
         if self.bagging_freq > 0 and (self.pos_bagging_fraction < 1.0 or self.neg_bagging_fraction < 1.0):
             if self.objective != "binary":
                 raise ValueError("pos/neg bagging fractions require binary objective")

@@ -66,6 +66,17 @@ def train(
             device_accounting=cfg.obs_device_accounting,
             measure_collectives=cfg.obs_collectives,
         )
+    # distributed tracing: always-on span recorder (independent of the
+    # telemetry session) — iteration/launch spans land under one train/run
+    # root span, dumped via Booster.dump_trace / GET /trace / on fault
+    from .obs.trace import get_tracer
+
+    tracer = get_tracer()
+    tracer.configure(
+        active=cfg.trace_spans,
+        capacity=cfg.trace_capacity,
+        default_rate=cfg.trace_sample,
+    )
     trace = (
         TraceWindow(
             cfg.profile_trace_dir,
@@ -184,6 +195,19 @@ def train(
     booster._host_overhead_total_ms = 0.0
     booster._host_overhead_n = 0
     prev_dispatch_end: Optional[float] = None
+    # root span for the whole training run: iteration/launch spans created
+    # by Booster.update / LaunchRunner.run attach as children (tls stack)
+    run_span = tracer.begin(
+        "train/run",
+        "train",
+        args={
+            "begin_iteration": begin_iteration,
+            "end_iteration": end_iteration,
+            "steps_per_launch": launch_n,
+        },
+        attach=True,
+        ambient=True,
+    )
     try:
         it = begin_iteration
         while it < end_iteration:
@@ -290,6 +314,8 @@ def train(
         booster.best_iteration = e.best_iteration + 1
         evaluation_result_list = e.best_score
     finally:
+        if run_span is not None:
+            tracer.end(run_span)
         if trace is not None:
             trace.close()
         if sigterm_installed:

@@ -22,7 +22,12 @@ into:
   atomic dump-on-fault: NumericsError, degradation latch, SIGTERM),
   ``health`` (per-iteration host-side watchdog emitting severity-tagged
   alerts), ``export`` (Prometheus text-format snapshot + opt-in HTTP
-  endpoint via ``obs_export_port`` and the ``Booster.health()`` API).
+  endpoint via ``obs_export_port`` and the ``Booster.health()`` API);
+* distributed tracing — ``trace`` (always-on span recorder with
+  ``trace_id``/``span_id``/parent links and per-category sampling,
+  exported as Perfetto-loadable Chrome trace JSON via
+  ``Booster.dump_trace``, ``GET /trace``, and automatically next to every
+  flight dump).  See README "Distributed tracing".
 
 Enable with ``telemetry=True`` (params/Config), stream to a file with
 ``telemetry_out=<path.jsonl>``, make phase walls measure device time with
@@ -75,6 +80,12 @@ from .registry import (  # noqa: F401
     get_session,
     session_disabled,
 )
+from .trace import (  # noqa: F401
+    TraceRecorder,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+)
 
 __all__ = [
     "TelemetrySession",
@@ -108,4 +119,8 @@ __all__ = [
     "host_snapshot",
     "merge_snapshots",
     "TraceWindow",
+    "TraceRecorder",
+    "get_tracer",
+    "parse_traceparent",
+    "format_traceparent",
 ]

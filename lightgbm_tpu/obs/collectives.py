@@ -43,6 +43,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .trace import note_collective
+
 _LOCK = threading.Lock()
 _ACC: Dict[str, Dict[str, float]] = {}  # site -> calls / bytes / wall_ns
 
@@ -66,6 +68,10 @@ def _end_host(site: str, nbytes: int, t0, _probe) -> np.ndarray:
         acc["calls"] += 1
         acc["bytes"] += nbytes
         acc["wall_ns"] += max(0, t - start)
+    # the measured site doubles as a trace span with payload-byte args,
+    # parented under the ambient training span (host clocks only — the
+    # begin/end brackets above are already concrete host ints)
+    note_collective(site, start, t, nbytes)
     return np.uint32(0)
 
 

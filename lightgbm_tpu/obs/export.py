@@ -13,6 +13,8 @@ hung scrape never blocks training and the thread dies with the process):
 
 * ``GET /metrics``  — Prometheus text format
 * ``GET /healthz``  — the ``Booster.health()`` JSON document
+* ``GET /trace``    — the live span ring as Chrome trace-event JSON
+  (Perfetto-loadable; see ``obs/trace.py``)
 
 The serving plane (``lightgbm_tpu/serving``) colocates its HTTP/JSON
 front end on the same endpoint by passing extra ``routes`` (method/path
@@ -36,6 +38,7 @@ from typing import Any, Callable, Dict, List, Optional
 from .flight import get_flight
 from .health import _SEV_RANK, HealthWatchdog
 from .registry import TelemetrySession, _jsonable, get_session
+from .trace import get_tracer
 
 METRIC_PREFIX = "lgbtpu_"
 
@@ -107,7 +110,41 @@ def prometheus_snapshot(
             name += "_total"
         emit(name, "counter", counters[raw])
     for raw in sorted(gauges):
+        # the serve queue/device attribution gauges are re-rendered below
+        # as proper Prometheus summaries — skip the raw gauge lines so
+        # strict parsers never see the same sample name with two TYPEs
+        if raw.startswith(("serve/queue_ms_", "serve/device_ms_")):
+            continue
         emit(sanitize_metric_name(raw), "gauge", gauges[raw])
+    # trace-plane health rides every scrape (the recorder is always-on and
+    # independent of the telemetry session's enabled flag)
+    tracer = get_tracer()
+    emit(
+        METRIC_PREFIX + "trace_spans_total", "counter", tracer.spans_total,
+        "spans recorded by the distributed trace recorder",
+    )
+    emit(
+        METRIC_PREFIX + "trace_dropped_total", "counter",
+        tracer.dropped_total, "trace spans evicted from the bounded ring",
+    )
+    # per-request serving attribution as Prometheus summaries (quantiles
+    # from the batcher's window, sum/count from its cumulative totals)
+    for dim in ("queue", "device"):
+        p50 = gauges.get(f"serve/{dim}_ms_p50")
+        p99 = gauges.get(f"serve/{dim}_ms_p99")
+        if p50 is None or p99 is None:
+            continue
+        name = f"{METRIC_PREFIX}serve_{dim}_ms"
+        lines.append(f"# TYPE {name} summary")
+        lines.append(f'{name}{{quantile="0.5"}} {_fmt_value(p50)}')
+        lines.append(f'{name}{{quantile="0.99"}} {_fmt_value(p99)}')
+        lines.append(
+            f"{name}_sum {_fmt_value(gauges.get(f'serve/{dim}_ms_sum', 0.0))}"
+        )
+        lines.append(
+            f"{name}_count "
+            f"{_fmt_value(counters.get('serve/requests_total', 0))}"
+        )
     if health is not None:
         status = str(health.get("status", "ok"))
         emit(
@@ -161,8 +198,10 @@ def health_snapshot(
                 "capacity": flight.capacity,
                 "n_events": len(flight.events()),
                 "last_dump": flight.last_dump_path,
+                "last_trace_dump": flight.last_trace_path,
                 "last_checkpoint": flight.last_checkpoint,
             },
+            "trace": get_tracer().stats(),
         }
     )
     if serving is not None:
@@ -173,10 +212,18 @@ def health_snapshot(
 class _Handler(BaseHTTPRequestHandler):
     exporter: "MetricsExporter"
 
-    def _respond(self, status: int, ctype: str, body: bytes) -> None:
+    def _respond(
+        self,
+        status: int,
+        ctype: str,
+        body: bytes,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
         self.end_headers()
         self.wfile.write(body)
 
@@ -184,12 +231,25 @@ class _Handler(BaseHTTPRequestHandler):
         route = self.exporter._routes.get((method, path))
         if route is None:
             return False
+        extra: Dict[str, str] = {}
         try:
-            status, ctype, out = route(body)
+            # handlers marked ``wants_headers`` (a function attribute) get
+            # the request headers — how the serving front end reads a
+            # caller's ``traceparent`` — and may return a 4th element of
+            # response headers to echo it back
+            if getattr(route, "wants_headers", False):
+                hdrs = {k.lower(): v for k, v in self.headers.items()}
+                result = route(body, hdrs)
+            else:
+                result = route(body)
+            if len(result) == 4:
+                status, ctype, out, extra = result
+            else:
+                status, ctype, out = result
         except Exception as e:
             status, ctype = 500, "application/json"
             out = json.dumps({"error": str(e)}).encode("utf-8")
-        self._respond(status, ctype, out)
+        self._respond(status, ctype, out, extra)
         return True
 
     def do_GET(self):  # noqa: N802 - http.server API
@@ -201,6 +261,11 @@ class _Handler(BaseHTTPRequestHandler):
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path == "/healthz":
             body = json.dumps(self.exporter._health() or {}).encode("utf-8")
+            ctype = "application/json"
+        elif path == "/trace":
+            # the live span ring as Chrome trace-event JSON — save the
+            # response body and load it in Perfetto / chrome://tracing
+            body = get_tracer().chrome_trace_json().encode("utf-8")
             ctype = "application/json"
         elif self._dispatch_route("GET", path, b""):
             return

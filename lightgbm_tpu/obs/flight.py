@@ -91,6 +91,7 @@ class FlightRecorder:
         self.run_info: Dict[str, Any] = {}
         self.last_checkpoint = ""
         self.last_dump_path = ""
+        self.last_trace_path = ""
         self.dump_count = 0
 
     # ---------------------------------------------------------- lifecycle
@@ -122,6 +123,7 @@ class FlightRecorder:
             self._sticky.clear()
             self.last_checkpoint = ""
             self.last_dump_path = ""
+            self.last_trace_path = ""
             self.dump_count = 0
 
     @property
@@ -216,14 +218,21 @@ class FlightRecorder:
         try:
             os.makedirs(target, exist_ok=True)
             ts = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
-            path = os.path.join(
-                target, f"flight_{ts}_{os.getpid()}_{self.dump_count}.json"
-            )
+            suffix = f"{ts}_{os.getpid()}_{self.dump_count}"
+            path = os.path.join(target, f"flight_{suffix}.json")
             _atomic_write_text(
                 path, json.dumps(self.snapshot(reason), indent=1)
             )
+            # pair the black box with the span timeline: the trace recorder
+            # dumps trace_<same suffix>.json next to this flight dump (best
+            # effort — a trace failure must not lose the flight dump)
+            from .trace import get_tracer
+
+            trace_path = get_tracer().dump_fault(target, suffix)
             with self._lock:
                 self.last_dump_path = path
+                if trace_path:
+                    self.last_trace_path = trace_path
                 self.dump_count += 1
             return path
         except Exception:

@@ -132,7 +132,13 @@ class HealthWatchdog:
         ses = ses or get_session()
         it = int(event.get("iter", self._last_iter + 1))
         self._last_iter = it
-        self._seen += 1
+        # warmup is counted in ITERATIONS, not observe() calls: a launch
+        # event covers `steps` iterations (train_steps_per_launch=N calls
+        # observe once per window), so advancing by 1 would silently
+        # stretch the warmup window N-fold.  Cooldown and the activity
+        # window already use `iter`-denominated arithmetic, which a launch
+        # event advances by N on its own.
+        self._seen += max(1, int(event.get("steps", 1)))
         out: List[Dict[str, Any]] = []
         gauges = ses.gauges
         counters = ses.counters
@@ -256,10 +262,22 @@ class HealthWatchdog:
             and requests >= self.deadline_miss_min_requests
             and miss > self.deadline_miss_ceiling
         ):
+            # per-request attribution (when the batcher publishes it) tells
+            # the operator WHERE the missed time went without a trace dump:
+            # queue wait (worker busy / overload) vs device dispatch
+            attribution = ""
+            queue_p99 = event.get("queue_ms_p99")
+            device_p99 = event.get("device_ms_p99")
+            if queue_p99 is not None and device_p99 is not None:
+                attribution = (
+                    f" (queue p99 {float(queue_p99):.1f} ms, "
+                    f"device p99 {float(device_p99):.1f} ms)"
+                )
             self._emit(
                 out, it, "serve_deadline", SEV_WARN,
                 f"serving deadline-miss rate {miss:.3f} > "
-                f"{self.deadline_miss_ceiling:g} over {requests} requests",
+                f"{self.deadline_miss_ceiling:g} over {requests} requests"
+                + attribution,
                 float(miss), self.deadline_miss_ceiling,
             )
         if out:

@@ -28,6 +28,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from .trace import note_phase as _note_phase
+
 
 class _NullPhase:
     """Shared no-op context manager handed out when telemetry is off."""
@@ -278,6 +280,9 @@ class _PhaseTimer:
     def __exit__(self, *exc):
         dt = time.perf_counter() - self._t0
         self._acc[self._name] = self._acc.get(self._name, 0.0) + dt
+        # phase walls double as trace spans under the open iteration/launch
+        # span (obs/trace.py); no-op when tracing is off or no span is open
+        _note_phase(self._name, self._t0, dt)
         return False
 
 

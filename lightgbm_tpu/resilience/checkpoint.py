@@ -92,12 +92,19 @@ def save_checkpoint(booster, directory: str, keep_last: Optional[int] = None) ->
     ``checkpoint_keep`` config (older checkpoints beyond it are deleted;
     pass 0/None-config to keep everything).
     """
-    state = booster._checkpoint_state()
-    if keep_last is None:
-        keep_last = int(getattr(booster.config, "checkpoint_keep", 0))
-    os.makedirs(directory, exist_ok=True)
-    path = os.path.join(directory, _ckpt_name(state["iter"]))
-    atomic_write_bytes(path, pickle.dumps(state, protocol=4))
+    from ..obs.trace import get_tracer
+
+    with get_tracer().span(
+        "lifecycle/checkpoint", "lifecycle", args={"directory": directory}
+    ) as sp:
+        state = booster._checkpoint_state()
+        if keep_last is None:
+            keep_last = int(getattr(booster.config, "checkpoint_keep", 0))
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, _ckpt_name(state["iter"]))
+        atomic_write_bytes(path, pickle.dumps(state, protocol=4))
+        if sp is not None:
+            sp.args.update({"iter": state["iter"], "path": path})
     ses = get_session()
     ses.inc("checkpoints_saved")
     event = {"event": "checkpoint", "iter": state["iter"], "path": path}

@@ -43,6 +43,7 @@ import numpy as np
 
 from ..obs.flight import get_flight
 from ..obs.registry import get_session
+from ..obs.trace import format_traceparent, get_tracer, parse_traceparent
 from ..predict import LADDER_MIN, bucket_rows
 
 # Plans: (padded_matrix, live_rows) pairs — one dispatch call predicts
@@ -64,6 +65,10 @@ class _Request(NamedTuple):
     X: np.ndarray
     future: Future
     t_enqueue: float
+    # tracing: span opened in submit() (ends when the response resolves);
+    # traceparent is the caller's W3C header, echoed back through info
+    span: Any = None
+    traceparent: Optional[str] = None
 
 
 class _Stop:
@@ -109,6 +114,16 @@ class MicroBatcher:
             maxlen=_STATS_WINDOW
         )
         self._fill_window: collections.deque = collections.deque(maxlen=256)
+        # latency attribution windows: where a request's wall went —
+        # queue_wait (coalescing + worker backlog) vs device_dispatch
+        self._queue_window: collections.deque = collections.deque(
+            maxlen=_STATS_WINDOW
+        )
+        self._device_window: collections.deque = collections.deque(
+            maxlen=_STATS_WINDOW
+        )
+        self._queue_ms_total = 0.0
+        self._device_ms_total = 0.0
         self.counters: Dict[str, int] = {
             "requests": 0,
             "rows": 0,
@@ -126,8 +141,14 @@ class MicroBatcher:
         self._thread.start()
 
     # ------------------------------------------------------------- client
-    def submit(self, X: np.ndarray) -> "Future":
-        """Enqueue one request; the Future resolves to a ServeResponse."""
+    def submit(
+        self, X: np.ndarray, traceparent: Optional[str] = None
+    ) -> "Future":
+        """Enqueue one request; the Future resolves to a ServeResponse.
+
+        ``traceparent`` is an optional W3C trace-context header from the
+        caller: the request's serve span joins that trace (the span id is
+        echoed back via ``ServeResponse.info["traceparent"]``)."""
         if not self._running:
             raise RuntimeError(f"batcher '{self.name}' is stopped")
         X = np.asarray(X)
@@ -138,8 +159,22 @@ class MicroBatcher:
                 f"expected a non-empty [rows, features] matrix, got shape "
                 f"{X.shape}"
             )
+        # request span opens at enqueue and closes when the response
+        # resolves in _flush (cross-thread: no tls attach); its queue_wait
+        # child and the flush's batch-stage spans decompose the latency
+        tracer = get_tracer()
+        ctx = parse_traceparent(traceparent) if traceparent else None
+        span = tracer.begin(
+            "serve/request",
+            "serve",
+            trace_id=ctx[0] if ctx else None,
+            parent=ctx[1] if ctx else None,
+            args={"rows": int(X.shape[0]), "batcher": self.name},
+        )
         fut: Future = Future()
-        self._queue.put(_Request(X, fut, time.perf_counter()))
+        self._queue.put(
+            _Request(X, fut, time.perf_counter(), span, traceparent)
+        )
         return fut
 
     def stop(self, timeout: float = 10.0) -> None:
@@ -210,6 +245,7 @@ class MicroBatcher:
                 )
 
     def _flush(self, batch: List[_Request], rows: int, reason: str) -> None:
+        tracer = get_tracer()
         t_disp = time.perf_counter()
         X = (
             batch[0].X
@@ -228,24 +264,47 @@ class MicroBatcher:
                 padded[:live] = mat
                 mat = padded
             plans.append((mat, live))
+        t_asm_done = time.perf_counter()
         try:
             preds, info = self._dispatch(plans)
         except Exception as e:
             with self._lock:
                 self.counters["errors"] += 1
             for r in batch:
+                if r.span is not None:
+                    tracer.end(r.span, extra={"error": type(e).__name__})
                 r.future.set_exception(e)
             return
+        t_dev_done = time.perf_counter()
         lo = 0
         for r in batch:
             n = r.X.shape[0]
-            r.future.set_result(ServeResponse(preds[lo : lo + n], info))
+            resp_info = info
+            if r.span is not None or r.traceparent:
+                # echo trace context so the caller can correlate: the
+                # request span's own ids when it was sampled, otherwise
+                # the caller's header unchanged
+                resp_info = dict(info)
+                resp_info["traceparent"] = (
+                    format_traceparent(r.span.trace_id, r.span.span_id)
+                    if r.span is not None
+                    else r.traceparent
+                )
+            r.future.set_result(ServeResponse(preds[lo : lo + n], resp_info))
             lo += n
         t_done = time.perf_counter()
         bucket_total = sum(m.shape[0] for m, _ in plans)
+        self._note_trace(
+            batch, rows, bucket_total, reason, info,
+            t_disp, t_asm_done, t_dev_done, t_done,
+        )
+        queue_ms = [(t_disp - r.t_enqueue) * 1e3 for r in batch]
+        device_ms = (t_dev_done - t_asm_done) * 1e3
         with self._lock:
-            for r in batch:
+            for r, q_ms in zip(batch, queue_ms):
                 self._latencies_ms.append((t_done - r.t_enqueue) * 1e3)
+                self._queue_window.append(q_ms)
+                self._queue_ms_total += q_ms
                 missed = (
                     t_disp - r.t_enqueue
                     > self.deadline_s + self.miss_slack_s
@@ -253,6 +312,12 @@ class MicroBatcher:
                 self._miss_window.append(1 if missed else 0)
                 if missed:
                     self.counters["deadline_miss"] += 1
+            # device time is shared by every request in the batch: each
+            # rider attributes the full dispatch wall to itself (that IS
+            # the latency it observed waiting on the device)
+            for _ in batch:
+                self._device_window.append(device_ms)
+                self._device_ms_total += device_ms
             self._fill_window.append(rows / max(1, bucket_total))
             self.counters["requests"] += len(batch)
             self.counters["rows"] += rows
@@ -263,16 +328,82 @@ class MicroBatcher:
             window = self._stats_locked()
         self._publish(window, rows, bucket_total, reason, len(batch))
 
+    def _note_trace(
+        self, batch, rows, bucket_total, reason, info,
+        t_disp, t_asm_done, t_dev_done, t_done,
+    ) -> None:
+        """Span decomposition for one flush: a ``serve/batch`` span with
+        ``batch_assembly`` / ``device_dispatch`` / ``unpad_respond`` stage
+        children, plus each rider request's ``queue_wait`` child and the
+        close of its ``serve/request`` span.  perf_counter() and the
+        tracer's perf_counter_ns() share an epoch, so the second-based
+        timestamps convert to span microseconds directly."""
+        tracer = get_tracer()
+        if not tracer.active:
+            return
+        us = lambda t: int(t * 1e6)  # noqa: E731
+        batch_id = tracer.add_span(
+            "serve/batch",
+            "serve",
+            us(t_disp),
+            max(1, us(t_done) - us(t_disp)),
+            args={
+                "batcher": self.name,
+                "requests": len(batch),
+                "rows": rows,
+                "bucket_rows": bucket_total,
+                "reason": reason,
+                "model": info.get("model_id", info.get("model", "")),
+            },
+        )
+        for stage, a, z in (
+            ("batch_assembly", t_disp, t_asm_done),
+            ("device_dispatch", t_asm_done, t_dev_done),
+            ("unpad_respond", t_dev_done, t_done),
+        ):
+            tracer.add_span(
+                f"serve/{stage}",
+                "serve",
+                us(a),
+                max(1, us(z) - us(a)),
+                parent_id=batch_id,
+            )
+        for r in batch:
+            if r.span is None:
+                continue
+            # the rider's queue_wait covers enqueue -> flush start; the
+            # batch span id rides in args (the request may belong to a
+            # caller's distributed trace, so it is NOT reparented)
+            tracer.add_span(
+                "serve/queue_wait",
+                "serve",
+                us(r.t_enqueue),
+                max(1, us(t_disp) - us(r.t_enqueue)),
+                trace_id=r.span.trace_id,
+                parent_id=r.span.span_id,
+                tid=r.span.tid,
+            )
+            tracer.end(
+                r.span,
+                extra={"batch_span": batch_id, "reason": reason},
+                end_us=us(t_done),
+            )
+
     # -------------------------------------------------------------- stats
     def _stats_locked(self) -> Dict[str, Any]:
         lat = sorted(self._latencies_ms)
         misses = list(self._miss_window)
         fills = list(self._fill_window)
+        q = sorted(self._queue_window)
+        d = sorted(self._device_window)
+
+        def pct_of(arr: List[float], p: float) -> float:
+            if not arr:
+                return 0.0
+            return arr[min(len(arr) - 1, int(p * (len(arr) - 1)))]
 
         def pct(p: float) -> float:
-            if not lat:
-                return 0.0
-            return lat[min(len(lat) - 1, int(p * (len(lat) - 1)))]
+            return pct_of(lat, p)
 
         return {
             "name": self.name,
@@ -283,6 +414,12 @@ class MicroBatcher:
                 sum(misses) / len(misses) if misses else 0.0
             ),
             "window_requests": len(lat),
+            "queue_ms_p50": pct_of(q, 0.50),
+            "queue_ms_p99": pct_of(q, 0.99),
+            "queue_ms_sum": self._queue_ms_total,
+            "device_ms_p50": pct_of(d, 0.50),
+            "device_ms_p99": pct_of(d, 0.99),
+            "device_ms_sum": self._device_ms_total,
             **dict(self.counters),
         }
 
@@ -306,6 +443,14 @@ class MicroBatcher:
                     "serve/p99_ms": window["p99_ms"],
                     "serve/batch_fill": window["batch_fill"],
                     "serve/deadline_miss_rate": window["deadline_miss_rate"],
+                    # latency attribution: feed the /metrics summaries and
+                    # the serve_deadline watchdog rule's blame message
+                    "serve/queue_ms_p50": window["queue_ms_p50"],
+                    "serve/queue_ms_p99": window["queue_ms_p99"],
+                    "serve/queue_ms_sum": window["queue_ms_sum"],
+                    "serve/device_ms_p50": window["device_ms_p50"],
+                    "serve/device_ms_p99": window["device_ms_p99"],
+                    "serve/device_ms_sum": window["device_ms_sum"],
                 }
             )
             ses.inc("serve/requests_total", n_requests)
@@ -331,6 +476,8 @@ class MicroBatcher:
                         "requests": window["window_requests"],
                         "deadline_miss_rate": window["deadline_miss_rate"],
                         "p99_ms": window["p99_ms"],
+                        "queue_ms_p99": window["queue_ms_p99"],
+                        "device_ms_p99": window["device_ms_p99"],
                         "batcher": self.name,
                     }
                 )

@@ -29,6 +29,7 @@ import numpy as np
 
 from ..obs.flight import get_flight
 from ..obs.registry import get_session
+from ..obs.trace import get_tracer
 from .registry import ModelRegistry
 
 
@@ -134,26 +135,38 @@ class RefreshLoop:
             self.last_report = report
             return report
         base = self.registry.booster(self.model_id)
-        if self.mode == "refit":
-            candidate = base.refit(X, y, decay_rate=self.decay_rate)
-        else:
-            from .. import engine
-            from ..dataset import Dataset
+        tracer = get_tracer()
+        # refit-cycle span: candidate build + metric gate (the promotion's
+        # swap_warm/swap_flip spans land separately via registry.hot_swap)
+        with tracer.span(
+            "lifecycle/refresh_cycle",
+            "lifecycle",
+            args={
+                "model_id": self.model_id,
+                "mode": self.mode,
+                "rows": int(X.shape[0]),
+            },
+        ):
+            if self.mode == "refit":
+                candidate = base.refit(X, y, decay_rate=self.decay_rate)
+            else:
+                from .. import engine
+                from ..dataset import Dataset
 
-            candidate = engine.train(
-                dict(base.params),
-                Dataset(X, y),
-                num_boost_round=self.extend_rounds,
-                init_model=base,
-            )
-        if callable(self.metric):
-            metric_name = getattr(self.metric, "__name__", "custom")
-            base_score = float(self.metric(base, X, y))
-            cand_score = float(self.metric(candidate, X, y))
-        else:
-            metric_name = self.metric
-            base_score = _score(base, X, y, self.metric)
-            cand_score = _score(candidate, X, y, self.metric)
+                candidate = engine.train(
+                    dict(base.params),
+                    Dataset(X, y),
+                    num_boost_round=self.extend_rounds,
+                    init_model=base,
+                )
+            if callable(self.metric):
+                metric_name = getattr(self.metric, "__name__", "custom")
+                base_score = float(self.metric(base, X, y))
+                cand_score = float(self.metric(candidate, X, y))
+            else:
+                metric_name = self.metric
+                base_score = _score(base, X, y, self.metric)
+                cand_score = _score(candidate, X, y, self.metric)
         promote = cand_score <= base_score + self.tolerance
         report = {
             "promoted": promote,
@@ -178,6 +191,17 @@ class RefreshLoop:
                 ses.set_gauge(
                     "serve/last_promotion_gain", base_score - cand_score
                 )
+            tracer.instant(
+                "lifecycle/refresh_promote",
+                "lifecycle",
+                args={
+                    "model_id": self.model_id,
+                    "version": report["version"],
+                    "metric": metric_name,
+                    "base_score": base_score,
+                    "candidate_score": cand_score,
+                },
+            )
             get_flight().note_sticky(
                 {"event": "serve_promotion", "model_id": self.model_id, **report}
             )
@@ -185,6 +209,16 @@ class RefreshLoop:
             self.rejections += 1
             if ses.enabled:
                 ses.inc("serve/promotions_rejected_total")
+            tracer.instant(
+                "lifecycle/refresh_reject",
+                "lifecycle",
+                args={
+                    "model_id": self.model_id,
+                    "metric": metric_name,
+                    "base_score": base_score,
+                    "candidate_score": cand_score,
+                },
+            )
             get_flight().note_sticky(
                 {
                     "event": "serve_promotion_rejected",

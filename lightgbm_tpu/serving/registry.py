@@ -36,6 +36,7 @@ import numpy as np
 
 from ..obs.flight import get_flight
 from ..obs.registry import get_session
+from ..obs.trace import get_tracer
 from ..predict import (
     LADDER_MIN,
     StreamingPredictor,
@@ -108,7 +109,12 @@ class ModelRegistry:
                 )
         entry = ModelEntry(model_id, 1, booster)
         if warm:
-            self._warm(entry)
+            with get_tracer().span(
+                "lifecycle/model_warm",
+                "lifecycle",
+                args={"model_id": model_id, "version": entry.version},
+            ):
+                self._warm(entry)
         evicted = []
         with self._lock:
             if model_id in self._live:
@@ -187,7 +193,12 @@ class ModelRegistry:
             version = old.version + 1
         entry = ModelEntry(model_id, version, booster)
         try:
-            self._warm(entry)
+            with get_tracer().span(
+                "lifecycle/swap_warm",
+                "lifecycle",
+                args={"model_id": model_id, "to_version": version},
+            ):
+                self._warm(entry)
         except BaseException as e:
             evict_exec_scope(entry.scope)
             flight = get_flight()
@@ -199,6 +210,15 @@ class ModelRegistry:
                     "to_version": version,
                     "error": repr(e),
                 }
+            )
+            get_tracer().instant(
+                "lifecycle/swap_failed",
+                "lifecycle",
+                args={
+                    "model_id": model_id,
+                    "to_version": version,
+                    "error": repr(e)[:200],
+                },
             )
             flight.dump(f"swap_warmup_failure:{model_id}")
             ses = get_session()
@@ -215,6 +235,16 @@ class ModelRegistry:
                 old.retired = True
                 if old.inflight == 0:
                     retire_now = old
+        get_tracer().instant(
+            "lifecycle/swap_flip",
+            "lifecycle",
+            args={
+                "model_id": model_id,
+                "from_version": old.version if old is not None else None,
+                "to_version": entry.version,
+                "generation": entry.generation,
+            },
+        )
         if retire_now is not None:
             self._retire_now(retire_now)
         self._note_lifecycle(
@@ -410,6 +440,15 @@ class ModelRegistry:
     def _retire_now(self, entry: ModelEntry) -> None:
         dropped = evict_exec_scope(entry.scope)
         entry.booster._stream = None
+        get_tracer().instant(
+            "lifecycle/drain_retire",
+            "lifecycle",
+            args={
+                "model_id": entry.model_id,
+                "version": entry.version,
+                "executables_dropped": dropped,
+            },
+        )
         get_flight().note_event(
             {
                 "event": "serve_model_retired",

@@ -149,10 +149,17 @@ class ServingServer:
         return batcher
 
     def predict_async(
-        self, X, model_id: Optional[str] = None
+        self,
+        X,
+        model_id: Optional[str] = None,
+        traceparent: Optional[str] = None,
     ) -> "Future[ServeResponse]":
-        """Enqueue one request; resolves to (values, model-identity info)."""
-        return self._batcher(model_id).submit(X)
+        """Enqueue one request; resolves to (values, model-identity info).
+
+        ``traceparent`` (optional W3C header) joins the request's serve
+        span to the caller's distributed trace; the assigned span id is
+        echoed via ``ServeResponse.info["traceparent"]``."""
+        return self._batcher(model_id).submit(X, traceparent=traceparent)
 
     def predict(
         self,
@@ -231,7 +238,12 @@ class ServingServer:
         return self._batcher(model_id).stats()
 
     # ---------------------------------------------------------------- http
-    def _http_predict(self, body: bytes):
+    def _http_predict(self, body: bytes, headers: Optional[Dict[str, str]] = None):
+        # W3C trace-context: accept the caller's traceparent header and
+        # echo the request span's own ids back as a response header (and
+        # in the JSON body) so the caller can correlate its trace with
+        # the serve timeline in GET /trace
+        traceparent = (headers or {}).get("traceparent")
         try:
             doc = json.loads(body.decode("utf-8"))
             rows = np.asarray(doc["rows"], dtype=np.float64)
@@ -242,9 +254,9 @@ class ServingServer:
                 json.dumps({"error": f"bad request: {e}"}).encode("utf-8"),
             )
         try:
-            resp = self.predict_async(rows, doc.get("model")).result(
-                timeout=30.0
-            )
+            resp = self.predict_async(
+                rows, doc.get("model"), traceparent=traceparent
+            ).result(timeout=30.0)
         except KeyError as e:
             return (
                 404,
@@ -255,11 +267,17 @@ class ServingServer:
             "predictions": np.asarray(resp.values).tolist(),
             **resp.info,
         }
+        extra_headers = {}
+        if resp.info.get("traceparent"):
+            extra_headers["traceparent"] = resp.info["traceparent"]
         return (
             200,
             "application/json",
             json.dumps(out).encode("utf-8"),
+            extra_headers,
         )
+
+    _http_predict.wants_headers = True
 
     def _http_models(self, body: bytes):
         return (

@@ -352,8 +352,10 @@ def test_health_snapshot_schema():
     assert doc["iter"] == 3
     assert doc["alerts"] == []
     assert set(doc["flight"]) == {
-        "capacity", "n_events", "last_dump", "last_checkpoint",
+        "capacity", "n_events", "last_dump", "last_trace_dump",
+        "last_checkpoint",
     }
+    assert set(doc["trace"]) >= {"active", "ring", "spans_total"}
     json.dumps(doc)  # JSON-serializable end to end
 
 

@@ -1,0 +1,406 @@
+"""End-to-end structured tracing: span recorder, Chrome export, wiring.
+
+Covers the always-on span recorder (tree integrity, bounded-ring
+eviction accounting, deterministic sampling), the Chrome trace-event
+JSON export (Perfetto-loadable schema), the training instrumentation
+(iteration spans, launch spans with synthetic per-iteration children
+reconstructed from device counters, per-iteration ``from_launch`` JSONL
+events), the serving decomposition (request/queue_wait/batch stages,
+W3C traceparent round-trip over HTTP), dump-on-fault pairing with the
+flight recorder, the iteration-denominated watchdog cadence at
+``train_steps_per_launch`` N=1 vs N=8, and the zero-retrace contract.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.obs.flight import get_flight  # noqa: E402
+from lightgbm_tpu.obs.health import HealthWatchdog  # noqa: E402
+from lightgbm_tpu.obs.jit import compile_counts_by_label  # noqa: E402
+from lightgbm_tpu.obs.registry import get_session  # noqa: E402
+from lightgbm_tpu.obs.trace import (  # noqa: E402
+    MIN_CAPACITY,
+    TRACE_SCHEMA,
+    TraceRecorder,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    ses = get_session()
+    ses.configure(enabled=False)
+    ses.reset()
+    flight = get_flight()
+    flight.reset()
+    flight.configure(fault_dir="", run_info={}, active=True)
+    tracer = get_tracer()
+    tracer.reset()
+    tracer.configure(active=True, capacity=4096, default_rate=1.0, rates={})
+    yield
+    ses.configure(enabled=False)
+    ses.reset()
+    flight.reset()
+    flight.configure(fault_dir="", run_info={}, active=True)
+    tracer.reset()
+    tracer.configure(active=True, capacity=4096, default_rate=1.0, rates={})
+
+
+def _data(n=300, f=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n)
+    return X, y
+
+
+_PARAMS = {
+    "objective": "regression",
+    "num_leaves": 7,
+    "verbosity": -1,
+    "deterministic": True,
+    "seed": 7,
+}
+
+
+# ------------------------------------------------------------- recorder core
+def test_span_tree_integrity():
+    tr = TraceRecorder()
+    with tr.span("root", "train") as root:
+        assert root is not None
+        with tr.span("child", "train") as child:
+            tr.instant("leaf", "lifecycle")
+    spans = tr.spans()
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["child"]["parent_id"] == root.span_id
+    assert by_name["child"]["trace_id"] == root.trace_id
+    assert by_name["leaf"]["parent_id"] == child.span_id
+    assert by_name["root"]["parent_id"] is None
+    # ids are stable hex of the documented widths
+    assert len(root.trace_id) == 32 and len(root.span_id) == 16
+    int(root.trace_id, 16), int(root.span_id, 16)
+    # ends arrive child-first, and every duration is non-negative
+    assert [s["name"] for s in spans] == ["leaf", "child", "root"]
+    assert all((s["dur"] or 0) >= 0 for s in spans)
+
+
+def test_ring_eviction_accounting():
+    tr = TraceRecorder()
+    tr.configure(capacity=MIN_CAPACITY)
+    for i in range(MIN_CAPACITY + 36):
+        tr.end(tr.begin(f"s{i}", "train"))
+    st = tr.stats()
+    assert st["ring"] == MIN_CAPACITY
+    assert st["spans_total"] == MIN_CAPACITY + 36
+    assert st["dropped_total"] == 36
+    # the ring keeps the newest spans
+    assert tr.spans()[-1]["name"] == f"s{MIN_CAPACITY + 35}"
+
+
+def test_sampling_deterministic_and_per_category():
+    tr = TraceRecorder()
+    tr.configure(default_rate=0.25, rates={"serve": 1.0, "phase": 0.0})
+    kept = sum(tr.begin(f"t{i}", "train") is not None for i in range(100))
+    assert kept == 25  # counter-based: exactly rate * n
+    assert all(tr.begin(f"r{i}", "serve") is not None for i in range(10))
+    assert all(tr.begin(f"p{i}", "phase") is None for i in range(10))
+    tr.configure(active=False)
+    assert tr.begin("off", "serve") is None
+
+
+def test_traceparent_parse_and_format():
+    tp = format_traceparent("ab" * 16, "cd" * 8)
+    assert tp == "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    assert parse_traceparent(tp) == ("ab" * 16, "cd" * 8)
+    assert parse_traceparent("garbage") is None
+    assert parse_traceparent("") is None
+    assert parse_traceparent(None) is None
+    # all-zero ids are invalid per W3C trace-context
+    assert parse_traceparent("00-" + "0" * 32 + "-" + "cd" * 8 + "-01") is None
+    assert parse_traceparent("00-" + "ab" * 16 + "-" + "0" * 16 + "-01") is None
+
+
+def test_chrome_trace_schema(tmp_path):
+    tr = TraceRecorder()
+    with tr.span("outer", "train", args={"k": 1}):
+        tr.instant("mark", "lifecycle")
+    path = tr.dump(str(tmp_path / "trace.json"))
+    doc = json.loads(open(path).read())
+    assert isinstance(doc["traceEvents"], list)
+    assert doc["displayTimeUnit"] == "ms"
+    assert doc["metadata"]["schema"] == TRACE_SCHEMA
+    events = doc["traceEvents"]
+    metas = [e for e in events if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in metas)
+    assert any(e["name"] == "thread_name" for e in metas)
+    xs = [e for e in events if e["ph"] == "X"]
+    instants = [e for e in events if e["ph"] == "i"]
+    assert len(xs) == 1 and len(instants) == 1
+    for e in xs:
+        assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+        assert e["pid"] == os.getpid()
+        assert {"trace_id", "span_id"} <= set(e["args"])
+    assert instants[0]["s"] == "t"
+    # non-meta events are sorted by timestamp
+    ts = [e["ts"] for e in events if e["ph"] != "M"]
+    assert ts == sorted(ts)
+    assert tr.stats()["last_dump"] == path
+
+
+# -------------------------------------------------------------- train spans
+def test_train_iteration_spans_and_phase_children():
+    X, y = _data()
+    lgb.train(dict(_PARAMS, telemetry=True), lgb.Dataset(X, y), 3)
+    spans = get_tracer().spans()
+    runs = [s for s in spans if s["name"] == "train/run"]
+    iters = [s for s in spans if s["name"] == "train/iteration"]
+    phases = [s for s in spans if s["name"].startswith("phase/")]
+    assert len(runs) == 1
+    assert len(iters) == 3
+    assert all(s["parent_id"] == runs[0]["span_id"] for s in iters)
+    assert all(s["trace_id"] == runs[0]["trace_id"] for s in iters)
+    iter_ids = {s["span_id"] for s in iters}
+    assert phases and all(s["parent_id"] in iter_ids for s in phases)
+    assert not any(s.get("synthetic") for s in spans)
+
+
+def test_launch_synthetic_children_match_serial(tmp_path):
+    X, y = _data()
+    serial = lgb.train(
+        dict(_PARAMS, telemetry=True), lgb.Dataset(X, y), 6
+    )
+    serial_events = [
+        e for e in serial.telemetry()["events"]
+        if e.get("event") == "iteration"
+    ]
+    assert len(serial_events) == 6
+    # ground truth per-iteration splits from the serial model's own trees
+    # (the serial JSONL's per-event split counts lag one iteration on the
+    # pipelined path, so the trees are the alignment oracle)
+    serial_splits = {
+        i: tree["num_leaves"] - 1
+        for i, tree in enumerate(serial.dump_model()["tree_info"])
+    }
+
+    tracer = get_tracer()
+    tracer.reset()
+    ses = get_session()
+    ses.configure(enabled=False)
+    ses.reset()
+    launched = lgb.train(
+        dict(_PARAMS, telemetry=True, train_steps_per_launch=3),
+        lgb.Dataset(X, y), 6,
+    )
+    # byte-identical model (the params block legitimately differs by the
+    # train_steps_per_launch line itself)
+    drop = lambda txt: [  # noqa: E731
+        ln for ln in txt.splitlines()
+        if not ln.startswith("[train_steps_per_launch")
+    ]
+    assert drop(serial.model_to_string()) == drop(launched.model_to_string())
+    spans = tracer.spans()
+    launches = [s for s in spans if s["name"] == "train/launch"]
+    synth = [s for s in spans if s.get("synthetic")]
+    assert len(launches) == 2
+    assert len(synth) == 6
+    launch_ids = {s["span_id"] for s in launches}
+    for s in synth:
+        assert s["name"] == "train/iteration"
+        assert s["parent_id"] in launch_ids
+        assert s["args"]["from_launch"] is True
+        # device counters on the synthetic span match the serial run
+        assert s["args"]["splits"] == serial_splits[s["args"]["iter"]]
+    # synthetic children tile their launch window in iteration order
+    for launch in launches:
+        kids = sorted(
+            (s for s in synth if s["parent_id"] == launch["span_id"]),
+            key=lambda s: s["args"]["iter"],
+        )
+        assert [s["ts"] for s in kids] == sorted(s["ts"] for s in kids)
+        assert all(s["ts"] >= launch["ts"] for s in kids)
+
+    # satellite: per-iteration JSONL events replayed with from_launch=true
+    launched_events = [
+        e for e in launched.telemetry()["events"]
+        if e.get("event") == "iteration"
+    ]
+    assert len(launched_events) == 6
+    assert all(e.get("from_launch") for e in launched_events)
+    assert {e["iter"]: e["splits"] for e in launched_events} == serial_splits
+
+
+def test_dump_trace_api(tmp_path):
+    X, y = _data()
+    b = lgb.train(dict(_PARAMS), lgb.Dataset(X, y), 2)
+    out = str(tmp_path / "run_trace.json")
+    assert b.dump_trace(out) == out
+    doc = json.loads(open(out).read())
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "train/run" in names and "train/iteration" in names
+
+
+def test_dump_on_fault_pairs_flight_and_trace(tmp_path):
+    flight = get_flight()
+    flight.configure(fault_dir=str(tmp_path), run_info={}, active=True)
+    flight.note_event({"event": "iteration", "iter": 0, "wall_ms": 1.0})
+    tr = get_tracer()
+    tr.end(tr.begin("train/iteration", "train"))
+    flight_path = flight.dump("unit_fault")
+    trace_path = flight.last_trace_path
+    assert os.path.exists(flight_path) and os.path.exists(trace_path)
+    # the pair shares one <ts>_<pid>_<n> suffix for postmortem correlation
+    fsuf = os.path.basename(flight_path)[len("flight_"):]
+    tsuf = os.path.basename(trace_path)[len("trace_"):]
+    assert fsuf == tsuf
+    doc = json.loads(open(trace_path).read())
+    assert any(
+        e["name"] == "train/iteration" for e in doc["traceEvents"]
+    )
+
+
+def test_trace_disabled_by_config():
+    X, y = _data()
+    lgb.train(dict(_PARAMS, trace_spans=False), lgb.Dataset(X, y), 2)
+    assert get_tracer().stats()["spans_total"] == 0
+
+
+# ------------------------------------------------------------- serving spans
+@pytest.mark.slow
+def test_serving_traceparent_http_round_trip():
+    X, y = _data()
+    b = lgb.train(dict(_PARAMS), lgb.Dataset(X, y), 3)
+    tracer = get_tracer()
+    tracer.reset()
+    srv = lgb.serve(b, params={"serve_port": -1, "serve_deadline_ms": 2.0})
+    try:
+        caller_trace, caller_span = "ab" * 16, "cd" * 8
+        req = urllib.request.Request(
+            srv.url + "/predict",
+            data=json.dumps({"rows": X[:4].tolist()}).encode(),
+            headers={"traceparent": format_traceparent(caller_trace, caller_span)},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            doc = json.loads(resp.read())
+            echoed = resp.headers.get("traceparent")
+        assert np.allclose(doc["predictions"], b.predict(X[:4]))
+        # echoed header: caller's trace id, the request span's own id
+        assert echoed == doc["traceparent"]
+        parsed = parse_traceparent(echoed)
+        assert parsed is not None and parsed[0] == caller_trace
+        spans = {s["span_id"]: s for s in tracer.spans()}
+        req_span = spans[parsed[1]]
+        assert req_span["name"] == "serve/request"
+        assert req_span["trace_id"] == caller_trace
+        assert req_span["parent_id"] == caller_span
+        by_name = {}
+        for s in spans.values():
+            by_name.setdefault(s["name"], []).append(s)
+        # queue_wait decomposes the request span; the stage spans decompose
+        # the flush's batch span
+        qw = by_name["serve/queue_wait"]
+        assert any(s["parent_id"] == req_span["span_id"] for s in qw)
+        batch = by_name["serve/batch"][0]
+        for stage in (
+            "serve/batch_assembly",
+            "serve/device_dispatch",
+            "serve/unpad_respond",
+        ):
+            assert any(
+                s["parent_id"] == batch["span_id"] for s in by_name[stage]
+            )
+        # GET /trace serves the same Chrome JSON document
+        with urllib.request.urlopen(srv.url + "/trace", timeout=10) as resp:
+            tdoc = json.loads(resp.read())
+        assert {e["name"] for e in tdoc["traceEvents"]} >= {
+            "serve/request", "serve/batch", "serve/queue_wait"
+        }
+        # /metrics: trace counters + queue/device attribution summaries
+        with urllib.request.urlopen(srv.url + "/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert "lgbtpu_trace_spans_total" in text
+        assert "lgbtpu_trace_dropped_total" in text
+        assert 'lgbtpu_serve_queue_ms{quantile="0.99"}' in text
+        assert 'lgbtpu_serve_device_ms{quantile="0.99"}' in text
+    finally:
+        srv.stop()
+
+
+def test_predict_async_traceparent_echo():
+    X, y = _data()
+    b = lgb.train(dict(_PARAMS), lgb.Dataset(X, y), 2)
+    srv = lgb.serve(b, params={"serve_port": 0, "serve_deadline_ms": 1.0})
+    try:
+        tp = format_traceparent("12" * 16, "34" * 8)
+        resp = srv.predict_async(X[:2], traceparent=tp).result(timeout=30)
+        parsed = parse_traceparent(resp.info["traceparent"])
+        assert parsed is not None and parsed[0] == "12" * 16
+        # without a header the info carries no trace context only when
+        # the request span was sampled out; by default it is sampled in
+        resp2 = srv.predict_async(X[:2]).result(timeout=30)
+        assert parse_traceparent(resp2.info.get("traceparent")) is not None
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------- watchdog cadence
+def _cadence_alerts(launch_steps: int, total: int = 80):
+    """Feed the watchdog commit-rate-collapse telemetry as `total`
+    iterations grouped into `launch_steps`-sized launch events; returns
+    the iterations at which the rule fired."""
+    ses = get_session()
+    ses.configure(enabled=True)
+    ses.set_gauge("grower.commit_rate", 0.05)
+    ses.set_gauge("grower.leaf_batch_effective", 4.0)
+    wd = HealthWatchdog(warmup_iters=7, cooldown_iters=16)
+    fired = []
+    for start in range(0, total, launch_steps):
+        last = start + launch_steps - 1
+        if launch_steps == 1:
+            event = {"event": "iteration", "iter": last, "wall_ms": 10.0}
+        else:
+            event = {
+                "event": "launch",
+                "iter": last,
+                "launch_begin": start,
+                "steps": launch_steps,
+                "wall_ms": 10.0,
+            }
+        for alert in wd.observe(event, ses):
+            fired.append(alert["iter"])
+    ses.configure(enabled=False)
+    ses.reset()
+    return fired
+
+
+def test_watchdog_cadence_identical_serial_vs_launch():
+    """Satellite: warmup/cooldown counted in iterations, not observe()
+    calls — N=1 and N=8 launches see the identical alert cadence."""
+    serial = _cadence_alerts(1)
+    launched = _cadence_alerts(8)
+    assert serial == [7, 23, 39, 55, 71]
+    assert launched == serial
+
+
+# ------------------------------------------------------------- perf contract
+def test_tracing_adds_zero_retraces():
+    X, y = _data()
+    params = dict(_PARAMS, telemetry=True)
+    lgb.train(params, lgb.Dataset(X, y), 3)
+    before = compile_counts_by_label()
+    # identical run with tracing exercised end-to-end (spans + dump) must
+    # not introduce a single new compile at any jit site
+    get_tracer().reset()
+    b = lgb.train(params, lgb.Dataset(X, y), 3)
+    assert get_tracer().stats()["spans_total"] > 0
+    assert b.dump_trace  # API exists on every Booster
+    after = compile_counts_by_label()
+    assert after == before

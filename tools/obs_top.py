@@ -252,10 +252,13 @@ def render_frame(state: TopState, width: int = 78, color: bool = True) -> str:
         )
         batchers = serving.get("batchers") or {}
         if batchers:
+            # q99/d99: latency attribution — where the p99 wall went
+            # (queue_wait vs device_dispatch), from the trace decomposition
             lines.append(
                 c(
                     DIM,
-                    "    model             p50ms    p99ms   fill  miss%     reqs",
+                    "    model             p50ms    p99ms    q99ms    d99ms"
+                    "   fill  miss%     reqs",
                 )
             )
             for mid in sorted(batchers):
@@ -263,6 +266,8 @@ def render_frame(state: TopState, width: int = 78, color: bool = True) -> str:
                 lines.append(
                     f"    {mid:<16}{b.get('p50_ms', 0.0):>8.2f}"
                     f"{b.get('p99_ms', 0.0):>9.2f}"
+                    f"{b.get('queue_ms_p99', 0.0):>9.2f}"
+                    f"{b.get('device_ms_p99', 0.0):>9.2f}"
                     f"{b.get('batch_fill', 0.0):>7.2f}"
                     f"{100.0 * b.get('deadline_miss_rate', 0.0):>6.1f}"
                     f"{int(b.get('requests', 0)):>9}"
@@ -275,6 +280,25 @@ def render_frame(state: TopState, width: int = 78, color: bool = True) -> str:
             + f"  p99 {state.gauge('serve/p99_ms') or 0.0:.2f}ms"
             + f"  fill {state.gauge('serve/batch_fill') or 0.0:.2f}"
             + f"  miss {100.0 * (state.gauge('serve/deadline_miss_rate') or 0.0):.1f}%"
+        )
+    # trace recorder health: span/drop counts from either source (the
+    # health doc's "trace" block, or the lgbtpu_trace_* counters)
+    trace_doc = (state.health or {}).get("trace") or {}
+    spans_total = trace_doc.get(
+        "spans_total", state.metrics.get("lgbtpu_trace_spans_total")
+    )
+    if spans_total is not None:
+        dropped = trace_doc.get(
+            "dropped_total",
+            state.metrics.get("lgbtpu_trace_dropped_total", 0.0),
+        )
+        ring = trace_doc.get("ring")
+        cap = trace_doc.get("capacity")
+        lines.append(
+            c(DIM, "  trace")
+            + f"  spans {int(spans_total)}"
+            + (f"  ring {int(ring)}/{int(cap)}" if ring is not None else "")
+            + f"  dropped {int(dropped or 0)}"
         )
     lines.append(
         c(DIM, f"  alerts (last {len(state.alerts)})")

@@ -405,4 +405,44 @@ assert scan_compiles == 1, (
     f"expected 1 scan compile across 3 launches, saw {scan_compiles}")
 print("launch-scan smoke: byte parity + single scan compile OK")
 PYEOF
+
+# trace smoke: a 3-iteration train plus one served request (with a caller
+# traceparent) must yield a Perfetto-loadable Chrome trace via
+# Booster.dump_trace containing the train span tree AND the serve request
+# decomposition, with the request joined to the caller's trace id.
+echo "=== trace smoke (dump_trace: train + serve spans, traceparent join) ==="
+python - <<'PYEOF' || rc=$?
+import json
+import tempfile
+
+import numpy as np
+import lightgbm_tpu as lgb
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(400, 6))
+y = X[:, 0] + 0.1 * rng.normal(size=400)
+b = lgb.train({"objective": "regression", "num_leaves": 7, "verbosity": -1},
+              lgb.Dataset(X, y), 3)
+caller = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+server = lgb.serve(b, deadline_ms=2.0, port=-1)
+try:
+    resp = server.predict_async(X[:5], traceparent=caller).result(timeout=30.0)
+    echoed = resp.info.get("traceparent", "")
+    assert echoed.split("-")[1] == "ab" * 16, echoed
+finally:
+    server.stop()
+path = tempfile.mktemp(suffix=".json")
+b.dump_trace(path)
+with open(path) as fp:
+    doc = json.load(fp)
+names = {e.get("name") for e in doc["traceEvents"]}
+for want in ("train/run", "train/iteration", "serve/request",
+             "serve/queue_wait", "serve/batch"):
+    assert want in names, (want, sorted(names))
+req = [e for e in doc["traceEvents"]
+       if e.get("name") == "serve/request" and e.get("ph") == "X"]
+assert req and req[0]["args"]["trace_id"] == "ab" * 16, req
+print(f"trace smoke: {len(doc['traceEvents'])} events, "
+      "train+serve spans + traceparent join OK")
+PYEOF
 exit $rc

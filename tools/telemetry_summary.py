@@ -82,6 +82,16 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             int(e.get("compiles_delta", 0)) for e in iters[1:]
         )
         out["splits_total"] = sum(int(e.get("splits", 0)) for e in iters)
+        # device-resident launches replay one synthetic iteration event
+        # per consumed step (from_launch=true), so the shape above holds
+        # for both serial and launched runs; surface the split explicitly
+        from_launch = sum(1 for e in iters if e.get("from_launch"))
+        if from_launch:
+            out["iterations_from_launch"] = from_launch
+    launches = [e for e in events if e.get("event") == "launch"]
+    if launches:
+        out["launches"] = len(launches)
+        out["steps_per_launch"] = launches[-1].get("steps_per_launch")
         colls = [e["collective"] for e in iters if "collective" in e]
         if colls:
             out["collective_bytes_total"] = {
